@@ -1,0 +1,20 @@
+"""scanner_tpu: a TPU-native framework for efficient analysis of large video
+datasets.
+
+Capabilities mirror scanner-research/scanner (SIGGRAPH 2018): computation
+graphs (Source -> Ops -> Sink) over tables of keyframe-indexed video streams,
+executed by a master/worker runtime that decodes exactly the frames each task
+needs and runs kernels as JAX/XLA programs on TPU.
+"""
+
+from .common import (BlobType, BoundaryCondition, CacheMode, DeviceType,
+                     FrameType, GraphException, JobException, NullElement,
+                     PerfParams, ScannerException, SliceList, StorageException)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "BlobType", "BoundaryCondition", "CacheMode", "DeviceType", "FrameType",
+    "GraphException", "JobException", "NullElement", "PerfParams",
+    "ScannerException", "SliceList", "StorageException",
+]

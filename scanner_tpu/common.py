@@ -1,0 +1,132 @@
+"""Common types shared across the framework.
+
+Capability parity: reference scannerpy/common.py (DeviceType:36, CacheMode:72,
+PerfParams:78) — re-designed for a host+TPU execution model rather than
+CPU/GPU kernel placement.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+class ScannerException(Exception):
+    """Base exception for all framework errors."""
+
+
+class GraphException(ScannerException):
+    """Raised when a computation graph is malformed."""
+
+
+class JobException(ScannerException):
+    """Raised when a bulk job fails."""
+
+
+class StorageException(ScannerException):
+    """Raised on storage backend errors."""
+
+
+class DeviceType(enum.Enum):
+    """Where a kernel runs.
+
+    The reference dispatches CPU vs GPU (common.h:53-82); here the split is
+    host (numpy, C++ helpers) vs TPU (JAX/XLA programs).  DeviceType.GPU is
+    accepted as an alias for TPU so reference-style scripts keep working.
+    """
+
+    CPU = "cpu"
+    TPU = "tpu"
+    GPU = "tpu"  # alias: accelerator
+
+    @property
+    def is_accelerator(self) -> bool:
+        return self is not DeviceType.CPU
+
+
+class FrameType:
+    """Marker type for video-frame columns in kernel type annotations."""
+
+
+class BlobType:
+    """Marker type for raw-bytes columns."""
+
+
+class CacheMode(enum.Enum):
+    """What to do when a job's output stream already exists.
+
+    Mirrors reference CacheMode (common.py:72): Error refuses, Ignore skips
+    already-committed outputs (job-level resume), Overwrite recomputes.
+    """
+
+    Error = 0
+    Ignore = 1
+    Overwrite = 2
+
+
+class BoundaryCondition(enum.Enum):
+    """Stencil boundary handling. Only REPEAT_EDGE is supported, matching the
+    reference (assert at evaluate_worker.cpp:413)."""
+
+    REPEAT_EDGE = 0
+
+
+@dataclass
+class PerfParams:
+    """Performance knobs for a bulk job.
+
+    io_packet_size: rows per storage/decode unit of work (task granularity is
+      a multiple of this); work_packet_size: rows per compute batch pushed to
+      a kernel group (the XLA batch dimension).
+    Mirrors reference PerfParams (common.py:78-160) with TPU-centric defaults.
+    """
+
+    work_packet_size: int = 16
+    io_packet_size: int = 64
+    pipeline_instances_per_node: Optional[int] = None
+    load_sparsity_threshold: int = 8
+    queue_size_per_pipeline: int = 4
+    cpu_pool: Optional[str] = None
+    task_timeout: float = 0.0  # seconds; 0 = no timeout
+    checkpoint_frequency: int = 10
+
+    @classmethod
+    def manual(cls, work_packet_size: int, io_packet_size: int, **kw) -> "PerfParams":
+        if io_packet_size % work_packet_size != 0:
+            raise ScannerException(
+                f"io_packet_size ({io_packet_size}) must be a multiple of "
+                f"work_packet_size ({work_packet_size})")
+        return cls(work_packet_size=work_packet_size,
+                   io_packet_size=io_packet_size, **kw)
+
+    @classmethod
+    def estimate(cls, **kw) -> "PerfParams":
+        """Auto-tuned variant; heuristics are applied at job-launch time when
+        stream geometry is known (engine/executor.py)."""
+        p = cls(**kw)
+        p._estimate = True  # type: ignore[attr-defined]
+        return p
+
+
+class NullElement:
+    """Placeholder for a null row produced by RepeatNull spacing or missed
+    dependencies (reference storage.py:8)."""
+
+    _instance: Optional["NullElement"] = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self) -> str:
+        return "NullElement"
+
+    def __reduce__(self):
+        return (NullElement, ())
+
+
+class SliceList(list):
+    """Marks a per-job argument list as being per-slice-group rather than a
+    plain value (reference op.py SliceList)."""

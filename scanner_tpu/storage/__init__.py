@@ -1,0 +1,10 @@
+from .backend import StorageBackend, PosixStorage, MemoryStorage, make_storage
+from .database import Database
+from .metadata import (ColumnDescriptor, ColumnType, DatabaseMetadata,
+                       TableDescriptor, VideoDescriptor)
+
+__all__ = [
+    "StorageBackend", "PosixStorage", "MemoryStorage", "make_storage",
+    "Database", "ColumnDescriptor", "ColumnType", "DatabaseMetadata",
+    "TableDescriptor", "VideoDescriptor",
+]

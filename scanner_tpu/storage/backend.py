@@ -1,0 +1,173 @@
+"""Storage backends.
+
+Capability parity: the reference delegates persistence to the external
+`storehouse` library (POSIX/GCS/S3 — reference scanner/util/storehouse.h,
+python config.py:56).  Here the same narrow interface is defined natively;
+POSIX is the production backend (works against local disk, NFS and
+GCS-via-gcsfuse), Memory backs unit tests.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Iterator, List, Optional
+
+from ..common import StorageException
+
+
+class StorageBackend:
+    """A flat blob store keyed by slash-separated paths.
+
+    Writes are atomic (visible entirely or not at all) so that concurrent
+    readers — other workers, the master — never observe torn metadata.
+    """
+
+    def read(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def read_range(self, path: str, offset: int, size: int) -> bytes:
+        raise NotImplementedError
+
+    def write(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def size(self, path: str) -> int:
+        raise NotImplementedError
+
+    def delete(self, path: str) -> None:
+        raise NotImplementedError
+
+    def delete_prefix(self, prefix: str) -> None:
+        raise NotImplementedError
+
+    def list_prefix(self, prefix: str) -> List[str]:
+        raise NotImplementedError
+
+
+class PosixStorage(StorageBackend):
+    """Blobs are files under a root directory."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _abs(self, path: str) -> str:
+        p = os.path.normpath(os.path.join(self.root, path))
+        if p != self.root and not p.startswith(self.root + os.sep):
+            raise StorageException(f"path escapes storage root: {path}")
+        return p
+
+    def read(self, path: str) -> bytes:
+        try:
+            with open(self._abs(path), "rb") as f:
+                return f.read()
+        except FileNotFoundError as e:
+            raise StorageException(f"not found: {path}") from e
+
+    def read_range(self, path: str, offset: int, size: int) -> bytes:
+        try:
+            with open(self._abs(path), "rb") as f:
+                f.seek(offset)
+                return f.read(size)
+        except FileNotFoundError as e:
+            raise StorageException(f"not found: {path}") from e
+
+    def write(self, path: str, data: bytes) -> None:
+        p = self._abs(path)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + f".tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, p)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(self._abs(path))
+
+    def size(self, path: str) -> int:
+        try:
+            return os.path.getsize(self._abs(path))
+        except FileNotFoundError as e:
+            raise StorageException(f"not found: {path}") from e
+
+    def delete(self, path: str) -> None:
+        try:
+            os.remove(self._abs(path))
+        except FileNotFoundError:
+            pass
+
+    def delete_prefix(self, prefix: str) -> None:
+        import shutil
+        p = self._abs(prefix)
+        if os.path.isdir(p):
+            shutil.rmtree(p, ignore_errors=True)
+        elif os.path.exists(p):
+            os.remove(p)
+
+    def list_prefix(self, prefix: str) -> List[str]:
+        p = self._abs(prefix)
+        out: List[str] = []
+        if not os.path.isdir(p):
+            return out
+        for dirpath, _dirs, files in os.walk(p):
+            for fn in files:
+                out.append(os.path.relpath(os.path.join(dirpath, fn), self.root))
+        return sorted(out)
+
+    def local_path(self, path: str) -> str:
+        """Direct filesystem path — used to hand files to the C++ layer."""
+        return self._abs(path)
+
+
+class MemoryStorage(StorageBackend):
+    """In-process blob store for unit tests."""
+
+    def __init__(self):
+        self._blobs: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def read(self, path: str) -> bytes:
+        with self._lock:
+            if path not in self._blobs:
+                raise StorageException(f"not found: {path}")
+            return self._blobs[path]
+
+    def read_range(self, path: str, offset: int, size: int) -> bytes:
+        return self.read(path)[offset:offset + size]
+
+    def write(self, path: str, data: bytes) -> None:
+        with self._lock:
+            self._blobs[path] = bytes(data)
+
+    def exists(self, path: str) -> bool:
+        with self._lock:
+            return path in self._blobs
+
+    def size(self, path: str) -> int:
+        return len(self.read(path))
+
+    def delete(self, path: str) -> None:
+        with self._lock:
+            self._blobs.pop(path, None)
+
+    def delete_prefix(self, prefix: str) -> None:
+        with self._lock:
+            for k in [k for k in self._blobs if k.startswith(prefix)]:
+                del self._blobs[k]
+
+    def list_prefix(self, prefix: str) -> List[str]:
+        with self._lock:
+            return sorted(k for k in self._blobs if k.startswith(prefix))
+
+
+def make_storage(storage_type: str, **kw) -> StorageBackend:
+    if storage_type == "posix":
+        return PosixStorage(kw["db_path"])
+    if storage_type == "memory":
+        return MemoryStorage()
+    raise StorageException(f"unknown storage type: {storage_type}")

@@ -1,0 +1,121 @@
+"""Column item-file format.
+
+One item file holds the serialized rows of one column for one row range.
+Layout (little-endian):
+
+    magic   u32  = 0x53434954 ("SCIT")
+    version u32
+    nrows   u64
+    sizes   u64[nrows]   (NULL_SIZE marks a null row)
+    payloads, concatenated
+
+The sizes header is fixed-position so a reader can fetch it with one ranged
+read and then fetch only the rows it needs — the sparse-read path the
+reference implements in ColumnSource (column_source.cpp, sparse vs dense via
+load_sparsity_threshold).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..common import NullElement, StorageException
+from .backend import StorageBackend
+
+MAGIC = 0x53434954
+VERSION = 1
+NULL_SIZE = 0xFFFFFFFFFFFFFFFF
+_HEADER = struct.Struct("<IIQ")
+
+RowData = Union[bytes, NullElement]
+
+
+def build_item(rows: Sequence[RowData]) -> bytes:
+    sizes = np.empty(len(rows), dtype=np.uint64)
+    payloads: List[bytes] = []
+    for i, r in enumerate(rows):
+        if isinstance(r, NullElement):
+            sizes[i] = NULL_SIZE
+        else:
+            b = bytes(r)
+            sizes[i] = len(b)
+            payloads.append(b)
+    return b"".join([_HEADER.pack(MAGIC, VERSION, len(rows)),
+                     sizes.tobytes()] + payloads)
+
+
+def write_item(backend: StorageBackend, path: str, rows: Sequence[RowData]) -> None:
+    backend.write(path, build_item(rows))
+
+
+def _parse_header(buf: bytes, path: str):
+    if len(buf) < _HEADER.size:
+        raise StorageException(f"item file too short: {path}")
+    magic, version, nrows = _HEADER.unpack_from(buf, 0)
+    if magic != MAGIC:
+        raise StorageException(f"bad item magic in {path}")
+    if version != VERSION:
+        raise StorageException(f"unsupported item version {version} in {path}")
+    return nrows
+
+
+def read_item(backend: StorageBackend, path: str) -> List[Optional[bytes]]:
+    """Read every row of an item. Null rows come back as None."""
+    buf = backend.read(path)
+    nrows = _parse_header(buf, path)
+    sizes = np.frombuffer(buf, dtype=np.uint64, count=nrows, offset=_HEADER.size)
+    out: List[Optional[bytes]] = []
+    off = _HEADER.size + 8 * nrows
+    for s in sizes:
+        if s == NULL_SIZE:
+            out.append(None)
+        else:
+            s = int(s)
+            out.append(buf[off:off + s])
+            off += s
+    return out
+
+
+def read_item_rows(backend: StorageBackend, path: str,
+                   local_rows: Sequence[int],
+                   sparsity_threshold: int = 8) -> List[Optional[bytes]]:
+    """Read selected rows (local indices) from an item.
+
+    If the requested rows are dense relative to the item, the whole file is
+    fetched with one read; otherwise the sizes header is read first and each
+    row fetched with a ranged read.
+    """
+    if len(local_rows) == 0:
+        return []
+    header = backend.read_range(path, 0, _HEADER.size)
+    nrows = _parse_header(header, path)
+    if nrows == 0:
+        raise StorageException(f"empty item: {path}")
+    dense = len(local_rows) * sparsity_threshold >= nrows
+    if dense:
+        all_rows = read_item(backend, path)
+        return [all_rows[r] for r in local_rows]
+    sizes_buf = backend.read_range(path, _HEADER.size, 8 * nrows)
+    sizes = np.frombuffer(sizes_buf, dtype=np.uint64, count=nrows)
+    payload_sizes = np.where(sizes == NULL_SIZE, 0, sizes).astype(np.uint64)
+    offsets = np.zeros(nrows, dtype=np.uint64)
+    np.cumsum(payload_sizes[:-1], out=offsets[1:])
+    base = _HEADER.size + 8 * nrows
+    out: List[Optional[bytes]] = []
+    for r in local_rows:
+        if r < 0 or r >= nrows:
+            raise StorageException(f"row {r} out of item bounds ({nrows}): {path}")
+        if sizes[r] == NULL_SIZE:
+            out.append(None)
+        else:
+            out.append(backend.read_range(path, base + int(offsets[r]),
+                                          int(sizes[r])))
+    return out
+
+
+def item_num_rows(backend: StorageBackend, path: str) -> int:
+    header = backend.read_range(path, 0, _HEADER.size)
+    return _parse_header(header, path)

@@ -1,0 +1,18 @@
+import os
+
+# Tests always run on a virtual 8-device CPU mesh so multi-chip sharding
+# logic is exercised without TPU hardware.  bench.py does NOT import this —
+# it runs on the real chip.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_db(tmp_path):
+    from scanner_tpu.storage import Database, PosixStorage
+    return Database(PosixStorage(str(tmp_path / "db")))
