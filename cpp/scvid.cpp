@@ -1,0 +1,613 @@
+// libscvid: native video layer for scanner_tpu.
+//
+// Capability parity with the reference's scanner/video/ stack:
+//   - ingest/index      (reference ingest.cpp:867, h264_byte_stream_index_creator.cpp)
+//   - exact-frame decode (reference decoder_automata.cpp, software_video_decoder.cpp)
+//   - re-encode          (reference software_video_encoder.cpp)
+//   - mp4 export         (reference storage.py save_mp4)
+//
+// Design differences (TPU-native, not a port):
+//   * Codec-agnostic container index: per-sample offsets/sizes/keyframe flags
+//     come from the demuxer, not a hand-rolled H.264 NAL parser, so any
+//     libavcodec codec ingests; H.264/libx264 is the encode path.
+//   * C ABI for ctypes.  Python threads call in parallel (ctypes drops the
+//     GIL), so N decoder handles = N truly parallel decode pipelines feeding
+//     one TPU.
+//   * Batch decode-range call: one crossing decodes a keyframe-aligned packet
+//     run into a caller-owned RGB24 buffer, selecting only wanted frames —
+//     the DecoderAutomata contract in a single call.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+#include <libavcodec/avcodec.h>
+#include <libavformat/avformat.h>
+#include <libavutil/imgutils.h>
+#include <libavutil/opt.h>
+#include <libswscale/swscale.h>
+}
+
+#define SCVID_API extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+thread_local std::string g_error;
+
+void set_error(const std::string& msg) { g_error = msg; }
+
+void set_av_error(const std::string& prefix, int err) {
+  char buf[256];
+  av_strerror(err, buf, sizeof(buf));
+  g_error = prefix + ": " + buf;
+}
+
+}  // namespace
+
+SCVID_API const char* scvid_last_error() { return g_error.c_str(); }
+
+SCVID_API void scvid_set_log_level(int level) { av_log_set_level(level); }
+
+// ---------------------------------------------------------------------------
+// Ingest: demux a container, write the packet stream, return the index.
+// ---------------------------------------------------------------------------
+
+struct ScvidIndex {
+  int32_t width = 0;
+  int32_t height = 0;
+  double fps = 0.0;
+  int64_t num_samples = 0;
+  char codec[32] = {0};
+  // pts/dts time base of the source stream
+  int32_t tb_num = 0;
+  int32_t tb_den = 1;
+  // arrays of length num_samples, decode order
+  uint64_t* sample_offsets = nullptr;
+  uint64_t* sample_sizes = nullptr;
+  int64_t* sample_pts = nullptr;
+  int64_t* sample_dts = nullptr;
+  uint8_t* keyflags = nullptr;
+  uint8_t* extradata = nullptr;
+  int64_t extradata_size = 0;
+};
+
+SCVID_API void scvid_index_free(ScvidIndex* idx) {
+  if (!idx) return;
+  delete[] idx->sample_offsets;
+  delete[] idx->sample_sizes;
+  delete[] idx->sample_pts;
+  delete[] idx->sample_dts;
+  delete[] idx->keyflags;
+  delete[] idx->extradata;
+  delete idx;
+}
+
+// Demux `in_path`. If out_packets_path != NULL, concatenated packet payloads
+// are written there and offsets index that file (normal ingest).  If NULL,
+// offsets are the packets' byte positions inside the original container
+// (in-place ingest, reference ingest.cpp:382 parse_video_inplace); fails if
+// the container does not expose packet positions.
+SCVID_API ScvidIndex* scvid_ingest(const char* in_path,
+                                   const char* out_packets_path) {
+  AVFormatContext* fmt = nullptr;
+  int err = avformat_open_input(&fmt, in_path, nullptr, nullptr);
+  if (err < 0) {
+    set_av_error(std::string("open ") + in_path, err);
+    return nullptr;
+  }
+  err = avformat_find_stream_info(fmt, nullptr);
+  if (err < 0) {
+    set_av_error("find_stream_info", err);
+    avformat_close_input(&fmt);
+    return nullptr;
+  }
+  int stream_idx =
+      av_find_best_stream(fmt, AVMEDIA_TYPE_VIDEO, -1, -1, nullptr, 0);
+  if (stream_idx < 0) {
+    set_error("no video stream found");
+    avformat_close_input(&fmt);
+    return nullptr;
+  }
+  AVStream* stream = fmt->streams[stream_idx];
+  const AVCodecParameters* par = stream->codecpar;
+  const AVCodecDescriptor* desc = avcodec_descriptor_get(par->codec_id);
+
+  FILE* out = nullptr;
+  if (out_packets_path) {
+    out = fopen(out_packets_path, "wb");
+    if (!out) {
+      set_error(std::string("cannot open for write: ") + out_packets_path);
+      avformat_close_input(&fmt);
+      return nullptr;
+    }
+  }
+
+  std::vector<uint64_t> offsets, sizes;
+  std::vector<int64_t> pts, dts;
+  std::vector<uint8_t> keys;
+  uint64_t write_off = 0;
+  bool inplace_ok = true;
+
+  AVPacket* pkt = av_packet_alloc();
+  while (av_read_frame(fmt, pkt) >= 0) {
+    if (pkt->stream_index == stream_idx) {
+      if (out) {
+        offsets.push_back(write_off);
+        fwrite(pkt->data, 1, pkt->size, out);
+        write_off += pkt->size;
+      } else {
+        if (pkt->pos < 0) inplace_ok = false;
+        offsets.push_back(pkt->pos < 0 ? 0 : (uint64_t)pkt->pos);
+      }
+      sizes.push_back((uint64_t)pkt->size);
+      pts.push_back(pkt->pts == AV_NOPTS_VALUE ? (int64_t)pts.size()
+                                               : pkt->pts);
+      dts.push_back(pkt->dts == AV_NOPTS_VALUE ? (int64_t)dts.size() - 1
+                                               : pkt->dts);
+      keys.push_back((pkt->flags & AV_PKT_FLAG_KEY) ? 1 : 0);
+    }
+    av_packet_unref(pkt);
+  }
+  av_packet_free(&pkt);
+  if (out) fclose(out);
+
+  if (!out_packets_path && !inplace_ok) {
+    set_error("container does not expose packet positions; in-place ingest "
+              "unsupported for this file");
+    avformat_close_input(&fmt);
+    return nullptr;
+  }
+  if (offsets.empty()) {
+    set_error("no packets in video stream");
+    avformat_close_input(&fmt);
+    return nullptr;
+  }
+
+  ScvidIndex* idx = new ScvidIndex();
+  idx->width = par->width;
+  idx->height = par->height;
+  AVRational fr = stream->avg_frame_rate.num
+                      ? stream->avg_frame_rate
+                      : stream->r_frame_rate;
+  idx->fps = fr.den ? av_q2d(fr) : 0.0;
+  idx->num_samples = (int64_t)offsets.size();
+  snprintf(idx->codec, sizeof(idx->codec), "%s",
+           desc ? desc->name : "unknown");
+  idx->tb_num = stream->time_base.num;
+  idx->tb_den = stream->time_base.den;
+  idx->sample_offsets = new uint64_t[offsets.size()];
+  idx->sample_sizes = new uint64_t[sizes.size()];
+  idx->sample_pts = new int64_t[pts.size()];
+  idx->sample_dts = new int64_t[dts.size()];
+  idx->keyflags = new uint8_t[keys.size()];
+  memcpy(idx->sample_offsets, offsets.data(), offsets.size() * 8);
+  memcpy(idx->sample_sizes, sizes.data(), sizes.size() * 8);
+  memcpy(idx->sample_pts, pts.data(), pts.size() * 8);
+  memcpy(idx->sample_dts, dts.data(), dts.size() * 8);
+  memcpy(idx->keyflags, keys.data(), keys.size());
+  if (par->extradata_size > 0) {
+    idx->extradata = new uint8_t[par->extradata_size];
+    memcpy(idx->extradata, par->extradata, par->extradata_size);
+    idx->extradata_size = par->extradata_size;
+  }
+  avformat_close_input(&fmt);
+  return idx;
+}
+
+// ---------------------------------------------------------------------------
+// Decoder: exact-frame delivery from packet runs.
+// ---------------------------------------------------------------------------
+
+struct ScvidDecoder {
+  AVCodecContext* ctx = nullptr;
+  SwsContext* sws = nullptr;
+  AVFrame* frame = nullptr;
+  int width = 0;
+  int height = 0;
+  int64_t emitted = 0;  // display-order frames emitted since last reset
+};
+
+SCVID_API ScvidDecoder* scvid_decoder_create(const char* codec_name,
+                                             const uint8_t* extradata,
+                                             int64_t extradata_size,
+                                             int32_t width, int32_t height,
+                                             int32_t n_threads) {
+  const AVCodec* codec = avcodec_find_decoder_by_name(codec_name);
+  if (!codec) {
+    set_error(std::string("no decoder: ") + codec_name);
+    return nullptr;
+  }
+  AVCodecContext* ctx = avcodec_alloc_context3(codec);
+  if (extradata_size > 0) {
+    ctx->extradata =
+        (uint8_t*)av_mallocz(extradata_size + AV_INPUT_BUFFER_PADDING_SIZE);
+    memcpy(ctx->extradata, extradata, extradata_size);
+    ctx->extradata_size = (int)extradata_size;
+  }
+  ctx->width = width;
+  ctx->height = height;
+  ctx->thread_count = n_threads > 0 ? n_threads : 1;
+  ctx->thread_type = FF_THREAD_FRAME | FF_THREAD_SLICE;
+  int err = avcodec_open2(ctx, codec, nullptr);
+  if (err < 0) {
+    set_av_error("avcodec_open2", err);
+    avcodec_free_context(&ctx);
+    return nullptr;
+  }
+  ScvidDecoder* d = new ScvidDecoder();
+  d->ctx = ctx;
+  d->frame = av_frame_alloc();
+  return d;
+}
+
+SCVID_API void scvid_decoder_destroy(ScvidDecoder* d) {
+  if (!d) return;
+  if (d->sws) sws_freeContext(d->sws);
+  av_frame_free(&d->frame);
+  avcodec_free_context(&d->ctx);
+  delete d;
+}
+
+// Drop all buffered state; call on seek/discontinuity
+// (reference decoder_automata.cpp discontinuity flush).
+SCVID_API void scvid_decoder_reset(ScvidDecoder* d) {
+  avcodec_flush_buffers(d->ctx);
+  d->emitted = 0;
+}
+
+namespace {
+
+// Convert the decoder's current frame to RGB24 into dst (h*w*3 bytes).
+int convert_to_rgb(ScvidDecoder* d, uint8_t* dst) {
+  AVFrame* f = d->frame;
+  if (!d->sws || d->width != f->width || d->height != f->height) {
+    if (d->sws) sws_freeContext(d->sws);
+    d->sws = sws_getContext(f->width, f->height, (AVPixelFormat)f->format,
+                            f->width, f->height, AV_PIX_FMT_RGB24,
+                            SWS_BILINEAR, nullptr, nullptr, nullptr);
+    d->width = f->width;
+    d->height = f->height;
+    if (!d->sws) {
+      set_error("sws_getContext failed");
+      return -1;
+    }
+  }
+  uint8_t* dst_planes[4] = {dst, nullptr, nullptr, nullptr};
+  int dst_stride[4] = {3 * f->width, 0, 0, 0};
+  sws_scale(d->sws, f->data, f->linesize, 0, f->height, dst_planes,
+            dst_stride);
+  return 0;
+}
+
+}  // namespace
+
+// Decode a run of packets and write selected output frames.
+//
+//   packets      : concatenated packet payloads
+//   pkt_sizes    : size of each packet, n_packets entries
+//   wanted       : mask over output frames (display order, relative to the
+//                  first frame this run emits *since the last reset*); may be
+//                  shorter than the run's total output — excess frames drop.
+//   n_wanted     : length of `wanted`
+//   flush        : 1 = send EOF after the packets and drain the codec
+//   out          : caller buffer of out_capacity bytes
+//   out_capacity : size of `out`; decode aborts cleanly rather than overrun
+//                  (guards against mid-stream geometry changes / stale index)
+//   out_dims     : receives [height, width] of decoded frames
+//
+// Returns number of frames written, or -1 on error.  The decoder keeps
+// counting emitted frames across calls until scvid_decoder_reset, so a long
+// keyframe run can be streamed through multiple calls with a sliding mask.
+SCVID_API int64_t scvid_decode_run(ScvidDecoder* d, const uint8_t* packets,
+                                   const uint64_t* pkt_sizes,
+                                   int64_t n_packets, const uint8_t* wanted,
+                                   int64_t n_wanted, int32_t flush,
+                                   uint8_t* out, int64_t out_capacity,
+                                   int64_t* out_dims) {
+  int64_t written = 0;
+  int64_t frame_bytes = 0;
+  AVPacket* pkt = av_packet_alloc();
+  const uint8_t* cur = packets;
+
+  auto drain = [&]() -> int {
+    while (true) {
+      int err = avcodec_receive_frame(d->ctx, d->frame);
+      if (err == AVERROR(EAGAIN) || err == AVERROR_EOF) return 0;
+      if (err < 0) {
+        set_av_error("receive_frame", err);
+        return -1;
+      }
+      if (frame_bytes == 0) {
+        out_dims[0] = d->frame->height;
+        out_dims[1] = d->frame->width;
+        frame_bytes = (int64_t)d->frame->height * d->frame->width * 3;
+      }
+      int64_t fi = d->emitted++;
+      if (fi < n_wanted && wanted[fi]) {
+        if ((written + 1) * frame_bytes > out_capacity) {
+          set_error("decode output exceeds buffer capacity (geometry "
+                    "mismatch with index?)");
+          return -1;
+        }
+        if (convert_to_rgb(d, out + written * frame_bytes) < 0) return -1;
+        written++;
+      }
+      av_frame_unref(d->frame);
+    }
+  };
+
+  for (int64_t i = 0; i < n_packets; ++i) {
+    av_packet_unref(pkt);
+    // const-cast is safe: we set pkt as a read-only view for send_packet
+    pkt->data = const_cast<uint8_t*>(cur);
+    pkt->size = (int)pkt_sizes[i];
+    cur += pkt_sizes[i];
+    int err;
+    while ((err = avcodec_send_packet(d->ctx, pkt)) == AVERROR(EAGAIN)) {
+      // codec input queue full: drain output, then resend this packet
+      if (drain() < 0) {
+        av_packet_free(&pkt);
+        return -1;
+      }
+    }
+    if (err < 0) {
+      // Corrupt packet: report, don't crash the pipeline
+      set_av_error("send_packet", err);
+      av_packet_free(&pkt);
+      return -1;
+    }
+    if (drain() < 0) {
+      av_packet_free(&pkt);
+      return -1;
+    }
+  }
+  if (flush) {
+    avcodec_send_packet(d->ctx, nullptr);
+    if (drain() < 0) {
+      av_packet_free(&pkt);
+      return -1;
+    }
+    avcodec_flush_buffers(d->ctx);
+  }
+  av_packet_free(&pkt);
+  return written;
+}
+
+SCVID_API int64_t scvid_decoder_emitted(ScvidDecoder* d) { return d->emitted; }
+
+// ---------------------------------------------------------------------------
+// Encoder: RGB24 frames -> H.264 (or any libavcodec encoder) packets.
+// ---------------------------------------------------------------------------
+
+struct ScvidEncoder {
+  AVCodecContext* ctx = nullptr;
+  SwsContext* sws = nullptr;
+  AVFrame* frame = nullptr;
+  AVPacket* pkt = nullptr;
+  int64_t pts = 0;
+  // drained packets waiting for pickup
+  std::vector<std::vector<uint8_t>> out_packets;
+  std::vector<uint8_t> out_keys;
+  std::vector<int64_t> out_pts;
+  std::vector<int64_t> out_dts;
+};
+
+SCVID_API ScvidEncoder* scvid_encoder_create(int32_t width, int32_t height,
+                                             int32_t fps_num, int32_t fps_den,
+                                             const char* codec_name,
+                                             int64_t bitrate, int32_t crf,
+                                             int32_t keyint) {
+  const AVCodec* codec = avcodec_find_encoder_by_name(codec_name);
+  if (!codec) {
+    set_error(std::string("no encoder: ") + codec_name);
+    return nullptr;
+  }
+  AVCodecContext* ctx = avcodec_alloc_context3(codec);
+  ctx->width = width;
+  ctx->height = height;
+  ctx->time_base = {fps_den, fps_num};
+  ctx->framerate = {fps_num, fps_den};
+  ctx->pix_fmt = AV_PIX_FMT_YUV420P;
+  ctx->gop_size = keyint > 0 ? keyint : 16;
+  ctx->max_b_frames = 0;  // simplifies exact-seek on our own outputs
+  // SPS/PPS in extradata, not per-keyframe (matches mp4-style storage)
+  ctx->flags |= AV_CODEC_FLAG_GLOBAL_HEADER;
+  if (bitrate > 0) ctx->bit_rate = bitrate;
+  if (strcmp(codec_name, "libx264") == 0) {
+    av_opt_set(ctx->priv_data, "preset", "veryfast", 0);
+    if (bitrate <= 0)
+      av_opt_set_int(ctx->priv_data, "crf", crf > 0 ? crf : 20, 0);
+  }
+  int err = avcodec_open2(ctx, codec, nullptr);
+  if (err < 0) {
+    set_av_error("encoder open", err);
+    avcodec_free_context(&ctx);
+    return nullptr;
+  }
+  ScvidEncoder* e = new ScvidEncoder();
+  e->ctx = ctx;
+  e->frame = av_frame_alloc();
+  e->frame->format = AV_PIX_FMT_YUV420P;
+  e->frame->width = width;
+  e->frame->height = height;
+  av_frame_get_buffer(e->frame, 0);
+  e->pkt = av_packet_alloc();
+  e->sws = sws_getContext(width, height, AV_PIX_FMT_RGB24, width, height,
+                          AV_PIX_FMT_YUV420P, SWS_BILINEAR, nullptr, nullptr,
+                          nullptr);
+  return e;
+}
+
+SCVID_API void scvid_encoder_destroy(ScvidEncoder* e) {
+  if (!e) return;
+  if (e->sws) sws_freeContext(e->sws);
+  av_frame_free(&e->frame);
+  av_packet_free(&e->pkt);
+  avcodec_free_context(&e->ctx);
+  delete e;
+}
+
+SCVID_API int64_t scvid_encoder_extradata(ScvidEncoder* e, uint8_t* buf,
+                                          int64_t bufsize) {
+  if (!e->ctx->extradata) return 0;
+  if (buf && bufsize >= e->ctx->extradata_size)
+    memcpy(buf, e->ctx->extradata, e->ctx->extradata_size);
+  return e->ctx->extradata_size;
+}
+
+namespace {
+
+int encoder_drain(ScvidEncoder* e) {
+  while (true) {
+    int err = avcodec_receive_packet(e->ctx, e->pkt);
+    if (err == AVERROR(EAGAIN) || err == AVERROR_EOF) return 0;
+    if (err < 0) {
+      set_av_error("receive_packet", err);
+      return -1;
+    }
+    e->out_packets.emplace_back(e->pkt->data, e->pkt->data + e->pkt->size);
+    e->out_keys.push_back((e->pkt->flags & AV_PKT_FLAG_KEY) ? 1 : 0);
+    e->out_pts.push_back(e->pkt->pts);
+    e->out_dts.push_back(e->pkt->dts);
+    av_packet_unref(e->pkt);
+  }
+}
+
+}  // namespace
+
+// Feed n RGB24 frames (contiguous, h*w*3 each). Returns 0 / -1.
+SCVID_API int32_t scvid_encoder_feed(ScvidEncoder* e, const uint8_t* rgb,
+                                     int64_t n_frames) {
+  for (int64_t i = 0; i < n_frames; ++i) {
+    av_frame_make_writable(e->frame);
+    const uint8_t* src_planes[4] = {rgb + i * 3 * e->ctx->width * e->ctx->height,
+                                    nullptr, nullptr, nullptr};
+    int src_stride[4] = {3 * e->ctx->width, 0, 0, 0};
+    sws_scale(e->sws, src_planes, src_stride, 0, e->ctx->height,
+              e->frame->data, e->frame->linesize);
+    e->frame->pts = e->pts++;
+    int err = avcodec_send_frame(e->ctx, e->frame);
+    if (err < 0) {
+      set_av_error("send_frame", err);
+      return -1;
+    }
+    if (encoder_drain(e) < 0) return -1;
+  }
+  return 0;
+}
+
+SCVID_API int32_t scvid_encoder_flush(ScvidEncoder* e) {
+  int err = avcodec_send_frame(e->ctx, nullptr);
+  if (err < 0 && err != AVERROR_EOF) {
+    set_av_error("flush", err);
+    return -1;
+  }
+  return encoder_drain(e);
+}
+
+// Packet pickup: sizes first, then payload copy-out; clears the queue.
+SCVID_API int64_t scvid_encoder_pending(ScvidEncoder* e) {
+  return (int64_t)e->out_packets.size();
+}
+
+SCVID_API int64_t scvid_encoder_pending_bytes(ScvidEncoder* e) {
+  int64_t total = 0;
+  for (auto& p : e->out_packets) total += (int64_t)p.size();
+  return total;
+}
+
+SCVID_API void scvid_encoder_take(ScvidEncoder* e, uint8_t* data,
+                                  uint64_t* sizes, uint8_t* keys,
+                                  int64_t* pts, int64_t* dts) {
+  uint64_t off = 0;
+  for (size_t i = 0; i < e->out_packets.size(); ++i) {
+    auto& p = e->out_packets[i];
+    memcpy(data + off, p.data(), p.size());
+    sizes[i] = p.size();
+    keys[i] = e->out_keys[i];
+    pts[i] = e->out_pts[i];
+    dts[i] = e->out_dts[i];
+    off += p.size();
+  }
+  e->out_packets.clear();
+  e->out_keys.clear();
+  e->out_pts.clear();
+  e->out_dts.clear();
+}
+
+// ---------------------------------------------------------------------------
+// MP4 export (reference storage.py:365 save_mp4)
+// ---------------------------------------------------------------------------
+
+// pts/dts are expressed in time base tb_num/tb_den (pass 1/fps_num-style
+// frame numbering as tb = fps_den/fps_num).
+SCVID_API int32_t scvid_mp4_write(const char* path, int32_t width,
+                                  int32_t height, int32_t fps_num,
+                                  int32_t fps_den, int32_t tb_num,
+                                  int32_t tb_den, const char* codec_name,
+                                  const uint8_t* extradata,
+                                  int64_t extradata_size,
+                                  const uint8_t* packets,
+                                  const uint64_t* pkt_sizes,
+                                  const uint8_t* keys, const int64_t* pts,
+                                  const int64_t* dts, int64_t n_packets) {
+  AVFormatContext* fmt = nullptr;
+  int err = avformat_alloc_output_context2(&fmt, nullptr, "mp4", path);
+  if (err < 0 || !fmt) {
+    set_av_error("alloc mp4 muxer", err);
+    return -1;
+  }
+  const AVCodecDescriptor* desc = avcodec_descriptor_get_by_name(codec_name);
+  AVStream* stream = avformat_new_stream(fmt, nullptr);
+  stream->codecpar->codec_type = AVMEDIA_TYPE_VIDEO;
+  stream->codecpar->codec_id = desc ? desc->id : AV_CODEC_ID_H264;
+  stream->codecpar->width = width;
+  stream->codecpar->height = height;
+  if (extradata_size > 0) {
+    stream->codecpar->extradata = (uint8_t*)av_mallocz(
+        extradata_size + AV_INPUT_BUFFER_PADDING_SIZE);
+    memcpy(stream->codecpar->extradata, extradata, extradata_size);
+    stream->codecpar->extradata_size = (int)extradata_size;
+  }
+  stream->time_base = {fps_den, fps_num};
+  err = avio_open(&fmt->pb, path, AVIO_FLAG_WRITE);
+  if (err < 0) {
+    set_av_error("avio_open", err);
+    avformat_free_context(fmt);
+    return -1;
+  }
+  err = avformat_write_header(fmt, nullptr);
+  if (err < 0) {
+    set_av_error("write_header", err);
+    avio_closep(&fmt->pb);
+    avformat_free_context(fmt);
+    return -1;
+  }
+  AVPacket* pkt = av_packet_alloc();
+  const uint8_t* cur = packets;
+  for (int64_t i = 0; i < n_packets; ++i) {
+    pkt->data = const_cast<uint8_t*>(cur);
+    pkt->size = (int)pkt_sizes[i];
+    pkt->pts = av_rescale_q(pts[i], {tb_num, tb_den}, stream->time_base);
+    pkt->dts = av_rescale_q(dts[i], {tb_num, tb_den}, stream->time_base);
+    pkt->flags = keys[i] ? AV_PKT_FLAG_KEY : 0;
+    pkt->stream_index = 0;
+    cur += pkt_sizes[i];
+    err = av_interleaved_write_frame(fmt, pkt);
+    if (err < 0) {
+      set_av_error("write_frame", err);
+      av_packet_free(&pkt);
+      avio_closep(&fmt->pb);
+      avformat_free_context(fmt);
+      return -1;
+    }
+  }
+  av_packet_free(&pkt);
+  av_write_trailer(fmt);
+  avio_closep(&fmt->pb);
+  avformat_free_context(fmt);
+  return 0;
+}

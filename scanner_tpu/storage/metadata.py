@@ -121,8 +121,13 @@ class VideoDescriptor:
     sample_sizes: np.ndarray = field(default_factory=lambda: np.zeros(0, np.uint64))
     # indices (into decode order) of keyframe samples, ascending
     keyframe_indices: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int64))
-    # pts per sample, used to map decode order -> display order
+    # pts/dts per sample (source time base), decode order; pts maps decode
+    # order -> display order, dts is needed to remux B-frame streams
     sample_pts: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int64))
+    sample_dts: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int64))
+    # time base of pts/dts as a rational
+    tb_num: int = 1
+    tb_den: int = 30
     # path of the packet-stream blob this index describes; "" = column item
     # file itself (normal ingest), otherwise an absolute path (in-place ingest
     # of an external mp4 keeps data where it is - reference ingest.cpp:382)
@@ -139,6 +144,8 @@ class VideoDescriptor:
             "sample_sizes": np.asarray(self.sample_sizes, np.uint64),
             "keyframe_indices": np.asarray(self.keyframe_indices, np.int64),
             "sample_pts": np.asarray(self.sample_pts, np.int64),
+            "sample_dts": np.asarray(self.sample_dts, np.int64),
+            "tb_num": self.tb_num, "tb_den": self.tb_den,
             "data_path": self.data_path,
         }
 

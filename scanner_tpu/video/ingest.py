@@ -1,0 +1,204 @@
+"""Video ingest into the database, mp4 export, and synthetic test clips.
+
+Capability parity: reference ingest path (ingest.cpp:867 ingest_videos,
+parse_and_write_video:175, parse_video_inplace:382) and storage.py save_mp4.
+
+An ingested video becomes a committed table with columns
+['index', 'frame']: 'index' stores the row number (8-byte LE) and 'frame'
+is a VIDEO column whose single item is the demuxed packet stream, described
+by a VideoDescriptor side file.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import tempfile
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common import ScannerException
+from ..storage import items
+from ..storage import metadata as md
+from ..storage.backend import PosixStorage
+from ..storage.database import Database
+from . import lib
+from .automata import DecoderAutomata
+
+
+def ingest_videos(db: Database, named_paths: Sequence[Tuple[str, str]],
+                  inplace: bool = False) -> List[md.TableDescriptor]:
+    """Ingest videos as named tables. inplace=True indexes the original file
+    without copying packet data (reference ingest.cpp:382)."""
+    out = []
+    for name, path in named_paths:
+        out.append(_ingest_one(db, name, path, inplace))
+    return out
+
+
+def _ingest_one(db: Database, name: str, path: str,
+                inplace: bool) -> md.TableDescriptor:
+    if db.has_table(name):
+        raise ScannerException(f"table already exists: {name}")
+    cols = [md.ColumnDescriptor("index", md.ColumnType.BYTES),
+            md.ColumnDescriptor("frame", md.ColumnType.VIDEO)]
+    if inplace:
+        vd = lib.ingest_file(path, None)
+        desc = db.create_table(name, cols, end_rows=[vd.num_frames])
+    else:
+        desc = None
+        tmp_path = None
+        try:
+            if isinstance(db.backend, PosixStorage):
+                # write the packet stream straight into storage
+                desc = db.create_table(name, cols, end_rows=[0])
+                item_rel = md.column_item_path(desc.id, "frame", 0)
+                target = db.backend.local_path(item_rel)
+                os.makedirs(os.path.dirname(target), exist_ok=True)
+                vd = lib.ingest_file(path, target)
+            else:
+                fd, tmp_path = tempfile.mkstemp(suffix=".pkts")
+                os.close(fd)
+                vd = lib.ingest_file(path, tmp_path)
+                desc = db.create_table(name, cols, end_rows=[0])
+                with open(tmp_path, "rb") as f:
+                    db.backend.write(md.column_item_path(desc.id, "frame", 0),
+                                     f.read())
+        except Exception:
+            # don't leave an orphaned uncommitted table squatting the name
+            if desc is not None:
+                db.delete_table(name)
+            raise
+        finally:
+            if tmp_path:
+                os.unlink(tmp_path)
+        desc.end_rows = [vd.num_frames]
+        db.write_table_descriptor(desc)
+    db.backend.write(md.video_meta_path(desc.id, "frame", 0), vd.serialize())
+    # index column: row number, one item
+    idx_rows = [struct.pack("<q", i) for i in range(vd.num_frames)]
+    items.write_item(db.backend, md.column_item_path(desc.id, "index", 0),
+                     idx_rows)
+    db.commit_table(desc.id)
+    return db.table_descriptor(desc.id)
+
+
+def load_video_meta(db: Database, table, column: str = "frame",
+                    item: int = 0) -> md.VideoDescriptor:
+    desc = db.table_descriptor(table)
+    return md.VideoDescriptor.deserialize(
+        db.backend.read(md.video_meta_path(desc.id, column, item)))
+
+
+def open_automata(db: Database, table, column: str = "frame",
+                  n_threads: int = 1) -> DecoderAutomata:
+    desc = db.table_descriptor(table)
+    vd = load_video_meta(db, table, column)
+    return DecoderAutomata(db.backend, vd,
+                           md.column_item_path(desc.id, column, 0),
+                           n_threads=n_threads)
+
+
+def load_frames(db: Database, table, rows: Sequence[int],
+                column: str = "frame") -> np.ndarray:
+    """Client-side exact frame read (reference storage.py NamedVideoStream
+    .load / as_hwang)."""
+    auto = open_automata(db, table, column)
+    try:
+        return auto.get_frames(rows)
+    finally:
+        auto.close()
+
+
+def export_mp4(db: Database, table, out_path: str,
+               column: str = "frame") -> None:
+    """Remux a stored video column to an .mp4 without re-encoding
+    (reference storage.py:365 save_mp4)."""
+    desc = db.table_descriptor(table)
+    data_parts = []
+    sizes_l, keys_l, pts_l, dts_l = [], [], [], []
+    vd0: Optional[md.VideoDescriptor] = None
+    pts_base = 0
+    for item in range(len(desc.end_rows)):
+        vd = md.VideoDescriptor.deserialize(
+            db.backend.read(md.video_meta_path(desc.id, column, item)))
+        if vd0 is None:
+            vd0 = vd
+        elif (vd.tb_num, vd.tb_den) != (vd0.tb_num, vd0.tb_den):
+            raise ScannerException(
+                "export_mp4: items have differing time bases")
+        if vd.data_path:
+            with open(vd.data_path, "rb") as f:
+                raw = f.read()
+            for o, s in zip(vd.sample_offsets, vd.sample_sizes):
+                data_parts.append(raw[int(o):int(o) + int(s)])
+        else:
+            data_parts.append(db.backend.read(
+                md.column_item_path(desc.id, column, item)))
+        sizes_l.append(np.asarray(vd.sample_sizes, np.uint64))
+        kf = np.zeros(vd.num_frames, np.uint8)
+        kf[np.asarray(vd.keyframe_indices, np.int64)] = 1
+        keys_l.append(kf)
+        # shift each item's timestamps so concatenated items play back to
+        # back (multi-item tables are always this library's own encodes,
+        # which stamp frame-number pts starting at 0)
+        pts = np.asarray(vd.sample_pts, np.int64)
+        dts = np.asarray(vd.sample_dts, np.int64)
+        shift = pts_base - int(pts.min())
+        pts_l.append(pts + shift)
+        dts_l.append(dts + shift)
+        pts_base = int(pts_l[-1].max()) + _pts_step(vd)
+    assert vd0 is not None
+    lib.write_mp4(out_path, vd0.width, vd0.height, vd0.fps or 30.0,
+                  vd0.codec, vd0.extradata, b"".join(data_parts),
+                  np.concatenate(sizes_l), np.concatenate(keys_l),
+                  np.concatenate(pts_l), np.concatenate(dts_l),
+                  tb=(vd0.tb_num, vd0.tb_den))
+
+
+def _pts_step(vd: md.VideoDescriptor) -> int:
+    """Typical pts increment between consecutive display frames."""
+    pts = np.sort(np.asarray(vd.sample_pts, np.int64))
+    if len(pts) < 2:
+        return 1
+    diffs = np.diff(pts)
+    diffs = diffs[diffs > 0]
+    return int(np.median(diffs)) if len(diffs) else 1
+
+
+# ---------------------------------------------------------------------------
+# Synthetic clips for tests/benchmarks (replaces the reference's downloaded
+# GCS fixtures, py_test.py:81 — this environment has no network egress)
+# ---------------------------------------------------------------------------
+
+def frame_pattern(i: int, height: int, width: int) -> np.ndarray:
+    """Deterministic per-frame pattern: R channel encodes i%14 with 16-unit
+    spacing, wide enough to survive lossy H.264 quantization."""
+    f = np.zeros((height, width, 3), np.uint8)
+    f[:, :, 0] = (i * 16) % 224
+    f[:, :, 1] = np.linspace(0, 239, width, dtype=np.uint8)[None, :]
+    sq = max(4, height // 8)
+    x = (i * 5) % max(1, width - sq)
+    f[:sq, x:x + sq, 2] = 230
+    return f
+
+
+def frame_pattern_id(frame: np.ndarray) -> int:
+    """Recover i%14 from a decoded pattern frame (R is ~(i*16)%224)."""
+    r = float(frame[..., 0].mean())
+    return int(round(r / 16.0)) % 14
+
+
+def synthesize_video(path: str, num_frames: int = 90, width: int = 128,
+                     height: int = 96, fps: float = 24.0,
+                     keyint: int = 12) -> None:
+    """Encode a deterministic test clip to an .mp4 with libx264."""
+    enc = lib.Encoder(width, height, fps=fps, keyint=keyint, crf=18)
+    for i in range(num_frames):
+        enc.feed(frame_pattern(i, height, width))
+    enc.flush()
+    data, sizes, keys, pts, dts = enc.take_packets()
+    lib.write_mp4(path, width, height, fps, "h264", enc.extradata, data,
+                  sizes, keys, pts, dts)
+    enc.close()

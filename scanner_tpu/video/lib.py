@@ -1,0 +1,294 @@
+"""ctypes bindings for libscvid (cpp/scvid.cpp).
+
+Every call into the library releases the GIL, so one Python process can run
+many decoder handles truly in parallel — the replacement for the reference's
+decoder thread pool (decoder_automata.cpp feeder threads, worker.cpp:1631
+decoder_cpus).
+"""
+
+from __future__ import annotations
+
+import ctypes as C
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common import ScannerException
+from ..storage.metadata import VideoDescriptor
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libscvid.so")
+
+
+class _Index(C.Structure):
+    _fields_ = [
+        ("width", C.c_int32),
+        ("height", C.c_int32),
+        ("fps", C.c_double),
+        ("num_samples", C.c_int64),
+        ("codec", C.c_char * 32),
+        ("tb_num", C.c_int32),
+        ("tb_den", C.c_int32),
+        ("sample_offsets", C.POINTER(C.c_uint64)),
+        ("sample_sizes", C.POINTER(C.c_uint64)),
+        ("sample_pts", C.POINTER(C.c_int64)),
+        ("sample_dts", C.POINTER(C.c_int64)),
+        ("keyflags", C.POINTER(C.c_uint8)),
+        ("extradata", C.POINTER(C.c_uint8)),
+        ("extradata_size", C.c_int64),
+    ]
+
+
+_lib = None
+
+
+def get_lib():
+    global _lib
+    if _lib is None:
+        if not os.path.exists(_LIB_PATH):
+            raise ScannerException(
+                f"libscvid.so not built; run `make -C cpp` (expected at "
+                f"{_LIB_PATH})")
+        lib = C.CDLL(_LIB_PATH)
+        lib.scvid_last_error.restype = C.c_char_p
+        lib.scvid_set_log_level.argtypes = [C.c_int]
+        lib.scvid_ingest.restype = C.POINTER(_Index)
+        lib.scvid_ingest.argtypes = [C.c_char_p, C.c_char_p]
+        lib.scvid_index_free.argtypes = [C.POINTER(_Index)]
+        lib.scvid_decoder_create.restype = C.c_void_p
+        lib.scvid_decoder_create.argtypes = [
+            C.c_char_p, C.c_char_p, C.c_int64, C.c_int32, C.c_int32, C.c_int32]
+        lib.scvid_decoder_destroy.argtypes = [C.c_void_p]
+        lib.scvid_decoder_reset.argtypes = [C.c_void_p]
+        lib.scvid_decode_run.restype = C.c_int64
+        lib.scvid_decode_run.argtypes = [
+            C.c_void_p, C.c_char_p, C.POINTER(C.c_uint64), C.c_int64,
+            C.c_char_p, C.c_int64, C.c_int32, C.c_void_p, C.c_int64,
+            C.POINTER(C.c_int64)]
+        lib.scvid_decoder_emitted.restype = C.c_int64
+        lib.scvid_decoder_emitted.argtypes = [C.c_void_p]
+        lib.scvid_encoder_create.restype = C.c_void_p
+        lib.scvid_encoder_create.argtypes = [
+            C.c_int32, C.c_int32, C.c_int32, C.c_int32, C.c_char_p,
+            C.c_int64, C.c_int32, C.c_int32]
+        lib.scvid_encoder_destroy.argtypes = [C.c_void_p]
+        lib.scvid_encoder_extradata.restype = C.c_int64
+        lib.scvid_encoder_extradata.argtypes = [C.c_void_p, C.c_void_p,
+                                                C.c_int64]
+        lib.scvid_encoder_feed.restype = C.c_int32
+        lib.scvid_encoder_feed.argtypes = [C.c_void_p, C.c_void_p, C.c_int64]
+        lib.scvid_encoder_flush.restype = C.c_int32
+        lib.scvid_encoder_flush.argtypes = [C.c_void_p]
+        lib.scvid_encoder_pending.restype = C.c_int64
+        lib.scvid_encoder_pending.argtypes = [C.c_void_p]
+        lib.scvid_encoder_pending_bytes.restype = C.c_int64
+        lib.scvid_encoder_pending_bytes.argtypes = [C.c_void_p]
+        lib.scvid_encoder_take.argtypes = [
+            C.c_void_p, C.c_void_p, C.POINTER(C.c_uint64), C.c_void_p,
+            C.POINTER(C.c_int64), C.POINTER(C.c_int64)]
+        lib.scvid_mp4_write.restype = C.c_int32
+        lib.scvid_mp4_write.argtypes = [
+            C.c_char_p, C.c_int32, C.c_int32, C.c_int32, C.c_int32,
+            C.c_int32, C.c_int32,
+            C.c_char_p, C.c_char_p, C.c_int64, C.c_char_p,
+            C.POINTER(C.c_uint64), C.c_char_p, C.POINTER(C.c_int64),
+            C.POINTER(C.c_int64), C.c_int64]
+        lib.scvid_set_log_level(16)  # AV_LOG_ERROR
+        _lib = lib
+    return _lib
+
+
+def _err() -> str:
+    return get_lib().scvid_last_error().decode("utf-8", "replace")
+
+
+def ingest_file(path: str, out_packets_path: Optional[str]
+                ) -> VideoDescriptor:
+    """Demux a video file into (packet stream, index).
+
+    out_packets_path=None performs in-place ingest: the index references the
+    original container (reference ingest.cpp:382 parse_video_inplace).
+    """
+    lib = get_lib()
+    idx_p = lib.scvid_ingest(
+        path.encode(), out_packets_path.encode() if out_packets_path else None)
+    if not idx_p:
+        raise ScannerException(f"ingest failed for {path}: {_err()}")
+    idx = idx_p.contents
+    n = idx.num_samples
+    try:
+        vd = VideoDescriptor(
+            width=idx.width, height=idx.height, fps=idx.fps, num_frames=n,
+            codec=idx.codec.decode(),
+            extradata=bytes(
+                C.cast(idx.extradata,
+                       C.POINTER(C.c_uint8 * idx.extradata_size)).contents)
+            if idx.extradata_size > 0 else b"",
+            sample_offsets=np.ctypeslib.as_array(idx.sample_offsets,
+                                                 (n,)).copy(),
+            sample_sizes=np.ctypeslib.as_array(idx.sample_sizes, (n,)).copy(),
+            keyframe_indices=np.nonzero(
+                np.ctypeslib.as_array(idx.keyflags, (n,)))[0].astype(np.int64),
+            sample_pts=np.ctypeslib.as_array(idx.sample_pts, (n,)).copy(),
+            sample_dts=np.ctypeslib.as_array(idx.sample_dts, (n,)).copy(),
+            tb_num=idx.tb_num, tb_den=idx.tb_den,
+            data_path=os.path.abspath(path) if out_packets_path is None else "")
+    finally:
+        lib.scvid_index_free(idx_p)
+    if len(vd.keyframe_indices) == 0 or vd.keyframe_indices[0] != 0:
+        raise ScannerException(
+            f"{path}: stream does not start with a keyframe")
+    return vd
+
+
+class Decoder:
+    """One hardware-thread decode pipeline. Not thread-safe per-instance;
+    use one per worker thread."""
+
+    def __init__(self, codec: str, extradata: bytes, width: int, height: int,
+                 n_threads: int = 1):
+        self._lib = get_lib()
+        self._h = self._lib.scvid_decoder_create(
+            codec.encode(), extradata, len(extradata), width, height,
+            n_threads)
+        if not self._h:
+            raise ScannerException(f"decoder create failed: {_err()}")
+
+    def close(self):
+        if self._h:
+            self._lib.scvid_decoder_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def reset(self):
+        self._lib.scvid_decoder_reset(self._h)
+
+    def decode_run(self, packets: bytes, sizes: np.ndarray,
+                   wanted: np.ndarray, out: np.ndarray,
+                   flush: bool = True) -> Tuple[int, int, int]:
+        """Decode a packet run; write frames selected by `wanted` (uint8 mask
+        over emitted frames since last reset) into `out` (flat uint8).
+        Returns (n_written, height, width)."""
+        sizes = np.ascontiguousarray(sizes, dtype=np.uint64)
+        wanted = np.ascontiguousarray(wanted, dtype=np.uint8)
+        assert out.dtype == np.uint8 and out.flags["C_CONTIGUOUS"]
+        dims = (C.c_int64 * 2)()
+        n = self._lib.scvid_decode_run(
+            self._h, packets,
+            sizes.ctypes.data_as(C.POINTER(C.c_uint64)), len(sizes),
+            wanted.ctypes.data_as(C.c_char_p), len(wanted),
+            1 if flush else 0,
+            out.ctypes.data_as(C.c_void_p), out.nbytes, dims)
+        if n < 0:
+            raise ScannerException(f"decode failed: {_err()}")
+        return int(n), int(dims[0]), int(dims[1])
+
+
+class Encoder:
+    def __init__(self, width: int, height: int, fps: float = 30.0,
+                 codec: str = "libx264", bitrate: int = 0, crf: int = 20,
+                 keyint: int = 16):
+        self._lib = get_lib()
+        fps_num, fps_den = _fps_to_rational(fps)
+        self.width, self.height = width, height
+        self.fps_num, self.fps_den = fps_num, fps_den
+        self._h = self._lib.scvid_encoder_create(
+            width, height, fps_num, fps_den, codec.encode(), bitrate, crf,
+            keyint)
+        if not self._h:
+            raise ScannerException(f"encoder create failed: {_err()}")
+
+    def close(self):
+        if self._h:
+            self._lib.scvid_encoder_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    @property
+    def extradata(self) -> bytes:
+        n = self._lib.scvid_encoder_extradata(self._h, None, 0)
+        if n == 0:
+            return b""
+        buf = C.create_string_buffer(n)
+        self._lib.scvid_encoder_extradata(self._h, buf, n)
+        return buf.raw
+
+    def feed(self, frames: np.ndarray) -> None:
+        """frames: uint8 array (n, h, w, 3) or (h, w, 3)."""
+        frames = np.ascontiguousarray(frames, dtype=np.uint8)
+        if frames.ndim == 3:
+            frames = frames[None]
+        if frames.shape[1:] != (self.height, self.width, 3):
+            raise ScannerException(
+                f"encoder expects {self.height}x{self.width}x3 frames, got "
+                f"{frames.shape[1:]}")
+        n = frames.shape[0]
+        if self._lib.scvid_encoder_feed(
+                self._h, frames.ctypes.data_as(C.c_void_p), n) < 0:
+            raise ScannerException(f"encode failed: {_err()}")
+
+    def flush(self) -> None:
+        if self._lib.scvid_encoder_flush(self._h) < 0:
+            raise ScannerException(f"encode flush failed: {_err()}")
+
+    def take_packets(self):
+        """Returns (data: bytes, sizes, keys, pts, dts) and clears the
+        internal queue."""
+        n = self._lib.scvid_encoder_pending(self._h)
+        if n == 0:
+            return b"", np.zeros(0, np.uint64), np.zeros(0, np.uint8), \
+                np.zeros(0, np.int64), np.zeros(0, np.int64)
+        total = self._lib.scvid_encoder_pending_bytes(self._h)
+        data = np.empty(total, np.uint8)
+        sizes = np.empty(n, np.uint64)
+        keys = np.empty(n, np.uint8)
+        pts = np.empty(n, np.int64)
+        dts = np.empty(n, np.int64)
+        self._lib.scvid_encoder_take(
+            self._h, data.ctypes.data_as(C.c_void_p),
+            sizes.ctypes.data_as(C.POINTER(C.c_uint64)),
+            keys.ctypes.data_as(C.c_void_p),
+            pts.ctypes.data_as(C.POINTER(C.c_int64)),
+            dts.ctypes.data_as(C.POINTER(C.c_int64)))
+        return data.tobytes(), sizes, keys, pts, dts
+
+
+def _fps_to_rational(fps: float) -> Tuple[int, int]:
+    if abs(fps - round(fps)) < 1e-6:
+        return int(round(fps)), 1
+    # NTSC-style rates
+    return int(round(fps * 1001)), 1001
+
+
+def write_mp4(path: str, width: int, height: int, fps: float, codec: str,
+              extradata: bytes, packets: bytes, sizes: np.ndarray,
+              keys: np.ndarray, pts: np.ndarray, dts: np.ndarray,
+              tb: Optional[Tuple[int, int]] = None) -> None:
+    """tb: (num, den) time base of pts/dts; default = frame numbering at
+    `fps` (matches this library's Encoder output)."""
+    lib = get_lib()
+    fps_num, fps_den = _fps_to_rational(fps)
+    tb_num, tb_den = tb if tb is not None else (fps_den, fps_num)
+    sizes = np.ascontiguousarray(sizes, np.uint64)
+    keys = np.ascontiguousarray(keys, np.uint8)
+    pts = np.ascontiguousarray(pts, np.int64)
+    dts = np.ascontiguousarray(dts, np.int64)
+    r = lib.scvid_mp4_write(
+        path.encode(), width, height, fps_num, fps_den, tb_num, tb_den,
+        codec.encode(), extradata, len(extradata), packets,
+        sizes.ctypes.data_as(C.POINTER(C.c_uint64)),
+        keys.ctypes.data_as(C.c_char_p),
+        pts.ctypes.data_as(C.POINTER(C.c_int64)),
+        dts.ctypes.data_as(C.POINTER(C.c_int64)), len(sizes))
+    if r < 0:
+        raise ScannerException(f"mp4 write failed: {_err()}")

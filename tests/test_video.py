@@ -1,0 +1,124 @@
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from scanner_tpu.common import ScannerException
+from scanner_tpu import video as scv
+from scanner_tpu.video.automata import VideoIndex
+
+
+def expected_id(r, h, w):
+    return scv.frame_pattern_id(scv.frame_pattern(r, h, w))
+
+
+@pytest.fixture(scope="module")
+def clip(tmp_path_factory):
+    p = str(tmp_path_factory.mktemp("vids") / "clip.mp4")
+    scv.synthesize_video(p, num_frames=90, width=128, height=96, fps=24,
+                         keyint=12)
+    return p
+
+
+def test_synthesize_and_index(clip):
+    vd = scv.ingest_file(clip, None)  # in-place index of the mp4
+    assert vd.num_frames == 90
+    assert vd.width == 128 and vd.height == 96
+    assert vd.codec == "h264"
+    assert len(vd.extradata) > 0
+    # keyint=12 -> keyframes at 0,12,24,...
+    assert vd.keyframe_indices[0] == 0
+    assert len(vd.keyframe_indices) >= 90 // 12
+
+
+def test_ingest_and_exact_decode(tmp_db, clip):
+    scv.ingest_videos(tmp_db, [("clip", clip)])
+    desc = tmp_db.table_descriptor("clip")
+    assert desc.num_rows == 90
+    assert desc.column_names() == ["index", "frame"]
+    assert tmp_db.table_is_committed("clip")
+    # index column contents
+    idx = list(tmp_db.load_column("clip", "index"))
+    assert struct.unpack("<q", idx[33])[0] == 33
+
+    # exact frame reads across keyframe boundaries, unsorted with dup
+    rows = [0, 13, 12, 40, 40, 89]
+    frames = scv.load_frames(tmp_db, "clip", rows)
+    assert frames.shape == (6, 96, 128, 3)
+    for got, r in zip(frames, rows):
+        assert scv.frame_pattern_id(got) == expected_id(r, 96, 128), \
+            f"frame {r} mismatch"
+    assert (frames[3] == frames[4]).all()
+
+
+def test_inplace_ingest_decode(tmp_db, clip):
+    scv.ingest_videos(tmp_db, [("clip_inplace", clip)], inplace=True)
+    frames = scv.load_frames(tmp_db, "clip_inplace", [5, 60])
+    for got, r in zip(frames, [5, 60]):
+        assert scv.frame_pattern_id(got) == expected_id(r, 96, 128)
+
+
+def test_full_sequential_decode(tmp_db, clip):
+    scv.ingest_videos(tmp_db, [("clip2", clip)])
+    frames = scv.load_frames(tmp_db, "clip2", list(range(90)))
+    assert frames.shape == (90, 96, 128, 3)
+    ids = [scv.frame_pattern_id(f) for f in frames]
+    assert ids == [expected_id(r, 96, 128) for r in range(90)]
+
+
+def test_plan_minimality(clip):
+    vd = scv.ingest_file(clip, None)
+    index = VideoIndex(vd)
+    kfs = list(vd.keyframe_indices)
+
+    def governing(r):
+        return max(k for k in kfs if k <= r)
+
+    # single frame mid-GOP: one run from its governing keyframe to the frame
+    runs = index.plan([15])
+    assert len(runs) == 1
+    assert runs[0].start_dec == governing(15) and runs[0].end_dec == 15
+    # distant frames: separate runs (no decode-through across the gap)
+    runs = index.plan([0, 80], decode_through=4)
+    assert len(runs) == 2
+    assert runs[0].start_dec == 0 and runs[0].end_dec == 0
+    assert runs[1].start_dec == governing(80)
+    # near frames merge into one run
+    runs = index.plan([10, 14], decode_through=64)
+    assert len(runs) == 1
+    assert runs[0].end_dec == 14
+
+
+def test_out_of_range_row(tmp_db, clip):
+    scv.ingest_videos(tmp_db, [("clip3", clip)])
+    with pytest.raises(ScannerException):
+        scv.load_frames(tmp_db, "clip3", [90])
+
+
+def test_export_mp4_roundtrip(tmp_db, clip, tmp_path):
+    scv.ingest_videos(tmp_db, [("clip4", clip)])
+    out = str(tmp_path / "out.mp4")
+    scv.export_mp4(tmp_db, "clip4", out)
+    assert os.path.getsize(out) > 1000
+    vd = scv.ingest_file(out, None)
+    assert vd.num_frames == 90
+
+
+def test_encoder_decoder_roundtrip_lossless_geometry():
+    enc = scv.Encoder(64, 48, fps=30, keyint=8)
+    frames = np.stack([scv.frame_pattern(i, 48, 64) for i in range(20)])
+    enc.feed(frames)
+    enc.flush()
+    data, sizes, keys, pts, dts = enc.take_packets()
+    assert len(sizes) == 20
+    assert keys[0] == 1
+    dec = scv.Decoder("h264", enc.extradata, 64, 48)
+    out = np.empty(20 * 48 * 64 * 3, np.uint8)
+    n, h, w = dec.decode_run(data, sizes, np.ones(20, np.uint8), out)
+    assert (n, h, w) == (20, 48, 64)
+    out = out.reshape(20, 48, 64, 3)
+    for i in range(20):
+        assert scv.frame_pattern_id(out[i]) == expected_id(i, 48, 64)
+    dec.close()
+    enc.close()
