@@ -1,0 +1,338 @@
+"""Row-math tests for samplers and DAG analysis.
+
+These encode the reference's executable spec (tests/py_test.py) at the
+row-derivation level, before the engine exists: the same cases are re-run
+end-to-end in test_engine.py.
+"""
+
+import numpy as np
+import pytest
+
+from scanner_tpu.common import (DeviceType, FrameType, GraphException,
+                                SliceList)
+from scanner_tpu.graph import analysis as A
+from scanner_tpu.graph import ops as O
+from scanner_tpu.graph import samplers as S
+from scanner_tpu.graph.streams_dsl import (IOGenerator, StreamsGenerator,
+                                           TaskPartitioner)
+from typing import Any
+
+io = IOGenerator()
+streams = StreamsGenerator()
+partitioner = TaskPartitioner()
+ops = O.OpGenerator()
+
+
+class FakeStream:
+    is_video = False
+
+    def __init__(self, n):
+        self.n = n
+
+
+@O.register_op(name="Flow", device=DeviceType.CPU, stencil=[-1, 0])
+class _Flow(O.Kernel):
+    def execute(self, frame: FrameType) -> bytes:  # pragma: no cover
+        return b""
+
+
+@O.register_op(name="Incr", bounded_state=3)
+class _Incr(O.Kernel):
+    def execute(self, ignore: bytes) -> bytes:  # pragma: no cover
+        return b""
+
+
+@O.register_op(name="IncrU", unbounded_state=True)
+class _IncrU(O.Kernel):
+    def execute(self, ignore: bytes) -> bytes:  # pragma: no cover
+        return b""
+
+
+@O.register_op(name="Pass")
+class _Pass(O.Kernel):
+    def execute(self, x: bytes) -> bytes:  # pragma: no cover
+        return b""
+
+
+# ---------------------------------------------------------------------------
+# samplers
+# ---------------------------------------------------------------------------
+
+def brute_downstream(sampler, num_upstream):
+    """Downstream domain via upstream_rows inversion, for cross-checking."""
+    n_down = sampler.num_downstream(num_upstream)
+    return n_down
+
+
+@pytest.mark.parametrize("stride,n", [(8, 720), (3, 10), (1, 5), (7, 7)])
+def test_strided_sampler(stride, n):
+    s = S.StridedSampler(stride)
+    assert s.num_downstream(n) == -(-n // stride)
+    down = np.arange(s.num_downstream(n))
+    up = s.upstream_rows(down)
+    assert (up == down * stride).all()
+    d2, mapping = s.downstream_map(up)
+    assert (d2 == down).all()
+    assert (mapping == np.arange(len(up))).all()
+
+
+def test_strided_ranges_sampler():
+    s = S.StridedRangesSampler([0, 100], [11, 201], 1)
+    assert s.num_downstream(720) == 11 + 101
+    assert list(s.upstream_rows([0, 10, 11, 111])) == [0, 10, 100, 200]
+    # inputs are always rows previously requested via upstream_rows, i.e.
+    # within the ranges (the reference drops between-range rows the same way)
+    down, mapping = s.downstream_map(np.array([0, 5, 100, 150]))
+    assert list(down) == [0, 5, 11, 61]
+    assert list(mapping) == [0, 1, 2, 3]
+    # strided variant
+    s = S.StridedRangesSampler([0], [300], 10)
+    assert s.num_downstream(720) == 30
+    assert list(s.upstream_rows([0, 1, 29])) == [0, 10, 290]
+    # partial coverage sizing
+    s = S.StridedRangesSampler([0, 100], [50, 200], 1)
+    assert s.num_downstream(150) == 50 + 50
+    assert s.num_downstream(40) == 40
+
+
+def test_gather_sampler():
+    s = S.GatherSampler([0, 150, 377, 500])
+    assert s.num_downstream(720) == 4
+    assert s.num_downstream(300) == 2
+    assert list(s.upstream_rows([0, 2])) == [0, 377]
+    down, mapping = s.downstream_map(np.array([0, 150, 377, 500]))
+    assert list(down) == [0, 1, 2, 3]
+
+
+def test_space_samplers():
+    s = S.SpaceNullSampler(8)
+    assert s.num_downstream(90) == 720
+    assert list(s.upstream_rows([0, 7, 8, 63])) == [0, 1, 7]
+    down, mapping = s.downstream_map(np.array([0, 2]))
+    assert list(down[:3]) == [0, 1, 2]
+    assert mapping[0] == 0 and mapping[1] == -1
+    assert mapping[8] == 1 and mapping[9] == -1
+
+    r = S.SpaceRepeatSampler(8)
+    down, mapping = r.downstream_map(np.array([3]))
+    assert list(down) == list(range(24, 32))
+    assert (mapping == 0).all()
+
+
+def test_partitioners():
+    p = S.StridedPartitioner(720, 1, 50)
+    assert p.total_groups() == 15
+    assert list(p.group_at(0)) == list(range(50))
+    assert list(p.group_at(14)) == list(range(700, 720))
+    assert p.offset_at_group(2) == 100
+
+    p = S.StridedRangePartitioner(720, [0, 5, 15], [15, 25, 35], 1)
+    assert p.total_groups() == 3
+    assert list(p.group_at(1)) == list(range(5, 25))
+
+    p = S.GatherPartitioner(720, [[0, 5], [7]])
+    assert p.rows_per_group() == [2, 1]
+
+    with pytest.raises(GraphException):
+        S.StridedRangePartitioner(720, [0], [721], 1)
+
+
+# ---------------------------------------------------------------------------
+# graph construction + forward sizing
+# ---------------------------------------------------------------------------
+
+def _rows_for(out_node, n_in=720, job=0):
+    info = A.analyze([out_node])
+    src = info.sources[0]
+    return info, A.job_rows(info, job, {src.id: n_in})
+
+
+def test_sample_sizing():
+    frame = io.Input([FakeStream(720)])
+    for build, expected in [
+        (lambda f: streams.Stride(f, [{"stride": 8}]), 90),
+        (lambda f: streams.Range(f, [(0, 30)]), 30),
+        (lambda f: streams.StridedRange(f, [(0, 300, 10)]), 30),
+        (lambda f: streams.Gather(f, [[0, 150, 377, 500]]), 4),
+    ]:
+        out = io.Output(build(frame), [FakeStream(0)])
+        info, jr = _rows_for(out)
+        assert jr.output_rows == expected, build
+
+
+def test_space_sizing():
+    frame = io.Input([FakeStream(90)])
+    sp = streams.Repeat(frame, [8])
+    out = io.Output(sp, [FakeStream(0)])
+    _, jr = _rows_for(out, 90)
+    assert jr.output_rows == 720
+
+
+def test_slice_unslice_sizing_and_tasks():
+    frame = io.Input([FakeStream(720)])
+    sl = streams.Slice(frame, [partitioner.all(50)])
+    un = streams.Unslice(sl)
+    out = io.Output(un, [FakeStream(0)])
+    info, jr = _rows_for(out)
+    assert jr.output_rows == 720
+    assert jr.num_groups == 15
+    assert jr.group_ends[:3] == [50, 100, 150]
+    # tasks never cross group boundaries
+    tasks = A.generate_tasks(jr, io_packet_size=64)
+    for s, e in tasks:
+        g = np.searchsorted(np.asarray(jr.group_ends), s, side="right")
+        assert e <= jr.group_ends[g]
+    assert sum(e - s for s, e in tasks) == 720
+
+
+def test_overlapping_slice_with_per_group_args():
+    frame = io.Input([FakeStream(720)])
+    sl = streams.Slice(frame, [partitioner.strided_ranges(
+        [(0, 15), (5, 25), (15, 35)], 1)])
+    sampled = streams.Range(sl, [SliceList([
+        {"start": 0, "end": 10},
+        {"start": 5, "end": 15},
+        {"start": 5, "end": 15},
+    ])])
+    un = streams.Unslice(sampled)
+    out = io.Output(un, [FakeStream(0)])
+    info, jr = _rows_for(out)
+    assert jr.output_rows == 30
+    assert jr.group_ends == [10, 20, 30]
+    # task in group 1 pulls source rows from the overlapping range
+    plan = A.derive_task_streams(info, jr, (10, 20))
+    assert plan.slice_group == 1
+    src_id = info.sources[0].id
+    # group 1 covers source rows 5..25; Range start 5 end 15 within group =>
+    # local rows 5..15 => global rows 10..20
+    assert list(plan.source_rows[src_id]) == list(range(10, 20))
+
+
+def test_multiple_outputs_row_mismatch():
+    frame = io.Input([FakeStream(720)])
+    s1 = streams.Range(frame, [(0, 30)])
+    s2 = streams.Range(frame, [(0, 15)])
+    o1 = io.Output(s1, [FakeStream(0)])
+    o2 = io.Output(s2, [FakeStream(0)])
+    info = A.analyze([o1, o2])
+    with pytest.raises(GraphException):
+        A.job_rows(info, 0, {info.sources[0].id: 720})
+    # equal rows fine
+    s2b = streams.Range(frame, [(30, 60)])
+    o2b = io.Output(s2b, [FakeStream(0)])
+    info = A.analyze([o1, o2b])
+    jr = A.job_rows(info, 0, {info.sources[0].id: 720})
+    assert jr.output_rows == 30
+
+
+# ---------------------------------------------------------------------------
+# backward derivation
+# ---------------------------------------------------------------------------
+
+def test_stencil_derivation_cases():
+    # case: sample [0,1) then stencil [-1,0] -- needs source row 0 only
+    frame = io.Input([FakeStream(720)])
+    sampled = streams.Range(frame, [(0, 1)])
+    flow = ops.Flow(frame=sampled)
+    out = io.Output(flow, [FakeStream(0)])
+    info, jr = _rows_for(out)
+    assert jr.output_rows == 1
+    plan = A.derive_task_streams(info, jr, (0, 1))
+    src = info.sources[0].id
+    assert list(plan.source_rows[src]) == [0]
+
+    # case: stencil [0,1] over sampled stream of length 2
+    frame = io.Input([FakeStream(720)])
+    sampled = streams.Range(frame, [(0, 2)])
+    flow = ops.Flow(frame=sampled, stencil=[0, 1])
+    out = io.Output(flow, [FakeStream(0)])
+    info, jr = _rows_for(out)
+    plan = A.derive_task_streams(info, jr, (0, 2))
+    flow_stream = plan.streams[flow.op.id]
+    # row 1's stencil neighbor 2 is out of the sampled domain -> clamped
+    assert list(flow_stream.valid_input_rows) == [0, 1]
+    assert list(flow_stream.valid_output_rows) == [0, 1]
+
+    # case: stencil then sample: flow over full stream, then range [0,1)
+    frame = io.Input([FakeStream(720)])
+    flow = ops.Flow(frame=frame)  # stencil [-1, 0]
+    sampled = streams.Range(flow, [(0, 1)])
+    out = io.Output(sampled, [FakeStream(0)])
+    info, jr = _rows_for(out)
+    assert jr.output_rows == 1
+    plan = A.derive_task_streams(info, jr, (0, 1))
+    assert list(plan.source_rows[info.sources[0].id]) == [0]
+
+    # stencil reaching backward mid-stream pulls the extra source row
+    frame = io.Input([FakeStream(720)])
+    flow = ops.Flow(frame=frame)
+    sampled = streams.Range(flow, [(100, 101)])
+    out = io.Output(sampled, [FakeStream(0)])
+    info, jr = _rows_for(out)
+    plan = A.derive_task_streams(info, jr, (0, 1))
+    assert list(plan.source_rows[info.sources[0].id]) == [99, 100]
+
+
+def test_bounded_state_warmup_derivation():
+    # reference test_bounded_state: gather [0,10,25,26,27], warmup 3
+    frame = io.Input([FakeStream(720)])
+    incr = ops.Incr(ignore=frame)
+    sampled = streams.Gather(incr, [[0, 10, 25, 26, 27]])
+    out = io.Output(sampled, [FakeStream(0)])
+    info, jr = _rows_for(out)
+    assert jr.output_rows == 5
+    plan = A.derive_task_streams(info, jr, (0, 5))
+    ts = plan.streams[incr.op.id]
+    assert list(ts.compute_rows) == [0, 7, 8, 9, 10, 22, 23, 24, 25, 26, 27]
+    assert list(ts.valid_output_rows) == [0, 10, 25, 26, 27]
+
+
+def test_unbounded_state_derivation():
+    frame = io.Input([FakeStream(720)])
+    incr = ops.IncrU(ignore=frame)
+    sampled = streams.Gather(incr, [[5, 9]])
+    out = io.Output(sampled, [FakeStream(0)])
+    info, jr = _rows_for(out)
+    plan = A.derive_task_streams(info, jr, (0, 2))
+    ts = plan.streams[incr.op.id]
+    assert list(ts.compute_rows) == list(range(10))
+
+
+def test_task_crossing_group_boundary_rejected():
+    frame = io.Input([FakeStream(720)])
+    sl = streams.Slice(frame, [partitioner.all(50)])
+    un = streams.Unslice(sl)
+    out = io.Output(un, [FakeStream(0)])
+    info, jr = _rows_for(out)
+    with pytest.raises(GraphException):
+        A.derive_task_streams(info, jr, (40, 60))
+
+
+def test_validation_errors():
+    # sliced stream must be unsliced before output
+    frame = io.Input([FakeStream(720)])
+    sl = streams.Slice(frame, [partitioner.all(50)])
+    out = io.Output(sl, [FakeStream(0)])
+    with pytest.raises(GraphException):
+        A.analyze([out])
+
+    # job count mismatch
+    frame = io.Input([FakeStream(720), FakeStream(300)])
+    s1 = streams.Range(frame, [(0, 10)])  # one arg for two streams
+    out = io.Output(s1, [FakeStream(0), FakeStream(0)])
+    with pytest.raises(GraphException):
+        A.analyze([out])
+
+
+def test_per_job_args():
+    frame = io.Input([FakeStream(720), FakeStream(300)])
+    s1 = streams.Range(frame, [(0, 30), (10, 25)])
+    out = io.Output(s1, [FakeStream(0), FakeStream(0)])
+    info = A.analyze([out])
+    assert info.num_jobs == 2
+    jr0 = A.job_rows(info, 0, {info.sources[0].id: 720})
+    jr1 = A.job_rows(info, 1, {info.sources[0].id: 300})
+    assert jr0.output_rows == 30
+    assert jr1.output_rows == 15
+    plan = A.derive_task_streams(info, jr1, (0, 15))
+    assert list(plan.source_rows[info.sources[0].id]) == list(range(10, 25))
