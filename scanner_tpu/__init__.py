@@ -11,10 +11,19 @@ from .common import (BlobType, BoundaryCondition, CacheMode, DeviceType,
                      FrameType, GraphException, JobException, NullElement,
                      PerfParams, ScannerException, SliceList, StorageException)
 
+from .engine.client import Client, Table
+from .graph.ops import Kernel, KernelConfig, register_op
+from .storage.streams import NamedStream, NamedVideoStream, StoredStream
+
+# reference-compat alias
+register_python_op = register_op
+
 __version__ = "0.1.0"
 
 __all__ = [
     "BlobType", "BoundaryCondition", "CacheMode", "DeviceType", "FrameType",
     "GraphException", "JobException", "NullElement", "PerfParams",
     "ScannerException", "SliceList", "StorageException",
+    "Client", "Table", "Kernel", "KernelConfig", "register_op",
+    "register_python_op", "NamedStream", "NamedVideoStream", "StoredStream",
 ]

@@ -1,0 +1,208 @@
+"""Client: the user entry point.
+
+Capability parity: reference scannerpy/client.py (Client:58, run:1282,
+ingest_videos:965, new_table:418, table:500, summarize:548) — here the
+single-node path runs in-process; engine/service.py provides the
+master/worker cluster path behind the same API.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ..common import CacheMode, DeviceType, PerfParams, ScannerException
+from ..graph import analysis as A
+from ..graph import ops as O
+from ..graph.streams_dsl import IOGenerator, StreamsGenerator, TaskPartitioner
+from ..storage import Database, make_storage
+from ..storage import metadata as md
+from ..storage.streams import NamedStream, NamedVideoStream
+from ..util.profiler import Profile, Profiler
+from .executor import LocalExecutor
+
+
+class Table:
+    """Read handle on a stored table (reference table.py:11)."""
+
+    def __init__(self, db: Database, name: str):
+        self._db = db
+        self._name = name
+
+    def id(self) -> int:
+        return self._db.table_descriptor(self._name).id
+
+    def name(self) -> str:
+        return self._name
+
+    def num_rows(self) -> int:
+        return self._db.table_descriptor(self._name).num_rows
+
+    def column_names(self) -> List[str]:
+        return self._db.table_descriptor(self._name).column_names()
+
+    def column(self, name: str):
+        desc = self._db.table_descriptor(self._name)
+        if desc.column_type(name) == md.ColumnType.VIDEO:
+            s = NamedVideoStream(self._db, self._name)
+        else:
+            s = NamedStream(self._db, self._name)
+            if name != "output":
+                # direct column access bypasses the default-column logic
+                return _ColumnReader(self._db, self._name, name)
+        return s
+
+    def committed(self) -> bool:
+        return self._db.table_is_committed(self._name)
+
+
+class _ColumnReader:
+    def __init__(self, db: Database, table: str, column: str):
+        self._stream = NamedStream(db, table)
+        self._column = column
+
+    def load(self, rows: Optional[Sequence[int]] = None):
+        yield from self._stream.load(rows=rows, column=self._column)
+
+
+class Client:
+    """Create one per database.
+
+    sc = Client(db_path="/data/db")
+    frames = sc.io.Input([NamedVideoStream(sc, "movie", path="m.mp4")])
+    hist = sc.ops.Histogram(frame=frames)
+    sc.run(sc.io.Output(hist, [NamedStream(sc, "hists")]), PerfParams.estimate())
+    """
+
+    def __init__(self, db_path: Optional[str] = None,
+                 storage_type: str = "posix",
+                 master: Optional[str] = None,
+                 workers: Optional[List[str]] = None,
+                 num_load_workers: int = 2,
+                 num_save_workers: int = 2,
+                 pipeline_instances: int = 1,
+                 config_path: Optional[str] = None,
+                 **kw):
+        if config_path is not None:
+            import tomllib
+            with open(config_path, "rb") as f:
+                cfg = tomllib.load(f)
+            db_path = cfg.get("storage", {}).get("db_path", db_path)
+            storage_type = cfg.get("storage", {}).get("type", storage_type)
+            master = cfg.get("network", {}).get("master_address", master)
+        if db_path is None and storage_type == "posix":
+            db_path = os.path.expanduser("~/.scanner_tpu/db")
+        self._db = Database(make_storage(storage_type, db_path=db_path))
+        self._db.load_megafile()
+        self._profiler = Profiler(node="client")
+        self._job_profiles: Dict[int, List[Profiler]] = {}
+        self._next_job_id = 0
+        self._master_address = master
+        self._cluster = None
+        if master is not None:
+            try:
+                from .service import ClusterClient
+            except ImportError as e:
+                raise ScannerException(
+                    "cluster mode requires scanner_tpu.engine.service") \
+                    from e
+            self._cluster = ClusterClient(master, db=self._db, **kw)
+
+        self.ops = O.OpGenerator()
+        self.streams = StreamsGenerator()
+        self.io = IOGenerator(self)
+        self.partitioner = TaskPartitioner()
+        self._executor = LocalExecutor(
+            self._db, self._profiler,
+            num_load_workers=num_load_workers,
+            num_save_workers=num_save_workers,
+            pipeline_instances=pipeline_instances)
+
+    # -- context manager ----------------------------------------------------
+
+    def __enter__(self) -> "Client":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def stop(self) -> None:
+        if self._cluster is not None:
+            self._cluster.close()
+
+    # -- data management ----------------------------------------------------
+
+    def ingest_videos(self, named_paths: Sequence, inplace: bool = False):
+        from ..video import ingest_videos
+        return ingest_videos(self._db, named_paths, inplace=inplace)
+
+    def new_table(self, name: str, columns: Sequence[str],
+                  rows: Sequence[Sequence[bytes]],
+                  overwrite: bool = False) -> Table:
+        self._db.new_table(name, columns, rows, overwrite=overwrite)
+        return Table(self._db, name)
+
+    def table(self, name: str) -> Table:
+        if not self._db.has_table(name):
+            raise ScannerException(f"no such table: {name}")
+        return Table(self._db, name)
+
+    def has_table(self, name: str) -> bool:
+        return self._db.has_table(name)
+
+    def delete_table(self, name: str) -> None:
+        self._db.delete_table(name)
+
+    def summarize(self) -> str:
+        lines = ["table                          rows  committed"]
+        for name in self._db.list_tables():
+            try:
+                desc = self._db.table_descriptor(name)
+                lines.append(f"{name:28} {desc.num_rows:6}  "
+                             f"{self._db.table_is_committed(name)}")
+            except Exception:
+                lines.append(f"{name:28}      ?  ?")
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+    # -- execution ----------------------------------------------------------
+
+    def run(self, outputs: Union[O.OpNode, Sequence[O.OpNode]],
+            perf_params: Optional[PerfParams] = None,
+            cache_mode: CacheMode = CacheMode.Error,
+            show_progress: bool = True,
+            profiling: bool = True,
+            task_timeout: float = 0.0,
+            **kw) -> int:
+        """Execute a job set; returns a job id usable with get_profile."""
+        if isinstance(outputs, O.OpNode):
+            outputs = [outputs]
+        perf = perf_params or PerfParams.estimate()
+        if task_timeout:
+            perf.task_timeout = task_timeout
+        job_id = self._next_job_id
+        self._next_job_id += 1
+        prof = Profiler(node=f"job{job_id}")
+        if self._cluster is not None:
+            profs = self._cluster.run(outputs, perf, cache_mode,
+                                      show_progress)
+            self._job_profiles[job_id] = profs
+            return job_id
+        ex = LocalExecutor(
+            self._db, prof,
+            num_load_workers=self._executor.num_load_workers,
+            num_save_workers=self._executor.num_save_workers,
+            pipeline_instances=kw.get(
+                "pipeline_instances",
+                perf.pipeline_instances_per_node
+                or self._executor.pipeline_instances))
+        ex.run(outputs, perf, cache_mode=cache_mode,
+               show_progress=show_progress)
+        self._job_profiles[job_id] = [prof]
+        return job_id
+
+    def get_profile(self, job_id: int) -> Profile:
+        if job_id not in self._job_profiles:
+            raise ScannerException(f"no profile for job {job_id}")
+        return Profile(self._job_profiles[job_id])

@@ -1,0 +1,283 @@
+"""Per-task graph evaluation.
+
+Capability parity: reference scanner/engine/evaluate_worker.cpp:408-1328
+(EvaluateWorker: row bookkeeping, stencil cache, batching, builtin
+sample/space/slice/unslice remapping, per-slice arg rebinding, state reset).
+
+One TaskEvaluator owns the kernel instances of one pipeline instance and
+executes tasks end-to-end in element space: {(node_id, column): {row: elem}}.
+Frames are numpy uint8 arrays; TPU kernels receive whole batches and jit
+internally.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common import (DeviceType, GraphException, JobException, NullElement,
+                      ScannerException, SliceList)
+from ..graph import analysis as A
+from ..graph import ops as O
+from ..util.profiler import Profiler
+
+Elem = Any  # np.ndarray | bytes | arbitrary python object | NullElement
+ColKey = Tuple[int, str]  # (node id, column name)
+
+
+def _is_null(e: Elem) -> bool:
+    return isinstance(e, NullElement)
+
+
+class KernelInstance:
+    """One live kernel with its stream/state bookkeeping."""
+
+    def __init__(self, node: O.OpNode, profiler: Profiler,
+                 devices: Optional[List[Any]] = None):
+        assert node.spec is not None and node.spec.kernel_factory is not None
+        self.node = node
+        self.spec = node.spec
+        cfg = O.KernelConfig(device=node.effective_device(),
+                             args=dict(node.init_args),
+                             devices=devices or [])
+        self.kernel = self.spec.kernel_factory(cfg, **node.init_args)
+        self.profiler = profiler
+        self._cur_stream: Tuple[int, int] = (-1, -1)  # (job, slice group)
+        self._last_row: Optional[int] = None
+        self._did_setup = False
+
+    def setup(self, fetch: bool = True) -> None:
+        if not self._did_setup:
+            if fetch:
+                self.kernel.fetch_resources()
+            self.kernel.setup_with_resources()
+            self._did_setup = True
+
+    def bind_stream(self, job_idx: int, slice_group: int) -> None:
+        """Call new_stream when the (job, slice group) changes
+        (reference evaluate_worker.cpp:640-707 per-slice arg rebinding)."""
+        key = (job_idx, slice_group)
+        if key == self._cur_stream:
+            return
+        args = {}
+        for name, per_stream in self.node.job_args.items():
+            if name not in self.spec.stream_arg_names:
+                continue
+            v = per_stream[job_idx]
+            if isinstance(v, SliceList):
+                v = v[slice_group]
+            args[name] = v
+        self.kernel.new_stream(**args)
+        self.kernel.reset()
+        self._cur_stream = key
+        self._last_row = None
+
+    def maybe_reset(self, row: int) -> None:
+        """Reset state at row discontinuities (the reference kernel checks
+        element indices itself, test_ops.cpp:183-189; we centralize it)."""
+        if self._last_row is not None and row != self._last_row + 1 \
+                and self.spec.is_stateful:
+            self.kernel.reset()
+        self._last_row = row
+
+    def close(self) -> None:
+        self.kernel.close()
+
+
+class TaskEvaluator:
+    def __init__(self, info: A.GraphInfo, profiler: Profiler,
+                 devices: Optional[List[Any]] = None,
+                 skip_fetch_resources: bool = False):
+        self.info = info
+        self.profiler = profiler
+        self.kernels: Dict[int, KernelInstance] = {}
+        for n in info.ops:
+            if not n.is_builtin:
+                ki = KernelInstance(n, profiler, devices)
+                self.kernels[n.id] = ki
+        for ki in self.kernels.values():
+            ki.setup(fetch=not skip_fetch_resources)
+
+    def close(self) -> None:
+        for ki in self.kernels.values():
+            ki.close()
+
+    # ------------------------------------------------------------------
+
+    def execute_task(self, jr: A.JobRows, plan: A.TaskPlan,
+                     source_elements: Dict[int, Dict[int, Elem]]
+                     ) -> Dict[int, Dict[int, Elem]]:
+        """Run one task.  source_elements: Input node id -> {row: elem}.
+        Returns sink node id -> {output row: elem}."""
+        store: Dict[ColKey, Dict[int, Elem]] = {}
+        results: Dict[int, Dict[int, Elem]] = {}
+
+        for n in self.info.ops:
+            ts = plan.streams[n.id]
+            if n.name == O.INPUT_OP:
+                elems = source_elements[n.id]
+                store[(n.id, "output")] = elems
+            elif n.name in (O.SAMPLE_OP, O.SPACE_OP):
+                store[(n.id, "output")] = self._run_sampler(n, jr, plan, store)
+            elif n.name == O.SLICE_OP:
+                store[(n.id, "output")] = self._run_slice(n, jr, plan, store)
+            elif n.name == O.UNSLICE_OP:
+                store[(n.id, "output")] = self._run_unslice(n, jr, plan, store)
+            elif n.name == O.OUTPUT_OP:
+                src = n.input_columns()[0]
+                elems = store[(src.op.id, src.column)]
+                results[n.id] = {r: elems[r]
+                                 for r in ts.valid_output_rows.tolist()}
+            else:
+                outs = self._run_kernel(n, jr, plan, store)
+                for col, elems in outs.items():
+                    store[(n.id, col)] = elems
+        return results
+
+    # -- builtins ------------------------------------------------------
+
+    def _input_elems(self, n: O.OpNode, store) -> Dict[int, Elem]:
+        src = n.input_columns()[0]
+        return store[(src.op.id, src.column)]
+
+    def _run_sampler(self, n, jr, plan, store) -> Dict[int, Elem]:
+        ts = plan.streams[n.id]
+        g = plan.slice_group if self.info.slice_level[n.id] > 0 else 0
+        sampler = jr.samplers[n.id][g]
+        in_elems = self._input_elems(n, store)
+        up_rows = ts.valid_input_rows
+        down_rows, mapping = sampler.downstream_map(up_rows)
+        needed = set(ts.valid_output_rows.tolist())
+        out: Dict[int, Elem] = {}
+        for d, m in zip(down_rows.tolist(), mapping.tolist()):
+            if d in needed:
+                out[d] = NullElement() if m < 0 else in_elems[int(up_rows[m])]
+        missing = needed - out.keys()
+        if missing:
+            raise JobException(
+                f"{n.name}: missing output rows {sorted(missing)[:5]}...")
+        return out
+
+    def _run_slice(self, n, jr, plan, store) -> Dict[int, Elem]:
+        ts = plan.streams[n.id]
+        group = jr.partitioners[n.id].group_at(plan.slice_group)
+        in_elems = self._input_elems(n, store)
+        return {int(r): in_elems[int(group[r])]
+                for r in ts.valid_output_rows.tolist()}
+
+    def _run_unslice(self, n, jr, plan, store) -> Dict[int, Elem]:
+        ts = plan.streams[n.id]
+        inp = n.input_columns()[0].op
+        offset = int(np.concatenate(
+            [[0], np.cumsum(jr.rows[inp.id])])[plan.slice_group])
+        in_elems = self._input_elems(n, store)
+        return {int(r): in_elems[int(r) - offset]
+                for r in ts.valid_output_rows.tolist()}
+
+    # -- regular kernels -----------------------------------------------
+
+    def _run_kernel(self, n: O.OpNode, jr: A.JobRows, plan: A.TaskPlan,
+                    store) -> Dict[str, Dict[int, Elem]]:
+        ts = plan.streams[n.id]
+        ki = self.kernels[n.id]
+        ki.bind_stream(plan.job_idx, plan.slice_group)
+
+        in_cols = n.input_columns()
+        in_maps = [store[(c.op.id, c.column)] for c in in_cols]
+        g = plan.slice_group if self.info.slice_level[n.id] > 0 else 0
+        in_op = in_cols[0].op
+        max_in = jr.rows[in_op.id][g]
+        stencil = n.effective_stencil()
+        has_stencil = stencil != [0]
+        batch = max(1, n.effective_batch())
+
+        compute = ts.compute_rows.tolist()
+        out_cols = [c for c, _ in n.spec.output_columns]
+        outputs: Dict[str, Dict[int, Elem]] = {c: {} for c in out_cols}
+        valid_out = set(ts.valid_output_rows.tolist())
+
+        def put(row: int, result: Any) -> None:
+            if row not in valid_out:
+                return  # warmup row output discarded
+            if len(out_cols) == 1:
+                outputs[out_cols[0]][row] = result
+            else:
+                if not isinstance(result, tuple) or len(result) != len(out_cols):
+                    raise JobException(
+                        f"{n.name}: expected {len(out_cols)}-tuple output")
+                for c, v in zip(out_cols, result):
+                    outputs[c][row] = v
+
+        def gather(row: int, col_map: Dict[int, Elem]):
+            """Stencil window (REPEAT_EDGE clamp) or single element."""
+            if has_stencil:
+                window = []
+                for s_off in stencil:
+                    rr = min(max(row + s_off, 0), max_in - 1)
+                    window.append(col_map[rr])
+                return window
+            return col_map[row]
+
+        # split compute rows into contiguous runs; reset state between runs
+        runs: List[List[int]] = []
+        for r in compute:
+            if runs and r == runs[-1][-1] + 1:
+                runs[-1].append(r)
+            else:
+                runs.append([r])
+
+        with self.profiler.span("evaluate:" + n.name, rows=len(compute)):
+            for run in runs:
+                ki.maybe_reset(run[0])
+                ki._last_row = run[-1]
+                for i in range(0, len(run), batch):
+                    chunk = run[i:i + batch]
+                    # null propagation: a row whose inputs (or stencil
+                    # window) contain a null yields null without running
+                    # the kernel
+                    live_rows = []
+                    for r in chunk:
+                        window_rows = [min(max(r + s, 0), max_in - 1)
+                                       for s in stencil]
+                        if any(_is_null(m[wr]) for m in in_maps
+                               for wr in window_rows):
+                            put(r, NullElement())
+                        else:
+                            live_rows.append(r)
+                    if not live_rows:
+                        continue
+                    args_per_col = []
+                    for m in in_maps:
+                        col_vals = [gather(r, m) for r in live_rows]
+                        args_per_col.append(col_vals)
+                    if batch > 1:
+                        call_args = [self._maybe_stack(c)
+                                     for c in args_per_col]
+                        res = ki.kernel.execute(*call_args)
+                        if res is None or len(res) != len(live_rows):
+                            raise JobException(
+                                f"{n.name}: batch kernel returned "
+                                f"{0 if res is None else len(res)} results "
+                                f"for {len(live_rows)} inputs")
+                        for r, v in zip(live_rows, res):
+                            put(r, v)
+                    else:
+                        for r, cols_v in zip(
+                                live_rows,
+                                zip(*args_per_col) if args_per_col
+                                else [()] * len(live_rows)):
+                            res = ki.kernel.execute(*cols_v)
+                            put(r, res)
+        return outputs
+
+    @staticmethod
+    def _maybe_stack(vals: List[Any]):
+        """Stack uniform frame batches into one array so TPU kernels get a
+        single device transfer; fall back to lists for ragged/objects."""
+        if (vals and isinstance(vals[0], np.ndarray)
+                and all(isinstance(v, np.ndarray)
+                        and v.shape == vals[0].shape
+                        and v.dtype == vals[0].dtype for v in vals)):
+            return np.stack(vals)
+        return vals

@@ -1,0 +1,520 @@
+"""Single-node pipelined job executor.
+
+Capability parity: reference worker-side pipeline (worker.cpp:1467-1724
+thread spawn; load_worker/evaluate_worker/save_worker stage drivers) minus
+the RPC shell, which engine/service.py adds for the distributed path.
+
+Stages, connected by bounded queues (reference runtime.h:81-90):
+
+    task list -> [loader xL] -> [evaluator xP] -> [saver xS] -> commit
+
+Loaders read item bytes / decode exact frame sets (C++ releases the GIL, so
+loader threads overlap evaluator Python/JAX time).  Each evaluator thread is
+one pipeline instance owning its kernel set.  Savers H.264-encode video
+outputs and write column items.  Tasks are self-contained (warmup rows are
+re-derived per task), so any instance may take any task.
+"""
+
+from __future__ import annotations
+
+import queue
+import struct
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common import (CacheMode, JobException, NullElement, PerfParams,
+                      ScannerException)
+from ..graph import analysis as A
+from ..graph import ops as O
+from ..storage import Database
+from ..storage import items as IT
+from ..storage import metadata as md
+from ..storage.streams import NamedVideoStream, StoredStream
+from ..util.profiler import Profiler
+from .evaluate import TaskEvaluator
+
+_SENTINEL = object()
+
+
+@dataclass
+class JobContext:
+    job_idx: int
+    jr: A.JobRows
+    tasks: List[Tuple[int, int]]
+    # per Input node: metadata for loading
+    source_info: Dict[int, Dict[str, Any]]
+    # per sink node id: (table descriptor, column name, codec, encode opts)
+    sink_tables: Dict[int, Tuple[md.TableDescriptor, str, str, Dict]]
+    fps: float = 30.0
+    skipped: bool = False
+    tasks_done: int = 0
+    # per sink id: "video" | "pickle", fixed by the first task written so
+    # mixed-dtype frame outputs fail loudly instead of corrupting the table
+    sink_modes: Dict[int, str] = field(default_factory=dict)
+    sink_mode_lock: threading.Lock = field(default_factory=threading.Lock)
+
+
+@dataclass
+class TaskItem:
+    job: JobContext
+    task_idx: int
+    output_range: Tuple[int, int]
+    plan: Optional[A.TaskPlan] = None
+    elements: Optional[Dict[int, Dict[int, Any]]] = None
+    results: Optional[Dict[int, Dict[int, Any]]] = None
+
+
+class LocalExecutor:
+    def __init__(self, db: Database, profiler: Optional[Profiler] = None,
+                 num_load_workers: int = 2, num_save_workers: int = 2,
+                 pipeline_instances: int = 1, node_id: int = 0):
+        self.db = db
+        self.profiler = profiler or Profiler()
+        self.num_load_workers = num_load_workers
+        self.num_save_workers = num_save_workers
+        self.pipeline_instances = pipeline_instances
+        self.node_id = node_id
+
+    # ------------------------------------------------------------------
+    # Job-set preparation (reference master.cpp:1367 process_job admission)
+    # ------------------------------------------------------------------
+
+    def prepare(self, outputs: Sequence[O.OpNode], perf: PerfParams,
+                cache_mode: CacheMode = CacheMode.Error
+                ) -> Tuple[A.GraphInfo, List[JobContext]]:
+        info = A.analyze(outputs)
+        perf = self._estimate_perf(info, perf)
+        jobs: List[JobContext] = []
+        for j in range(info.num_jobs):
+            jobs.append(self._prepare_job(info, j, perf, cache_mode))
+        return info, jobs
+
+    def _estimate_perf(self, info: A.GraphInfo, perf: PerfParams
+                       ) -> PerfParams:
+        if not getattr(perf, "_estimate", False):
+            if perf.io_packet_size % perf.work_packet_size != 0:
+                raise ScannerException(
+                    "io_packet_size must be a multiple of work_packet_size")
+            return perf
+        # heuristic: frame pipelines move big elements -> smaller packets
+        any_video = any(
+            getattr(s, "is_video", False)
+            for n in info.sources for s in n.extra["streams"])
+        perf.io_packet_size = 64 if any_video else 512
+        perf.work_packet_size = 16 if any_video else 128
+        return perf
+
+    def _prepare_job(self, info: A.GraphInfo, j: int, perf: PerfParams,
+                     cache_mode: CacheMode) -> JobContext:
+        # resolve sources
+        source_info: Dict[int, Dict[str, Any]] = {}
+        source_rows: Dict[int, int] = {}
+        fps = 30.0
+        for n in info.sources:
+            stream: StoredStream = n.extra["streams"][j]
+            if isinstance(stream, NamedVideoStream):
+                stream.ensure_ingested()
+            if not stream.committed():
+                raise JobException(
+                    f"input stream {stream.name} does not exist or is "
+                    f"not committed")
+            desc = self.db.table_descriptor(stream.name)
+            col = stream.column if stream.column in desc.column_names() \
+                else next(c for c in desc.column_names() if c != "index")
+            is_video = desc.column_type(col) == md.ColumnType.VIDEO
+            vinfo = None
+            if is_video:
+                from ..video import load_video_meta
+                vinfo = load_video_meta(self.db, stream.name, col)
+                if vinfo.fps:
+                    fps = vinfo.fps
+            source_info[n.id] = {
+                "table": desc, "column": col, "is_video": is_video,
+                "video_meta": vinfo,
+            }
+            source_rows[n.id] = desc.num_rows
+
+        jr = A.job_rows(info, j, source_rows)
+        tasks = A.generate_tasks(jr, perf.io_packet_size)
+
+        # output tables (pre-created uncommitted, reference
+        # master.cpp:1619-1663).  CacheMode.Ignore skips the job only when
+        # EVERY sink output already exists committed (job-level resume,
+        # reference client.py:1389-1430)
+        sink_names = []
+        for sink in info.sinks:
+            out_stream = sink.extra["streams"][j]
+            sink_names.append(out_stream.name if hasattr(out_stream, "name")
+                              else str(out_stream))
+        if cache_mode == CacheMode.Ignore and all(
+                self.db.table_is_committed(nm) for nm in sink_names):
+            return JobContext(job_idx=j, jr=jr, tasks=tasks,
+                              source_info=source_info, sink_tables={},
+                              fps=fps, skipped=True)
+        sink_tables: Dict[int, Tuple] = {}
+        for sink, name in zip(info.sinks, sink_names):
+            src_col = sink.input_columns()[0]
+            codec = self._codec_for(src_col)
+            if self.db.has_table(name):
+                if self.db.table_is_committed(name) \
+                        and cache_mode == CacheMode.Error:
+                    raise JobException(
+                        f"output stream {name} already exists "
+                        f"(pass cache_mode=CacheMode.Overwrite or Ignore)")
+                self.db.delete_table(name)
+            is_frame = codec == "frame"
+            col = md.ColumnDescriptor(
+                "frame" if is_frame else "output",
+                md.ColumnType.VIDEO if is_frame else md.ColumnType.BYTES,
+                codec="video" if is_frame else codec)
+            desc = self.db.create_table(
+                name, [col], end_rows=[e for _, e in tasks], job_id=-1)
+            enc = dict(sink.extra.get("encode_options") or {})
+            sink_tables[sink.id] = (desc, col.name, codec, enc)
+        ctx = JobContext(job_idx=j, jr=jr, tasks=tasks,
+                         source_info=source_info, sink_tables=sink_tables,
+                         fps=fps, skipped=not sink_tables)
+        return ctx
+
+    @staticmethod
+    def _codec_for(col: O.OpColumn) -> str:
+        node = col.op
+        if node.is_builtin:
+            return "frame" if col.is_frame else "pickle"
+        idx = [c for c, _ in node.spec.output_columns].index(col.column)
+        return node.spec.output_codecs[idx]
+
+    # ------------------------------------------------------------------
+    # Execution
+    # ------------------------------------------------------------------
+
+    def run(self, outputs: Sequence[O.OpNode], perf: PerfParams,
+            cache_mode: CacheMode = CacheMode.Error,
+            show_progress: bool = False) -> List[JobContext]:
+        info, jobs = self.prepare(outputs, perf, cache_mode)
+        work = [TaskItem(job, t, rng)
+                for job in jobs if not job.skipped
+                for t, rng in enumerate(job.tasks)]
+        if work:
+            self._run_pipeline(info, work, show_progress)
+        for job in jobs:
+            if job.skipped:
+                continue
+            for desc, _c, _k, _e in job.sink_tables.values():
+                self.db.commit_table(desc.id)
+        self.db.write_megafile()
+        return jobs
+
+    def _run_pipeline(self, info: A.GraphInfo, work: List[TaskItem],
+                      show_progress: bool) -> None:
+        eval_q: "queue.Queue" = queue.Queue(maxsize=4)
+        save_q: "queue.Queue" = queue.Queue(maxsize=4)
+        task_q: "queue.Queue" = queue.Queue()
+        for w in work:
+            task_q.put(w)
+        errors: List[BaseException] = []
+        err_lock = threading.Lock()
+        stop = threading.Event()
+
+        def record_err(e: BaseException):
+            with err_lock:
+                errors.append(e)
+            stop.set()
+
+        # loader cache: (thread, job, node) -> DecoderAutomata
+        tls = threading.local()
+
+        def loader():
+            try:
+                while not stop.is_set():
+                    try:
+                        w: TaskItem = task_q.get_nowait()
+                    except queue.Empty:
+                        break
+                    with self.profiler.span("load", task=w.task_idx,
+                                            job=w.job.job_idx):
+                        w.plan = A.derive_task_streams(
+                            info, w.job.jr, w.output_range,
+                            job_idx=w.job.job_idx, task_idx=w.task_idx)
+                        w.elements = self._load_sources(w, tls)
+                    while not stop.is_set():
+                        try:
+                            eval_q.put(w, timeout=0.25)
+                            break
+                        except queue.Full:
+                            pass
+            except BaseException as e:  # noqa: BLE001
+                record_err(e)
+
+        def evaluator(evaluator_idx: int):
+            try:
+                # fetch_resources runs once per node: instance 0 fetches,
+                # the rest only setup (reference evaluate_worker.cpp:488-534)
+                if evaluator_idx > 0:
+                    fetch_done.wait()
+                te = TaskEvaluator(
+                    info, self.profiler,
+                    skip_fetch_resources=evaluator_idx > 0)
+                if evaluator_idx == 0:
+                    fetch_done.set()
+                try:
+                    while not stop.is_set():
+                        try:
+                            w: TaskItem = eval_q.get(timeout=0.25)
+                        except queue.Empty:
+                            if loaders_done.is_set() and eval_q.empty():
+                                break
+                            continue
+                        if w is _SENTINEL:
+                            break
+                        with self.profiler.span("evaluate",
+                                                task=w.task_idx,
+                                                job=w.job.job_idx):
+                            w.results = te.execute_task(
+                                w.job.jr, w.plan, w.elements)
+                        w.elements = None
+                        while not stop.is_set():
+                            try:
+                                save_q.put(w, timeout=0.25)
+                                break
+                            except queue.Full:
+                                pass
+                finally:
+                    te.close()
+            except BaseException as e:  # noqa: BLE001
+                record_err(e)
+                fetch_done.set()  # never leave siblings waiting
+
+        done_count = [0]
+        done_lock = threading.Lock()
+
+        def saver():
+            try:
+                while not stop.is_set():
+                    try:
+                        w: TaskItem = save_q.get(timeout=0.25)
+                    except queue.Empty:
+                        if evals_done.is_set() and save_q.empty():
+                            break
+                        continue
+                    with self.profiler.span("save", task=w.task_idx,
+                                            job=w.job.job_idx):
+                        self._save_task(info, w)
+                    with done_lock:
+                        done_count[0] += 1
+                        if show_progress:
+                            print(f"\rtasks {done_count[0]}/{len(work)}",
+                                  end="", flush=True)
+            except BaseException as e:  # noqa: BLE001
+                record_err(e)
+
+        fetch_done = threading.Event()
+        loaders_done = threading.Event()
+        evals_done = threading.Event()
+
+        loaders = [threading.Thread(target=loader, name=f"load-{i}")
+                   for i in range(self.num_load_workers)]
+        evals = [threading.Thread(target=evaluator, args=(i,),
+                                  name=f"eval-{i}")
+                 for i in range(self.pipeline_instances)]
+        savers = [threading.Thread(target=saver, name=f"save-{i}")
+                  for i in range(self.num_save_workers)]
+        for t in loaders + evals + savers:
+            t.start()
+        for t in loaders:
+            t.join()
+        loaders_done.set()
+        for t in evals:
+            t.join()
+        evals_done.set()
+        for t in savers:
+            t.join()
+        if show_progress:
+            print()
+        if errors:
+            raise errors[0]
+        if done_count[0] != len(work):
+            raise JobException(
+                f"pipeline finished {done_count[0]}/{len(work)} tasks")
+
+    # ------------------------------------------------------------------
+
+    def _load_sources(self, w: TaskItem, tls) -> Dict[int, Dict[int, Any]]:
+        """Read/decode exactly the rows the task needs."""
+        out: Dict[int, Dict[int, Any]] = {}
+        for node_id, rows in w.plan.source_rows.items():
+            si = w.job.source_info[node_id]
+            rows_l = [int(r) for r in rows]
+            if si["is_video"]:
+                # rows are global; multi-item video tables (job outputs)
+                # hold one independently-decodable item per task
+                desc = si["table"]
+                by_item: Dict[int, List[int]] = {}
+                for r in rows_l:
+                    it = desc.item_of_row(r)
+                    start, _ = desc.item_bounds(it)
+                    by_item.setdefault(it, []).append(r - start)
+                elems: Dict[int, Any] = {}
+                for it, local in by_item.items():
+                    start, _ = desc.item_bounds(it)
+                    auto = self._automata(tls, w.job, node_id, si, it)
+                    frames = auto.get_frames(local)
+                    for i, lr in enumerate(local):
+                        elems[start + lr] = frames[i]
+                out[node_id] = elems
+            else:
+                desc = si["table"]
+                vals = list(self.db.load_column(desc.id, si["column"],
+                                                rows=rows_l))
+                elems = {}
+                for r, v in zip(rows_l, vals):
+                    elems[r] = NullElement() if v is None else v
+                out[node_id] = elems
+        return out
+
+    def _automata(self, tls, job: JobContext, node_id: int, si,
+                  item: int = 0):
+        cache = getattr(tls, "automata", None)
+        if cache is None:
+            cache = {}
+            tls.automata = cache
+        key = (job.job_idx, node_id, item)
+        if key not in cache:
+            from ..video.automata import DecoderAutomata
+            desc = si["table"]
+            if item == 0:
+                vd = si["video_meta"]
+            else:
+                vd = md.VideoDescriptor.deserialize(self.db.backend.read(
+                    md.video_meta_path(desc.id, si["column"], item)))
+            cache[key] = DecoderAutomata(
+                self.db.backend, vd,
+                md.column_item_path(desc.id, si["column"], item))
+        return cache[key]
+
+    def _save_task(self, info: A.GraphInfo, w: TaskItem) -> None:
+        """Encode + write one item per sink (reference save_worker.cpp +
+        PostEvaluateWorker video encode, evaluate_worker.cpp:1373-1560)."""
+        start, end = w.output_range
+        for sink in info.sinks:
+            if sink.id not in w.job.sink_tables:
+                continue
+            desc, col_name, codec, enc_opts = w.job.sink_tables[sink.id]
+            elems = w.results[sink.id]
+            rows = [elems[r] for r in range(start, end)]
+            item_idx = w.task_idx
+            if codec == "frame":
+                mode = "video" if self._is_encodable(rows) else "pickle"
+                with w.job.sink_mode_lock:
+                    prev = w.job.sink_modes.setdefault(sink.id, mode)
+                    if prev != mode:
+                        raise JobException(
+                            f"{desc.name}: mixed frame output types across "
+                            f"tasks ({prev} vs {mode}); kernels must "
+                            f"produce a consistent frame dtype")
+                    if mode == "pickle":
+                        self._demote_video_column(desc)
+                if mode == "video":
+                    self._write_video_item(w.job, desc, col_name, item_idx,
+                                           rows, enc_opts)
+                else:
+                    # non-uint8/RGB frame data (e.g. float32 flow fields):
+                    # the reference stores these as RAW-format video
+                    # columns; here the column degrades to pickled arrays
+                    import pickle
+                    IT.write_item(
+                        self.db.backend,
+                        md.column_item_path(desc.id, col_name, item_idx),
+                        [e if isinstance(e, NullElement)
+                         else pickle.dumps(np.asarray(e),
+                                           protocol=pickle.HIGHEST_PROTOCOL)
+                         for e in rows])
+            else:
+                blobs = []
+                for e in rows:
+                    if isinstance(e, NullElement):
+                        blobs.append(e)
+                    elif codec == "raw":
+                        if not isinstance(e, (bytes, bytearray, memoryview)):
+                            raise JobException(
+                                f"{desc.name}: raw column got "
+                                f"{type(e).__name__}")
+                        blobs.append(bytes(e))
+                    else:
+                        import pickle
+                        blobs.append(pickle.dumps(
+                            e, protocol=pickle.HIGHEST_PROTOCOL))
+                IT.write_item(self.db.backend,
+                              md.column_item_path(desc.id, col_name,
+                                                  item_idx), blobs)
+
+    @staticmethod
+    def _is_encodable(rows: List[Any]) -> bool:
+        """True when the item is H.264-encodable (uint8 RGB).  Null rows in
+        an otherwise-encodable item raise inside _write_video_item, matching
+        the reference where video columns cannot hold nulls."""
+        saw_frame = False
+        for e in rows:
+            if isinstance(e, NullElement):
+                continue
+            a = np.asarray(e)
+            if a.dtype != np.uint8 or a.ndim != 3 or a.shape[2] != 3:
+                return False
+            saw_frame = True
+        return saw_frame
+
+    def _demote_video_column(self, desc: md.TableDescriptor) -> None:
+        col = desc.columns[0]
+        if col.type != md.ColumnType.VIDEO or col.codec != "pickle":
+            col.type = md.ColumnType.BYTES
+            col.codec = "pickle"
+            self.db.write_table_descriptor(desc)
+
+    def _write_video_item(self, job: JobContext, desc: md.TableDescriptor,
+                          col_name: str, item_idx: int, rows: List[Any],
+                          enc_opts: Dict) -> None:
+        from ..video.lib import Encoder
+        frames = []
+        for e in rows:
+            if isinstance(e, NullElement):
+                raise JobException(
+                    f"{desc.name}: video output cannot store null rows; "
+                    f"use a blob column")
+            a = np.asarray(e)
+            if a.dtype != np.uint8 or a.ndim != 3 or a.shape[2] != 3:
+                raise JobException(
+                    f"{desc.name}: video output requires uint8 HxWx3 "
+                    f"frames, got {a.dtype} {a.shape}")
+            frames.append(a)
+        h, w_ = frames[0].shape[:2]
+        keyint = int(enc_opts.get("keyint", 16))
+        enc = Encoder(w_, h, fps=job.fps or 30.0, codec="libx264",
+                      bitrate=int(enc_opts.get("bitrate", 0)),
+                      crf=int(enc_opts.get("crf", 20)), keyint=keyint)
+        try:
+            for f in frames:
+                enc.feed(f)
+            enc.flush()
+            data, sizes, keys, pts, dts = enc.take_packets()
+            vd = md.VideoDescriptor(
+                width=w_, height=h, fps=job.fps or 30.0,
+                num_frames=len(frames), codec="h264",
+                extradata=enc.extradata,
+                sample_offsets=np.concatenate(
+                    [[0], np.cumsum(sizes[:-1])]).astype(np.uint64)
+                if len(sizes) else np.zeros(0, np.uint64),
+                sample_sizes=sizes.astype(np.uint64),
+                keyframe_indices=np.nonzero(keys)[0].astype(np.int64),
+                sample_pts=pts, sample_dts=dts,
+                tb_num=enc.fps_den, tb_den=enc.fps_num)
+            self.db.backend.write(
+                md.column_item_path(desc.id, col_name, item_idx), data)
+            self.db.backend.write(
+                md.video_meta_path(desc.id, col_name, item_idx),
+                vd.serialize())
+        finally:
+            enc.close()

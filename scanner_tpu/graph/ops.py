@@ -84,6 +84,8 @@ class OpSpec:
     bounded_state: Optional[int] = None
     unbounded_state: bool = False
     variadic: bool = False
+    # per output column: "frame" | "raw" (bytes) | "pickle" (objects)
+    output_codecs: List[str] = field(default_factory=list)
     # names of per-stream (new_stream) parameters
     stream_arg_names: List[str] = field(default_factory=list)
     # names of init (kernel constructor) parameters
@@ -175,7 +177,9 @@ def register_op(name: Optional[str] = None,
         else:
             raise GraphException(f"cannot register {target!r} as op")
 
-        sig = inspect.signature(exec_fn)
+        # eval_str resolves PEP-563 string annotations (modules using
+        # `from __future__ import annotations`)
+        sig = inspect.signature(exec_fn, eval_str=True)
         params = list(sig.parameters.values())[skip_self:]
         in_cols: List[Tuple[str, bool]] = []
         variadic = False
@@ -190,17 +194,28 @@ def register_op(name: Optional[str] = None,
                 in_cols.append((p.name, _is_frame_ann(inner)))
             else:
                 init_args.append(p.name)
+        def codec_of(inner) -> str:
+            if _is_frame_ann(inner):
+                return "frame"
+            if inner is bytes:
+                return "raw"
+            return "pickle"
+
         ret = sig.return_annotation
         out_cols: List[Tuple[str, bool]] = []
+        out_codecs: List[str] = []
         if ret is inspect.Signature.empty or ret is None:
             out_cols = [("output", False)]
+            out_codecs = ["pickle"]
         elif typing.get_origin(ret) is tuple:
             for i, r in enumerate(typing.get_args(ret)):
                 inner, _ = _strip_seq(r)
                 out_cols.append((f"output{i}", _is_frame_ann(inner)))
+                out_codecs.append(codec_of(inner))
         else:
             inner, _ = _strip_seq(ret)
             out_cols = [("output", _is_frame_ann(inner))]
+            out_codecs = [codec_of(inner)]
 
         # new_stream kwargs (per-stream args)
         stream_args: List[str] = []
@@ -223,8 +238,8 @@ def register_op(name: Optional[str] = None,
             kernel_factory=cls, device=device,
             stencil=list(stencil) if stencil else [0], batch=batch,
             bounded_state=bounded_state, unbounded_state=unbounded_state,
-            variadic=variadic, stream_arg_names=stream_args,
-            init_arg_names=init_args)
+            variadic=variadic, output_codecs=out_codecs,
+            stream_arg_names=stream_args, init_arg_names=init_args)
         registry.register(spec)
         target._op_spec = spec
         return target

@@ -1,0 +1,167 @@
+"""Image-processing kernel stdlib (JAX).
+
+Capability parity: the scannertools kernel stdlib the reference tutorials
+import (examples/tutorials/00_basic.py `import scannertools.imgproc`:
+Histogram, Resize, Blur, OpticalFlow) and tests/test_ops.cpp (Histogram:13,
+Resize:114, Blur:239, OpticalFlow:63).
+
+All kernels are batched: XLA sees (batch, H, W, C) uint8 arrays, the natural
+TPU layout.  jit caches compile per (shape, dtype) bucket, so frame-geometry
+buckets compile once and stream thereafter.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import DeviceType, FrameType
+from ..graph.ops import Kernel, register_op
+
+HISTOGRAM_BINS = 16
+
+
+@functools.partial(jax.jit, static_argnames=("bins",))
+def _histogram_impl(frames: jnp.ndarray, bins: int = HISTOGRAM_BINS):
+    b = frames.shape[0]
+    vals = (frames.astype(jnp.int32) * bins) // 256
+    # (batch, channel, pixels)
+    vals = vals.reshape(b, -1, frames.shape[-1]).transpose(0, 2, 1)
+    one_hot = jax.nn.one_hot(vals, bins, dtype=jnp.int32)
+    return one_hot.sum(axis=2)  # (batch, channel, bins)
+
+
+@register_op(device=DeviceType.TPU, batch=16)
+class Histogram(Kernel):
+    """Per-channel 16-bin color histogram; returns [r, g, b] int32 arrays
+    per frame (matching scannertools' UniformList(Histogram, parts=3))."""
+
+    def execute(self, frame: Sequence[FrameType]) -> Sequence[Any]:
+        frames = jnp.asarray(np.asarray(frame))
+        hists = np.asarray(_histogram_impl(frames))
+        return [[hists[i, c] for c in range(hists.shape[1])]
+                for i in range(hists.shape[0])]
+
+
+@functools.partial(jax.jit, static_argnames=("h", "w"))
+def _resize_impl(frames: jnp.ndarray, h: int, w: int):
+    b, _, _, c = frames.shape
+    out = jax.image.resize(frames.astype(jnp.float32), (b, h, w, c),
+                           method="bilinear")
+    return jnp.clip(jnp.round(out), 0, 255).astype(jnp.uint8)
+
+
+@register_op(device=DeviceType.TPU, batch=16)
+class Resize(Kernel):
+    """Bilinear resize to (width, height) — per-stream args like the
+    reference Resize op (test_ops.cpp:114, stream-protobuf args)."""
+
+    def __init__(self, config, width: int = 0, height: int = 0):
+        super().__init__(config)
+        self.width, self.height = int(width), int(height)
+
+    def new_stream(self, width: int = None, height: int = None):
+        if width is not None:
+            self.width = int(width)
+        if height is not None:
+            self.height = int(height)
+
+    def execute(self, frame: Sequence[FrameType]) -> Sequence[FrameType]:
+        frames = jnp.asarray(np.asarray(frame))
+        out = np.asarray(_resize_impl(frames, self.height, self.width))
+        return list(out)
+
+
+def _gaussian_kernel1d(ksize: int, sigma: float) -> np.ndarray:
+    r = (ksize - 1) / 2.0
+    x = np.arange(ksize, dtype=np.float32) - r
+    k = np.exp(-(x ** 2) / (2.0 * max(sigma, 1e-6) ** 2))
+    return (k / k.sum()).astype(np.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("ksize",))
+def _blur_impl(frames: jnp.ndarray, kern: jnp.ndarray, ksize: int):
+    # separable gaussian via depthwise conv; frames (b,h,w,c) float32
+    b, h, w, c = frames.shape
+    x = frames.astype(jnp.float32).transpose(0, 3, 1, 2).reshape(b * c, 1, h, w)
+    pad = ksize // 2
+    kx = kern.reshape(1, 1, 1, ksize)
+    ky = kern.reshape(1, 1, ksize, 1)
+    x = jnp.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)), mode="edge")
+    x = jax.lax.conv_general_dilated(x, kx, (1, 1), "VALID")
+    x = jax.lax.conv_general_dilated(x, ky, (1, 1), "VALID")
+    x = x.reshape(b, c, h, w).transpose(0, 2, 3, 1)
+    return jnp.clip(jnp.round(x), 0, 255).astype(jnp.uint8)
+
+
+@register_op(device=DeviceType.TPU, batch=16)
+class Blur(Kernel):
+    """Gaussian blur (reference tests/test_ops.cpp:239 Blur)."""
+
+    def __init__(self, config, kernel_size: int = 3, sigma: float = 0.5):
+        super().__init__(config)
+        self.ksize = int(kernel_size) | 1  # odd
+        self.kern = jnp.asarray(_gaussian_kernel1d(self.ksize, float(sigma)))
+
+    def execute(self, frame: Sequence[FrameType]) -> Sequence[FrameType]:
+        frames = jnp.asarray(np.asarray(frame))
+        out = np.asarray(_blur_impl(frames, self.kern, self.ksize))
+        return list(out)
+
+
+@jax.jit
+def _grayscale(frames: jnp.ndarray) -> jnp.ndarray:
+    w = jnp.asarray([0.299, 0.587, 0.114], jnp.float32)
+    return (frames.astype(jnp.float32) * w).sum(-1)
+
+
+@functools.partial(jax.jit, static_argnames=("iters",))
+def _horn_schunck(prev: jnp.ndarray, nxt: jnp.ndarray, iters: int = 16,
+                  alpha: float = 15.0):
+    """Classic Horn-Schunck optical flow, batched; (b,h,w) grayscale in,
+    (b,h,w,2) float32 flow out.  Fixed-iteration lax.scan keeps the whole
+    solve inside one XLA program (no data-dependent control flow)."""
+    Ix = (jnp.roll(prev, -1, 2) - jnp.roll(prev, 1, 2)) * 0.5
+    Iy = (jnp.roll(prev, -1, 1) - jnp.roll(prev, 1, 1)) * 0.5
+    It = nxt - prev
+
+    avg_k = jnp.asarray([[1 / 12, 1 / 6, 1 / 12],
+                         [1 / 6, 0.0, 1 / 6],
+                         [1 / 12, 1 / 6, 1 / 12]], jnp.float32)
+
+    def avg(x):
+        b, h, w = x.shape
+        xp = jnp.pad(x[:, None], ((0, 0), (0, 0), (1, 1), (1, 1)),
+                     mode="edge")
+        return jax.lax.conv_general_dilated(
+            xp, avg_k[None, None], (1, 1), "VALID")[:, 0]
+
+    denom = alpha ** 2 + Ix ** 2 + Iy ** 2
+
+    def step(carry, _):
+        u, v = carry
+        ub, vb = avg(u), avg(v)
+        t = (Ix * ub + Iy * vb + It) / denom
+        return (ub - Ix * t, vb - Iy * t), None
+
+    (u, v), _ = jax.lax.scan(step, (jnp.zeros_like(Ix), jnp.zeros_like(Iy)),
+                             None, length=iters)
+    return jnp.stack([u, v], axis=-1)
+
+
+@register_op(device=DeviceType.TPU, stencil=[-1, 0], batch=4)
+class OpticalFlow(Kernel):
+    """Dense optical flow between consecutive frames (reference scannertools
+    OpticalFlow / test_ops.cpp:63, StenciledKernel).  Output per row:
+    float32 (H, W, 2) flow from the previous frame to the current."""
+
+    def execute(self, frame: Sequence[Sequence[FrameType]]
+                ) -> Sequence[FrameType]:
+        prev = jnp.asarray(np.stack([w[0] for w in frame]))
+        nxt = jnp.asarray(np.stack([w[1] for w in frame]))
+        flow = np.asarray(_horn_schunck(_grayscale(prev), _grayscale(nxt)))
+        return list(flow)

@@ -165,13 +165,18 @@ class VideoDescriptor:
 class ColumnDescriptor:
     name: str
     type: ColumnType = ColumnType.BYTES
+    # row codec: "raw" (bytes as written), "pickle" (python objects),
+    # "video" (encoded frames)
+    codec: str = "raw"
 
     def to_dict(self) -> dict:
-        return {"name": self.name, "type": int(self.type)}
+        return {"name": self.name, "type": int(self.type),
+                "codec": self.codec}
 
     @classmethod
     def from_dict(cls, d: dict) -> "ColumnDescriptor":
-        return cls(name=d["name"], type=ColumnType(d["type"]))
+        return cls(name=d["name"], type=ColumnType(d["type"]),
+                   codec=d.get("codec", "raw"))
 
 
 @dataclass

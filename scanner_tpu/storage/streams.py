@@ -1,0 +1,148 @@
+"""User-facing stored stream handles.
+
+Capability parity: reference scannerpy/storage.py — StorageBackend/
+StoredStream (:19,81), NamedStorage/NamedStream (:187,250),
+NamedVideoStorage/NamedVideoStream (:221,304), NullElement handling.
+
+A stored stream is one column of one table.  NamedStream is the blob flavor,
+NamedVideoStream the keyframe-indexed video flavor (decodes on load).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..common import NullElement, ScannerException
+from . import metadata as md
+from .database import Database
+
+
+class StoredStream:
+    """Base: a named, typed, committed-or-not stream of rows."""
+
+    is_video = False
+
+    def __init__(self, sc, name: str):
+        # sc is a Client or anything exposing ._db (a Database)
+        self._sc = sc
+        self.name = name
+
+    @property
+    def db(self) -> Database:
+        return self._sc._db if hasattr(self._sc, "_db") else self._sc
+
+    # -- engine-facing ------------------------------------------------------
+
+    @property
+    def column(self) -> str:
+        return "output"
+
+    def exists(self) -> bool:
+        return self.db.has_table(self.name)
+
+    def committed(self) -> bool:
+        return self.db.table_is_committed(self.name)
+
+    def len(self) -> int:
+        return self.db.table_descriptor(self.name).num_rows
+
+    def __len__(self) -> int:
+        return self.len()
+
+    def delete(self) -> None:
+        self.db.delete_table(self.name)
+
+    # -- reading ------------------------------------------------------------
+
+    def load_bytes(self, rows: Optional[Sequence[int]] = None
+                   ) -> Iterator[Optional[bytes]]:
+        desc = self.db.table_descriptor(self.name)
+        col = self.column if self.column in desc.column_names() \
+            else next(c for c in desc.column_names() if c != "index")
+        yield from self.db.load_column(self.name, col, rows=rows)
+
+    def load(self, rows: Optional[Sequence[int]] = None,
+             column: Optional[str] = None) -> Iterator[Any]:
+        """Deserialize rows (reference StoredStream.load, storage.py:135)."""
+        desc = self.db.table_descriptor(self.name)
+        col = column or (
+            self.column if self.column in desc.column_names()
+            else next(c for c in desc.column_names() if c != "index"))
+        codec = None
+        for c in desc.columns:
+            if c.name == col:
+                codec = getattr(c, "codec", "pickle")
+        for blob in self.db.load_column(self.name, col, rows=rows):
+            if blob is None:
+                yield NullElement()
+            elif codec == "pickle":
+                yield pickle.loads(blob)
+            else:
+                yield blob
+
+
+class NamedStream(StoredStream):
+    """Blob stream stored in a named table (reference NamedStream)."""
+
+
+class NamedVideoStream(StoredStream):
+    """Keyframe-indexed video stream (reference NamedVideoStream:304).
+
+    With `path=`, the video is ingested lazily at first use
+    (reference storage.py:235 auto-ingest).
+    """
+
+    is_video = True
+
+    def __init__(self, sc, name: str, path: Optional[str] = None,
+                 inplace: bool = False):
+        super().__init__(sc, name)
+        self._path = path
+        self._inplace = inplace
+
+    @property
+    def column(self) -> str:
+        return "frame"
+
+    def ensure_ingested(self) -> None:
+        if self._path is not None and not self.exists():
+            from ..video import ingest_videos
+            ingest_videos(self.db, [(self.name, self._path)],
+                          inplace=self._inplace)
+
+    def len(self) -> int:
+        self.ensure_ingested()
+        return super().len()
+
+    def estimate_size(self) -> int:
+        self.ensure_ingested()
+        vd = self._video_meta()
+        return int(vd.width * vd.height * 3)
+
+    def _video_meta(self) -> md.VideoDescriptor:
+        from ..video import load_video_meta
+        return load_video_meta(self.db, self.name, self.column)
+
+    def load(self, rows: Optional[Sequence[int]] = None) -> Iterator[Any]:
+        """Decode frames (reference NamedVideoStream.load via hwang)."""
+        self.ensure_ingested()
+        desc = self.db.table_descriptor(self.name)
+        if desc.column_type(self.column) != md.ColumnType.VIDEO:
+            yield from super().load(rows=rows)
+            return
+        from ..video.ingest import iter_frames
+        if rows is None:
+            rows = range(desc.num_rows)
+        yield from iter_frames(self.db, self.name, list(rows), self.column)
+
+    def save_mp4(self, path: str) -> None:
+        from ..video import export_mp4
+        export_mp4(self.db, self.name, path, self.column)
+
+    def as_hwang(self):  # pragma: no cover - reference-compat shim
+        raise ScannerException(
+            "as_hwang is CUDA-reference-specific; use load() instead")

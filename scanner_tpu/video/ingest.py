@@ -102,13 +102,68 @@ def open_automata(db: Database, table, column: str = "frame",
 
 def load_frames(db: Database, table, rows: Sequence[int],
                 column: str = "frame") -> np.ndarray:
-    """Client-side exact frame read (reference storage.py NamedVideoStream
-    .load / as_hwang)."""
-    auto = open_automata(db, table, column)
+    """Client-side exact frame read across item boundaries (reference
+    storage.py NamedVideoStream.load / as_hwang).  Rows are global display
+    indices; job-output tables store one independently-decodable video item
+    per task."""
+    desc = db.table_descriptor(table)
+    rows_l = [int(r) for r in rows]
+    if not rows_l:
+        vd0 = load_video_meta(db, table, column, 0)
+        return np.zeros((0, vd0.height, vd0.width, 3), np.uint8)
+    by_item: dict = {}
+    for r in rows_l:
+        item = desc.item_of_row(r)
+        start, _ = desc.item_bounds(item)
+        by_item.setdefault(item, []).append(r - start)
+    frames: dict = {}
+    for item, local in by_item.items():
+        start, _ = desc.item_bounds(item)
+        vd = md.VideoDescriptor.deserialize(
+            db.backend.read(md.video_meta_path(desc.id, column, item)))
+        auto = DecoderAutomata(db.backend, vd,
+                               md.column_item_path(desc.id, column, item))
+        try:
+            got = auto.get_frames(local)
+        finally:
+            auto.close()
+        for lr, f in zip(local, got):
+            frames[start + lr] = f
+    return np.stack([frames[r] for r in rows_l])
+
+
+def iter_frames(db: Database, table, rows: Sequence[int],
+                column: str = "frame", chunk: int = 64):
+    """Yield decoded frames in request order, keeping one DecoderAutomata
+    per item alive across chunks (streaming flavor of load_frames)."""
+    desc = db.table_descriptor(table)
+    rows_l = [int(r) for r in rows]
+    autos: dict = {}
     try:
-        return auto.get_frames(rows)
+        for i in range(0, len(rows_l), chunk):
+            part = rows_l[i:i + chunk]
+            by_item: dict = {}
+            for r in part:
+                it = desc.item_of_row(r)
+                start, _ = desc.item_bounds(it)
+                by_item.setdefault(it, []).append(r - start)
+            frames: dict = {}
+            for it, local in by_item.items():
+                start, _ = desc.item_bounds(it)
+                if it not in autos:
+                    vd = md.VideoDescriptor.deserialize(db.backend.read(
+                        md.video_meta_path(desc.id, column, it)))
+                    autos[it] = DecoderAutomata(
+                        db.backend, vd,
+                        md.column_item_path(desc.id, column, it))
+                got = autos[it].get_frames(local)
+                for lr, f in zip(local, got):
+                    frames[start + lr] = f
+            for r in part:
+                yield frames[r]
     finally:
-        auto.close()
+        for a in autos.values():
+            a.close()
 
 
 def export_mp4(db: Database, table, out_path: str,

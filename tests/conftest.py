@@ -1,9 +1,10 @@
 import os
 
 # Tests always run on a virtual 8-device CPU mesh so multi-chip sharding
-# logic is exercised without TPU hardware.  bench.py does NOT import this —
-# it runs on the real chip.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# logic is exercised without TPU hardware (the ambient environment may point
+# JAX_PLATFORMS at a real chip — override it).  bench.py does NOT import
+# this — it runs on the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

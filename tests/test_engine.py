@@ -1,0 +1,571 @@
+"""End-to-end engine tests — the equivalent of the reference's
+tests/py_test.py executable spec (sampling, spacing, slicing, state,
+stencil, python kernels, compression, multiple outputs...).
+"""
+
+import os
+import pickle
+import struct
+import tempfile
+import time
+from typing import Any, Sequence
+
+import numpy as np
+import pytest
+
+import scanner_tpu
+from scanner_tpu import (CacheMode, Client, DeviceType, FrameType, Kernel,
+                         NamedStream, NamedVideoStream, NullElement,
+                         PerfParams, ScannerException, SliceList,
+                         register_op)
+import scanner_tpu.kernels  # registers Histogram/Resize/Blur/OpticalFlow
+from scanner_tpu import video as scv
+
+N_FRAMES = 96
+W, H = 128, 96
+
+
+@pytest.fixture(scope="module")
+def sc(tmp_path_factory):
+    root = tmp_path_factory.mktemp("engine")
+    vid1 = str(root / "v1.mp4")
+    vid2 = str(root / "v2.mp4")
+    scv.synthesize_video(vid1, num_frames=N_FRAMES, width=W, height=H,
+                         fps=24, keyint=12)
+    scv.synthesize_video(vid2, num_frames=48, width=W, height=H, fps=24,
+                         keyint=12)
+    client = Client(db_path=str(root / "db"))
+    client.ingest_videos([("test1", vid1), ("test2", vid2)])
+    client.ingest_videos([("test1_inplace", vid1)], inplace=True)
+    yield client
+    client.stop()
+
+
+def expected_id(r):
+    return scv.frame_pattern_id(scv.frame_pattern(r, H, W))
+
+
+# ---------------------------------------------------------------------------
+
+
+def test_table_properties(sc):
+    t = sc.table("test1")
+    assert t.name() == "test1"
+    assert t.num_rows() == N_FRAMES
+    assert t.column_names() == ["index", "frame"]
+
+
+def test_load_video_column(sc):
+    for name in ["test1", "test1_inplace"]:
+        frame = next(NamedVideoStream(sc, name).load())
+        assert frame.shape == (H, W, 3)
+
+
+def test_gather_video_column(sc):
+    rows = [0, 10, 50, 90]
+    frames = list(NamedVideoStream(sc, "test1").load(rows=rows))
+    assert len(frames) == 4
+    for f, r in zip(frames, rows):
+        assert scv.frame_pattern_id(f) == expected_id(r)
+
+
+def test_new_table(sc):
+    sc.new_table("test", ["col1", "col2"],
+                 [[b"r00", b"r01"], [b"r10", b"r11"]], overwrite=True)
+    t = sc.table("test")
+    assert t.num_rows() == 2
+    assert next(t.column("col2").load()) == b"r01"
+
+
+def test_summarize(sc):
+    sc.summarize()
+
+
+def test_histogram_e2e(sc):
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    hist = sc.ops.Histogram(frame=frame)
+    out = NamedStream(sc, "hist_out")
+    sc.run(sc.io.Output(hist, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    hists = list(out.load())
+    assert len(hists) == N_FRAMES
+    h0 = hists[0]
+    assert len(h0) == 3 and h0[0].shape == (16,)
+    assert int(h0[0].sum()) == W * H  # every pixel lands in one bin
+    # frame 0 has R == 0 everywhere -> all R pixels in bin 0
+    assert h0[0][0] == W * H
+
+
+def test_sample(sc):
+    def run_sampler(build, expected):
+        frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+        sampled = build(frame)
+        out = NamedVideoStream(sc, "sample_out")
+        sc.run(sc.io.Output(sampled, [out]), PerfParams.estimate(),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+        assert out.len() == expected
+
+    run_sampler(lambda f: sc.streams.Stride(f, [{"stride": 8}]),
+                (N_FRAMES + 7) // 8)
+    run_sampler(lambda f: sc.streams.Range(f, [(0, 30)]), 30)
+    run_sampler(lambda f: sc.streams.StridedRange(f, [(0, 90, 10)]), 9)
+    run_sampler(lambda f: sc.streams.Gather(f, [[0, 50, 77]]), 3)
+
+
+def test_sample_content_exact(sc):
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    sampled = sc.streams.Gather(frame, [[3, 40, 71]])
+    out = NamedVideoStream(sc, "gather_out")
+    sc.run(sc.io.Output(sampled, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    got = list(out.load())
+    for f, r in zip(got, [3, 40, 71]):
+        assert scv.frame_pattern_id(f) == expected_id(r)
+
+
+def test_space(sc):
+    spacing = 8
+    # Repeat
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    hist = sc.ops.Histogram(frame=frame)
+    spaced = sc.streams.Repeat(hist, [spacing])
+    out = NamedStream(sc, "space_out")
+    sc.run(sc.io.Output(spaced, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    rows = list(out.load())
+    assert len(rows) == N_FRAMES * spacing
+    for i, hist_v in enumerate(rows):
+        ref = rows[(i // spacing) * spacing]
+        assert len(hist_v) == 3
+        for c in range(3):
+            assert (ref[c] == hist_v[c]).all()
+
+    # RepeatNull
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    hist = sc.ops.Histogram(frame=frame)
+    spaced = sc.streams.RepeatNull(hist, [spacing])
+    out = NamedStream(sc, "space_null_out")
+    sc.run(sc.io.Output(spaced, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    rows = list(out.load())
+    assert len(rows) == N_FRAMES * spacing
+    for i, v in enumerate(rows):
+        if i % spacing == 0:
+            assert not isinstance(v, NullElement)
+            assert v[0].shape[0] == 16
+        else:
+            assert isinstance(v, NullElement)
+
+
+def test_stream_args(sc):
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    resized = sc.ops.Resize(frame=frame, width=[64], height=[48])
+    sampled = sc.streams.Range(resized, [(0, 10)])
+    out = NamedVideoStream(sc, "resize_out")
+    sc.run(sc.io.Output(sampled, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    frames = list(out.load())
+    assert len(frames) == 10
+    assert frames[0].shape == (48, 64, 3)
+
+
+def test_slice(sc):
+    input = NamedVideoStream(sc, "test1")
+    frame = sc.io.Input([input])
+    sliced = sc.streams.Slice(frame, partitions=[sc.partitioner.all(24)])
+    unsliced = sc.streams.Unslice(sliced)
+    out = NamedStream(sc, "slice_out")
+    sc.run(sc.io.Output(unsliced, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    assert out.len() == input.len()
+
+
+def test_overlapping_slice(sc):
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    sliced = sc.streams.Slice(frame, partitions=[
+        sc.partitioner.strided_ranges([(0, 15), (5, 25), (15, 35)], 1)])
+    sampled = sc.streams.Range(sliced, ranges=[SliceList([
+        {"start": 0, "end": 10},
+        {"start": 5, "end": 15},
+        {"start": 5, "end": 15},
+    ])])
+    unsliced = sc.streams.Unslice(sampled)
+    out = NamedVideoStream(sc, "overlap_out")
+    sc.run(sc.io.Output(unsliced, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    assert out.len() == 30
+    got = list(out.load())
+    # group 0 local 0..10 = source 0..10; group 1 local 5..15 = source
+    # 10..20; group 2 local 5..15 = source 20..30
+    expect_rows = list(range(0, 10)) + list(range(10, 20)) + \
+        list(range(20, 30))
+    for f, r in zip(got, expect_rows):
+        assert scv.frame_pattern_id(f) == expected_id(r)
+
+
+@register_op()
+class TestSliceArgs(Kernel):
+    def new_stream(self, arg=None):
+        self.arg = arg
+
+    def execute(self, frame: FrameType) -> Any:
+        return self.arg
+
+
+def test_slice_args(sc):
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    sliced = sc.streams.Slice(frame, [sc.partitioner.ranges(
+        [[0, 1], [1, 2], [2, 3]])])
+    test = sc.ops.TestSliceArgs(frame=sliced,
+                                arg=[SliceList([i for i in range(3)])])
+    unsliced = sc.streams.Unslice(test)
+    out = NamedStream(sc, "slice_args_out")
+    sc.run(sc.io.Output(unsliced, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    assert list(out.load()) == [0, 1, 2]
+
+
+@register_op(bounded_state=3)
+class TestIncrementBounded(Kernel):
+    def __init__(self, config):
+        super().__init__(config)
+        self.reset()
+
+    def reset(self):
+        self.x = 0
+
+    def execute(self, ignore: FrameType) -> bytes:
+        v = self.x
+        self.x += 1
+        return struct.pack("=q", v)
+
+
+def test_bounded_state(sc):
+    warmup = 3
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    increment = sc.ops.TestIncrementBounded(ignore=frame)
+    sampled = sc.streams.Gather(increment, indices=[[0, 10, 25, 26, 27]])
+    out = NamedStream(sc, "bounded_out")
+    sc.run(sc.io.Output(sampled, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    expected = [0, warmup, warmup, warmup + 1, warmup + 2]
+    got = [struct.unpack("=q", b)[0] for b in out.load()]
+    assert got == expected
+
+
+@register_op(unbounded_state=True)
+class TestIncrementUnbounded(Kernel):
+    def __init__(self, config):
+        super().__init__(config)
+        self.reset()
+
+    def reset(self):
+        self.x = 0
+
+    def execute(self, ignore: FrameType) -> bytes:
+        v = self.x
+        self.x += 1
+        return struct.pack("=q", v)
+
+
+def test_unbounded_state(sc):
+    input = NamedVideoStream(sc, "test1")
+    frame = sc.io.Input([input])
+    sliced = sc.streams.Slice(frame, partitions=[sc.partitioner.all(24)])
+    increment = sc.ops.TestIncrementUnbounded(ignore=sliced)
+    unsliced = sc.streams.Unslice(increment)
+    out = NamedStream(sc, "unbounded_out")
+    sc.run(sc.io.Output(unsliced, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    assert out.len() == input.len()
+    got = [struct.unpack("=q", b)[0] for b in out.load()]
+    # state resets at each slice-group boundary
+    assert got == [i % 24 for i in range(N_FRAMES)]
+
+
+def test_stencil(sc):
+    input = NamedVideoStream(sc, "test1")
+
+    def flow_job(build, expected_len):
+        frame = sc.io.Input([input])
+        col = build(frame)
+        out = NamedStream(sc, "stencil_out")
+        sc.run(sc.io.Output(col, [out]),
+               PerfParams.estimate(pipeline_instances_per_node=1),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+        assert out.len() == expected_len
+        return list(out.load())
+
+    rows = flow_job(
+        lambda f: sc.ops.OpticalFlow(
+            frame=sc.streams.Range(f, [(0, 1)]), stencil=[-1, 0]), 1)
+    assert rows[0].shape == (H, W, 2)
+    flow_job(lambda f: sc.ops.OpticalFlow(
+        frame=sc.streams.Range(f, [(0, 1)]), stencil=[0, 1]), 1)
+    flow_job(lambda f: sc.ops.OpticalFlow(
+        frame=sc.streams.Range(f, [(0, 2)]), stencil=[0, 1]), 2)
+    flow_job(lambda f: sc.streams.Range(
+        sc.ops.OpticalFlow(frame=f, stencil=[-1, 0]), [(0, 1)]), 1)
+
+
+def test_wider_than_packet_stencil(sc):
+    input = NamedVideoStream(sc, "test1")
+    frame = sc.io.Input([input])
+    sampled = sc.streams.Range(frame, [(0, 3)])
+    flow = sc.ops.OpticalFlow(frame=sampled, stencil=[0, 1])
+    out = NamedStream(sc, "stencil_out2")
+    sc.run(sc.io.Output(flow, [out]),
+           PerfParams.manual(1, 1, pipeline_instances_per_node=1),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    assert out.len() == 3
+
+
+@register_op()
+class TestPy(Kernel):
+    def __init__(self, config, kernel_arg):
+        super().__init__(config)
+        assert kernel_arg == 1
+        self.x, self.y = 20, 20
+
+    def new_stream(self, x=None, y=None):
+        if x is not None:
+            self.x, self.y = x, y
+
+    def execute(self, frame: FrameType) -> Any:
+        return {"x": self.x, "y": self.y}
+
+
+def test_python_kernel(sc):
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    sampled = sc.streams.Range(frame, [(0, 3)])
+    test_out = sc.ops.TestPy(frame=sampled, kernel_arg=1, x=[0], y=[0])
+    out = NamedStream(sc, "py_out")
+    sc.run(sc.io.Output(test_out, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    assert next(out.load()) == {"x": 0, "y": 0}
+
+
+def test_bind_op_args(sc):
+    input = NamedVideoStream(sc, "test1")
+    frame = sc.io.Input([input, input])
+    sampled = sc.streams.Range(frame, [(0, 1), (0, 1)])
+    test_out = sc.ops.TestPy(frame=sampled, kernel_arg=1, x=[1, 10],
+                             y=[5, 50])
+    outs = [NamedStream(sc, "py_out_0"), NamedStream(sc, "py_out_1")]
+    sc.run(sc.io.Output(test_out, outs), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    for i, (x, y) in enumerate([(1, 5), (10, 50)]):
+        assert next(outs[i].load()) == {"x": x, "y": y}
+
+
+_fetch_counter_path = [None]
+
+
+@register_op()
+class ResourceTest(Kernel):
+    def __init__(self, config, path):
+        super().__init__(config)
+        self.path = path
+
+    def fetch_resources(self):
+        with open(self.path, "r") as f:
+            n = int(f.read())
+        with open(self.path, "w") as f:
+            f.write(str(n + 1))
+
+    def setup_with_resources(self):
+        with open(self.path, "r") as f:
+            assert int(f.read()) == 1
+
+    def execute(self, frame: FrameType) -> Any:
+        return None
+
+
+def test_fetch_resources(sc):
+    with tempfile.NamedTemporaryFile(mode="w", suffix=".cnt",
+                                     delete=False) as f:
+        f.write("0")
+        path = f.name
+    try:
+        frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+        sampled = sc.streams.Range(frame, [(0, 3)])
+        t = sc.ops.ResourceTest(frame=sampled, path=path)
+        out = NamedStream(sc, "fetch_out")
+        sc.run(sc.io.Output(t, [out]), PerfParams.estimate(),
+               cache_mode=CacheMode.Overwrite, show_progress=False,
+               pipeline_instances=2)
+        with open(path) as f:
+            assert f.read() == "1"
+    finally:
+        os.unlink(path)
+
+
+@register_op(batch=50)
+class TestPyBatch(Kernel):
+    def execute(self, frame: Sequence[FrameType]) -> Sequence[bytes]:
+        return [b"point" for _ in range(len(frame))]
+
+
+def test_python_batch_kernel(sc):
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    sampled = sc.streams.Range(frame, [(0, 30)])
+    t = sc.ops.TestPyBatch(frame=sampled, batch=50)
+    out = NamedStream(sc, "batch_out")
+    sc.run(sc.io.Output(t, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    rows = list(out.load())
+    assert len(rows) == 30 and rows[0] == b"point"
+
+
+@register_op(stencil=[0, 1])
+class TestPyStencil(Kernel):
+    def execute(self, frame: Sequence[FrameType]) -> bytes:
+        assert len(frame) == 2
+        return b"point"
+
+
+def test_python_stencil_kernel(sc):
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    sampled = sc.streams.Range(frame, [(0, 30)])
+    t = sc.ops.TestPyStencil(frame=sampled)
+    out = NamedStream(sc, "stencil_py_out")
+    sc.run(sc.io.Output(t, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    assert len(list(out.load())) == 30
+
+
+@register_op(stencil=[0, 1], batch=50)
+class TestPyStencilBatch(Kernel):
+    def execute(self, frame: Sequence[Sequence[FrameType]]
+                ) -> Sequence[bytes]:
+        assert len(frame[0]) == 2
+        return [b"point" for _ in range(len(frame))]
+
+
+def test_python_stencil_batch_kernel(sc):
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    sampled = sc.streams.Range(frame, [(0, 30)])
+    t = sc.ops.TestPyStencilBatch(frame=sampled, batch=50)
+    out = NamedStream(sc, "stencil_batch_out")
+    sc.run(sc.io.Output(t, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    assert len(list(out.load())) == 30
+
+
+@register_op()
+class TestPyVariadic(Kernel):
+    def execute(self, *frame: FrameType) -> FrameType:
+        assert len(frame) == 3
+        return frame[0]
+
+
+def test_py_variadic(sc):
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    sampled = sc.streams.Range(frame, [(0, 10)])
+    t = sc.ops.TestPyVariadic(sampled, sampled, sampled)
+    out = NamedVideoStream(sc, "variadic_out")
+    sc.run(sc.io.Output(t.lossless(), [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    assert len(list(out.load())) == 10
+
+
+def test_multiple_outputs(sc):
+    def run_job(r1, r2):
+        frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+        s1 = sc.streams.Range(frame, [r1])
+        s2 = sc.streams.Range(frame, [r2])
+        o1 = sc.io.Output(s1, [NamedVideoStream(sc, "mp_1")])
+        o2 = sc.io.Output(s2, [NamedVideoStream(sc, "mp_2")])
+        sc.run([o1, o2], PerfParams.estimate(),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+
+    with pytest.raises(ScannerException):
+        run_job((0, 30), (0, 15))
+
+    run_job((0, 30), (30, 60))
+    assert sc.table("mp_1").num_rows() == 30
+    assert sc.table("mp_2").num_rows() == 30
+    got = list(NamedVideoStream(sc, "mp_2").load(rows=[0]))
+    assert scv.frame_pattern_id(got[0]) == expected_id(30)
+
+
+def test_lossless_and_compress(sc):
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    sampled = sc.streams.Range(frame, [(0, 30)])
+    blurred = sc.ops.Blur(frame=sampled, kernel_size=3, sigma=0.1)
+    out = NamedVideoStream(sc, "blur_out")
+    sc.run(sc.io.Output(blurred.lossless(), [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    next(out.load())
+
+    out2 = NamedVideoStream(sc, "blur_out2")
+    sc.run(sc.io.Output(blurred.compress("video", bitrate=1024 * 1024),
+                        [out2]),
+           PerfParams.estimate(), cache_mode=CacheMode.Overwrite,
+           show_progress=False)
+    next(out2.load())
+
+
+def test_save_mp4(sc, tmp_path):
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    sampled = sc.streams.Range(frame, [(0, 30)])
+    blurred = sc.ops.Blur(frame=sampled, kernel_size=3, sigma=0.1)
+    out = NamedVideoStream(sc, "save_mp4_out")
+    sc.run(sc.io.Output(blurred, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    p = str(tmp_path / "out.mp4")
+    out.save_mp4(p)
+    vd = scv.ingest_file(p, None)
+    assert vd.num_frames == 30
+
+
+def test_cache_mode(sc):
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    hist = sc.ops.Histogram(frame=frame)
+    out = NamedStream(sc, "cache_out")
+    sc.run(sc.io.Output(hist, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    with pytest.raises(ScannerException):
+        frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+        hist = sc.ops.Histogram(frame=frame)
+        sc.run(sc.io.Output(hist, [out]), PerfParams.estimate(),
+               show_progress=False)
+    # Ignore: skipped silently
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    hist = sc.ops.Histogram(frame=frame)
+    sc.run(sc.io.Output(hist, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Ignore, show_progress=False)
+
+
+def test_profiler(sc):
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    hist = sc.ops.Histogram(frame=frame)
+    ghist = sc.streams.Gather(hist, [[0]])
+    out = NamedStream(sc, "prof_out")
+    job_id = sc.run(sc.io.Output(ghist, [out]), PerfParams.estimate(),
+                    cache_mode=CacheMode.Overwrite, show_progress=False)
+    profile = sc.get_profile(job_id)
+    with tempfile.NamedTemporaryFile(suffix=".trace", delete=False) as f:
+        path = f.name
+    try:
+        profile.write_trace(path)
+        import json
+        with open(path) as fh:
+            trace = json.load(fh)
+        assert len(trace["traceEvents"]) > 0
+        stats = profile.statistics()
+        assert any(k.startswith("evaluate") for k in stats)
+    finally:
+        os.unlink(path)
+
+
+def test_auto_ingest(sc, tmp_path):
+    p = str(tmp_path / "auto.mp4")
+    scv.synthesize_video(p, num_frames=24, width=64, height=48, fps=24)
+    stream = NamedVideoStream(sc, "auto_ingested", path=p)
+    frame = sc.io.Input([stream])
+    hist = sc.ops.Histogram(frame=frame)
+    out = NamedStream(sc, "auto_hist")
+    sc.run(sc.io.Output(hist, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    assert out.len() == 24
