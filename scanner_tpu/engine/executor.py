@@ -93,6 +93,20 @@ class LocalExecutor:
             jobs.append(self._prepare_job(info, j, perf, cache_mode))
         return info, jobs
 
+    def prepare_readonly(self, outputs: Sequence[O.OpNode], perf: PerfParams
+                         ) -> Tuple[A.GraphInfo, List[JobContext]]:
+        """Worker-side preparation: identical analysis but output tables
+        were already created by the master — look them up instead of
+        creating (reference workers re-run DAG analysis, worker.cpp:1013)."""
+        info = A.analyze(outputs)
+        perf = self._estimate_perf(info, perf)
+        jobs: List[JobContext] = []
+        for j in range(info.num_jobs):
+            jobs.append(self._prepare_job(info, j, perf,
+                                          CacheMode.Overwrite,
+                                          create_tables=False))
+        return info, jobs
+
     def _estimate_perf(self, info: A.GraphInfo, perf: PerfParams
                        ) -> PerfParams:
         if not getattr(perf, "_estimate", False):
@@ -109,13 +123,16 @@ class LocalExecutor:
         return perf
 
     def _prepare_job(self, info: A.GraphInfo, j: int, perf: PerfParams,
-                     cache_mode: CacheMode) -> JobContext:
+                     cache_mode: CacheMode,
+                     create_tables: bool = True) -> JobContext:
         # resolve sources
         source_info: Dict[int, Dict[str, Any]] = {}
         source_rows: Dict[int, int] = {}
         fps = 30.0
         for n in info.sources:
             stream: StoredStream = n.extra["streams"][j]
+            if getattr(stream, "_sc", False) is None:
+                stream.bind(self.db)  # arrived via RPC unbound
             if isinstance(stream, NamedVideoStream):
                 stream.ensure_ingested()
             if not stream.committed():
@@ -148,8 +165,25 @@ class LocalExecutor:
         sink_names = []
         for sink in info.sinks:
             out_stream = sink.extra["streams"][j]
+            if getattr(out_stream, "_sc", False) is None:
+                out_stream.bind(self.db)
             sink_names.append(out_stream.name if hasattr(out_stream, "name")
                               else str(out_stream))
+        if not create_tables:
+            sink_tables = {}
+            for sink, name in zip(info.sinks, sink_names):
+                if not self.db.has_table(name):
+                    continue  # job skipped by the master
+                src_col = sink.input_columns()[0]
+                codec = self._codec_for(src_col)
+                desc = self.db.table_descriptor(name)
+                enc = dict(sink.extra.get("encode_options") or {})
+                sink_tables[sink.id] = (desc, desc.columns[0].name, codec,
+                                        enc)
+            return JobContext(job_idx=j, jr=jr, tasks=tasks,
+                              source_info=source_info,
+                              sink_tables=sink_tables, fps=fps,
+                              skipped=not sink_tables)
         if cache_mode == CacheMode.Ignore and all(
                 self.db.table_is_committed(nm) for nm in sink_names):
             return JobContext(job_idx=j, jr=jr, tasks=tasks,
@@ -230,23 +264,30 @@ class LocalExecutor:
 
         def loader():
             try:
-                while not stop.is_set():
-                    try:
-                        w: TaskItem = task_q.get_nowait()
-                    except queue.Empty:
-                        break
-                    with self.profiler.span("load", task=w.task_idx,
-                                            job=w.job.job_idx):
-                        w.plan = A.derive_task_streams(
-                            info, w.job.jr, w.output_range,
-                            job_idx=w.job.job_idx, task_idx=w.task_idx)
-                        w.elements = self._load_sources(w, tls)
+                try:
                     while not stop.is_set():
                         try:
-                            eval_q.put(w, timeout=0.25)
+                            w: TaskItem = task_q.get_nowait()
+                        except queue.Empty:
                             break
-                        except queue.Full:
-                            pass
+                        with self.profiler.span("load", task=w.task_idx,
+                                                job=w.job.job_idx):
+                            w.plan = A.derive_task_streams(
+                                info, w.job.jr, w.output_range,
+                                job_idx=w.job.job_idx, task_idx=w.task_idx)
+                            w.elements = self._load_sources(w, tls)
+                        while not stop.is_set():
+                            try:
+                                eval_q.put(w, timeout=0.25)
+                                break
+                            except queue.Full:
+                                pass
+                finally:
+                    # release decoder handles held by this loader thread
+                    for auto in getattr(tls, "automata", {}).values():
+                        auto.close()
+                    if hasattr(tls, "automata"):
+                        tls.automata = {}
             except BaseException as e:  # noqa: BLE001
                 record_err(e)
 
@@ -411,6 +452,15 @@ class LocalExecutor:
                 mode = "video" if self._is_encodable(rows) else "pickle"
                 with w.job.sink_mode_lock:
                     prev = w.job.sink_modes.setdefault(sink.id, mode)
+                    if prev == mode:
+                        # cross-worker guard: the first writer durably
+                        # records the mode; others must agree (distributed
+                        # savers share no process state)
+                        marker = f"{md.table_dir(desc.id)}/.{col_name}.mode"
+                        if self.db.backend.exists(marker):
+                            prev = self.db.backend.read(marker).decode()
+                        else:
+                            self.db.backend.write(marker, mode.encode())
                     if prev != mode:
                         raise JobException(
                             f"{desc.name}: mixed frame output types across "

@@ -1,0 +1,128 @@
+"""Minimal gRPC plumbing: named methods with msgpack-serialized dict
+payloads.
+
+Capability parity: the reference's control plane (scanner/engine/rpc.proto
+service Master/Worker + grpc glue in util/grpc.h).  Instead of protoc
+codegen, methods are registered dynamically on a generic handler — the
+message schema lives in the handlers, serialization is msgpack (numpy-aware,
+via storage.metadata pack/unpack).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent import futures
+from typing import Any, Callable, Dict, Optional
+
+import grpc
+
+from ..common import ScannerException
+from ..storage.metadata import pack, unpack
+
+GRPC_OPTIONS = [
+    ("grpc.max_send_message_length", 1 << 30),
+    ("grpc.max_receive_message_length", 1 << 30),
+]
+
+
+class RpcError(ScannerException):
+    pass
+
+
+class _GenericService(grpc.GenericRpcHandler):
+    def __init__(self, service_name: str,
+                 methods: Dict[str, Callable[[dict], dict]]):
+        self._prefix = f"/{service_name}/"
+        self._methods = methods
+
+    def service(self, handler_call_details):
+        name = handler_call_details.method
+        if not name.startswith(self._prefix):
+            return None
+        method = self._methods.get(name[len(self._prefix):])
+        if method is None:
+            return None
+
+        def unary(request: bytes, context) -> bytes:
+            try:
+                return pack(method(unpack(request)))
+            except Exception as e:  # noqa: BLE001
+                context.set_code(grpc.StatusCode.INTERNAL)
+                context.set_details(f"{type(e).__name__}: {e}")
+                return b""
+
+        return grpc.unary_unary_rpc_method_handler(unary)
+
+
+class RpcServer:
+    """One gRPC server hosting one named service."""
+
+    def __init__(self, service_name: str,
+                 methods: Dict[str, Callable[[dict], dict]],
+                 port: int = 0, max_workers: int = 8):
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=GRPC_OPTIONS)
+        self._server.add_generic_rpc_handlers(
+            (_GenericService(service_name, methods),))
+        self.port = self._server.add_insecure_port(f"0.0.0.0:{port}")
+        if self.port == 0:
+            raise RpcError(f"could not bind port {port}")
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self, grace: float = 0.5) -> None:
+        self._server.stop(grace)
+
+
+class RpcClient:
+    """Stub for a remote service; call(method, **payload) -> dict."""
+
+    def __init__(self, address: str, service_name: str,
+                 timeout: float = 30.0):
+        self.address = address
+        self._service = service_name
+        self._timeout = timeout
+        self._channel = grpc.insecure_channel(address, options=GRPC_OPTIONS)
+
+    def call(self, method: str, timeout: Optional[float] = None,
+             **payload) -> dict:
+        fn = self._channel.unary_unary(
+            f"/{self._service}/{method}",
+            request_serializer=lambda x: x,
+            response_deserializer=lambda x: x)
+        try:
+            raw = fn(pack(payload), timeout=timeout or self._timeout)
+        except grpc.RpcError as e:
+            raise RpcError(
+                f"{self._service}.{method} @ {self.address}: "
+                f"{e.code().name}: {e.details()}") from e
+        return unpack(raw)
+
+    def try_call(self, method: str, timeout: Optional[float] = None,
+                 **payload) -> Optional[dict]:
+        """call() that returns None on transport errors (for pings)."""
+        try:
+            return self.call(method, timeout=timeout, **payload)
+        except RpcError:
+            return None
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+def wait_for_server(address: str, service: str, method: str = "Ping",
+                    timeout: float = 10.0) -> None:
+    c = RpcClient(address, service, timeout=2.0)
+    deadline = time.time() + timeout
+    try:
+        while time.time() < deadline:
+            if c.try_call(method) is not None:
+                return
+            time.sleep(0.1)
+        raise RpcError(f"{service} at {address} not reachable "
+                       f"after {timeout}s")
+    finally:
+        c.close()

@@ -1,0 +1,636 @@
+"""Distributed master/worker services.
+
+Capability parity: reference scanner/engine/master.{h,cpp} +
+worker.{h,cpp} + rpc.proto — dynamic task distribution (NextWork/
+FinishedWork), worker liveness pinger with strike-out removal, per-task
+timeout, job blacklisting after repeated task failures, elastic worker join,
+client watchdog, progress reporting.
+
+Differences from the reference, chosen deliberately:
+  * Fully pull-based: the master never dials workers.  Workers heartbeat and
+    pull tasks; a joining worker starts pulling immediately (elastic join
+    without the reference's unstarted_workers dance, master.cpp:514-560).
+  * The job spec travels as one cloudpickle blob (graph + resolved
+    PerfParams), so there are no op/kernel registration RPCs
+    (ListLoadedOps etc., worker.cpp:882-937) — the graph is self-contained.
+  * Bulk data never crosses RPC: workers read/write shared storage, master
+    owns all metadata writes — same storage-mediated data plane as the
+    reference (SURVEY §2.7).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+import cloudpickle
+
+from ..common import CacheMode, JobException, PerfParams, ScannerException
+from ..storage import Database, make_storage
+from ..util.profiler import Profiler
+from . import rpc
+from .evaluate import TaskEvaluator
+from .executor import LocalExecutor, TaskItem
+
+PING_INTERVAL = 1.0          # worker heartbeat period
+WORKER_STALE_AFTER = 6.0     # master: no heartbeat -> worker removed
+MAX_TASK_FAILURES = 3        # reference master.cpp:2131 blacklist threshold
+MASTER_SERVICE = "scanner.Master"
+WORKER_SERVICE = "scanner.Worker"
+
+
+# ---------------------------------------------------------------------------
+# Master
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _WorkerInfo:
+    worker_id: int
+    address: str
+    last_seen: float
+    active: bool = True
+
+
+@dataclass
+class _BulkJob:
+    bulk_id: int
+    spec_blob: bytes                    # graph + resolved perf + cache mode
+    task_timeout: float
+    queue: List[Tuple[int, int]] = field(default_factory=list)
+    outstanding: Dict[Tuple[int, int], Tuple[int, float]] = \
+        field(default_factory=dict)
+    done: Set[Tuple[int, int]] = field(default_factory=set)
+    failures: Dict[Tuple[int, int], int] = field(default_factory=dict)
+    blacklisted_jobs: Set[int] = field(default_factory=set)
+    total_tasks: int = 0
+    job_tasks: Dict[int, Set[Tuple[int, int]]] = field(default_factory=dict)
+    # job idx -> output table names, resolved at admission so completion
+    # commits never deserialize the graph under the control-plane lock
+    job_sink_names: Dict[int, List[str]] = field(default_factory=dict)
+    committed_jobs: Set[int] = field(default_factory=set)
+    finished: bool = False
+    error: str = ""
+    profiles: List[dict] = field(default_factory=list)
+
+
+class Master:
+    """The cluster control plane; also the single metadata writer."""
+
+    def __init__(self, db_path: str, port: int = 0,
+                 no_workers_timeout: float = 30.0,
+                 enable_watchdog: bool = False,
+                 storage_type: str = "posix"):
+        self.db = Database(make_storage(storage_type, db_path=db_path))
+        self.no_workers_timeout = no_workers_timeout
+        self.enable_watchdog = enable_watchdog
+        self._lock = threading.RLock()
+        self._admit_lock = threading.Lock()
+        self._workers: Dict[int, _WorkerInfo] = {}
+        self._next_worker_id = 0
+        self._next_bulk_id = 0
+        self._bulk: Optional[_BulkJob] = None
+        self._history: Dict[int, _BulkJob] = {}
+        self._last_poke = time.time()
+        self._shutdown = threading.Event()
+        self._server = rpc.RpcServer(MASTER_SERVICE, {
+            "Ping": self._rpc_ping,
+            "RegisterWorker": self._rpc_register_worker,
+            "Heartbeat": self._rpc_heartbeat,
+            "NewJob": self._rpc_new_job,
+            "GetJob": self._rpc_get_job,
+            "NextWork": self._rpc_next_work,
+            "FinishedWork": self._rpc_finished_work,
+            "FailedWork": self._rpc_failed_work,
+            "GetJobStatus": self._rpc_job_status,
+            "PokeWatchdog": self._rpc_poke,
+            "PostProfile": self._rpc_post_profile,
+            "GetProfiles": self._rpc_get_profiles,
+            "Shutdown": self._rpc_shutdown,
+        }, port=port)
+        self.port = self._server.port
+        self._server.start()
+        self._scan_thread = threading.Thread(
+            target=self._scan_loop, name="master-scan", daemon=True)
+        self._scan_thread.start()
+
+    # -- rpc handlers -------------------------------------------------------
+
+    def _rpc_ping(self, req: dict) -> dict:
+        return {"ok": True}
+
+    def _rpc_register_worker(self, req: dict) -> dict:
+        with self._lock:
+            wid = self._next_worker_id
+            self._next_worker_id += 1
+            self._workers[wid] = _WorkerInfo(
+                wid, req.get("address", ""), time.time())
+        return {"worker_id": wid}
+
+    def _rpc_heartbeat(self, req: dict) -> dict:
+        wid = req["worker_id"]
+        with self._lock:
+            w = self._workers.get(wid)
+            if w is None or not w.active:
+                # stale worker rejoining after removal: re-register
+                return {"reregister": True, "active_bulk": None}
+            w.last_seen = time.time()
+            active = self._bulk.bulk_id \
+                if self._bulk and not self._bulk.finished else None
+        return {"reregister": False, "active_bulk": active}
+
+    def _rpc_new_job(self, req: dict) -> dict:
+        """Admit a bulk job: resolve perf, create output tables, build the
+        task queue (reference master.cpp:1367 process_job).  The admission
+        lock serializes concurrent NewJob calls end-to-end — prepare()
+        mutates database metadata and must not interleave."""
+        with self._admit_lock:
+            with self._lock:
+                if self._bulk is not None and not self._bulk.finished:
+                    return {"error": "a bulk job is already active"}
+            spec = cloudpickle.loads(req["spec"])
+            outputs = spec["outputs"]
+            perf: PerfParams = spec["perf"]
+            cache_mode = CacheMode(spec["cache_mode"])
+            ex = LocalExecutor(self.db)
+            try:
+                info, jobs = ex.prepare(outputs, perf, cache_mode)
+            except Exception as e:  # noqa: BLE001
+                return {"error": f"{type(e).__name__}: {e}"}
+            with self._lock:
+                bulk = _BulkJob(
+                    bulk_id=self._next_bulk_id,
+                    spec_blob=cloudpickle.dumps(
+                        {"outputs": outputs, "perf": perf,
+                         "cache_mode": cache_mode.value}),
+                    task_timeout=float(getattr(perf, "task_timeout", 0.0)))
+                self._next_bulk_id += 1
+                for job in jobs:
+                    if job.skipped:
+                        continue
+                    tasks = {(job.job_idx, t) for t in range(len(job.tasks))}
+                    bulk.job_tasks[job.job_idx] = tasks
+                    bulk.job_sink_names[job.job_idx] = [
+                        d.name for d, _c, _k, _e in job.sink_tables.values()]
+                    bulk.queue.extend(sorted(tasks))
+                    bulk.total_tasks += len(tasks)
+                self._bulk = bulk
+                self._no_worker_since = time.time()
+                if bulk.total_tasks == 0:
+                    bulk.finished = True
+                self._history[bulk.bulk_id] = bulk
+                return {"bulk_id": bulk.bulk_id}
+
+    def _rpc_get_job(self, req: dict) -> dict:
+        with self._lock:
+            bulk = self._history.get(req["bulk_id"])
+            if bulk is None:
+                return {"error": "unknown bulk job"}
+            return {"spec": bulk.spec_blob}
+
+    def _touch_worker(self, wid) -> None:
+        w = self._workers.get(wid)
+        if w is not None and w.active:
+            w.last_seen = time.time()
+
+    def _rpc_next_work(self, req: dict) -> dict:
+        wid = req["worker_id"]
+        bulk_id = req["bulk_id"]
+        with self._lock:
+            self._touch_worker(wid)
+            bulk = self._bulk
+            if bulk is None or bulk.bulk_id != bulk_id or bulk.finished:
+                return {"status": "none"}
+            w = self._workers.get(wid)
+            if w is None or not w.active:
+                return {"status": "none"}
+            while bulk.queue:
+                j, t = bulk.queue.pop(0)
+                if j in bulk.blacklisted_jobs or (j, t) in bulk.done:
+                    continue
+                bulk.outstanding[(j, t)] = (wid, time.time())
+                return {"status": "task", "job_idx": j, "task_idx": t}
+            if bulk.outstanding:
+                return {"status": "wait"}
+            return {"status": "done"}
+
+    def _rpc_finished_work(self, req: dict) -> dict:
+        key = (req["job_idx"], req["task_idx"])
+        with self._lock:
+            self._touch_worker(req.get("worker_id"))
+            bulk = self._bulk
+            if bulk is None or bulk.bulk_id != req["bulk_id"]:
+                return {"ok": False}
+            # a completion only counts if this worker still holds the
+            # assignment — revoked (timed-out/reassigned) attempts are
+            # ignored, the in-process equivalent of the reference killing
+            # the slow worker (stop_job_on_worker, master.cpp:2111)
+            holder = bulk.outstanding.get(key, (None, 0.0))[0]
+            if holder != req.get("worker_id"):
+                return {"ok": False, "revoked": True}
+            bulk.outstanding.pop(key, None)
+            if key in bulk.done or key[0] in bulk.blacklisted_jobs:
+                return {"ok": True}
+            bulk.done.add(key)
+            self._maybe_finish_job(bulk, key[0])
+            self._maybe_finish_bulk(bulk)
+        return {"ok": True}
+
+    def _rpc_failed_work(self, req: dict) -> dict:
+        key = (req["job_idx"], req["task_idx"])
+        err = req.get("error", "")
+        with self._lock:
+            self._touch_worker(req.get("worker_id"))
+            bulk = self._bulk
+            if bulk is None or bulk.bulk_id != req["bulk_id"]:
+                return {"ok": False}
+            holder = bulk.outstanding.get(key, (None, 0.0))[0]
+            if holder != req.get("worker_id"):
+                return {"ok": False, "revoked": True}
+            bulk.outstanding.pop(key, None)
+            if key in bulk.done:
+                return {"ok": True}
+            n = bulk.failures.get(key, 0) + 1
+            bulk.failures[key] = n
+            if n >= MAX_TASK_FAILURES:
+                # job blacklisting (reference master.cpp:2161-2191): one
+                # poison stream cannot sink the bulk job
+                self._blacklist_job(bulk, key[0], err)
+            else:
+                bulk.queue.append(key)
+            self._maybe_finish_bulk(bulk)
+        return {"ok": True}
+
+    def _rpc_job_status(self, req: dict) -> dict:
+        with self._lock:
+            bulk = self._history.get(req["bulk_id"]) \
+                if req.get("bulk_id") is not None else self._bulk
+            if bulk is None:
+                return {"error": "no such bulk job"}
+            return {
+                "finished": bulk.finished,
+                "tasks_done": len(bulk.done),
+                "total_tasks": bulk.total_tasks,
+                "failed_jobs": sorted(bulk.blacklisted_jobs),
+                "error": bulk.error,
+                "num_workers": sum(1 for w in self._workers.values()
+                                   if w.active),
+            }
+
+    def _rpc_poke(self, req: dict) -> dict:
+        self._last_poke = time.time()
+        return {"ok": True}
+
+    def _rpc_post_profile(self, req: dict) -> dict:
+        with self._lock:
+            bulk = self._history.get(req["bulk_id"])
+            if bulk is not None:
+                bulk.profiles.append(req["profile"])
+        return {"ok": True}
+
+    def _rpc_get_profiles(self, req: dict) -> dict:
+        with self._lock:
+            bulk = self._history.get(req["bulk_id"])
+            return {"profiles": list(bulk.profiles) if bulk else []}
+
+    def _rpc_shutdown(self, req: dict) -> dict:
+        self._shutdown.set()
+        return {"ok": True}
+
+    # -- internals ----------------------------------------------------------
+
+    def _blacklist_job(self, bulk: _BulkJob, j: int, err: str) -> None:
+        bulk.blacklisted_jobs.add(j)
+        bulk.queue = [k for k in bulk.queue if k[0] != j]
+        for k in [k for k in bulk.outstanding if k[0] == j]:
+            bulk.outstanding.pop(k)
+        if not bulk.error:
+            bulk.error = f"job {j} blacklisted after repeated failures: {err}"
+
+    def _maybe_finish_job(self, bulk: _BulkJob, j: int) -> None:
+        if j in bulk.committed_jobs or j in bulk.blacklisted_jobs:
+            return
+        if bulk.job_tasks[j] <= bulk.done:
+            # all tasks of this output stream finished: commit its tables
+            # (reference: tables committed per job, master.cpp:1031-1125)
+            for name in bulk.job_sink_names.get(j, []):
+                if self.db.has_table(name):
+                    self.db.commit_table(name)
+            bulk.committed_jobs.add(j)
+
+    def _maybe_finish_bulk(self, bulk: _BulkJob) -> None:
+        active = {k for s in bulk.job_tasks.items()
+                  if s[0] not in bulk.blacklisted_jobs for k in s[1]}
+        if active <= bulk.done and not bulk.outstanding:
+            bulk.finished = True
+            self.db.write_megafile()
+
+    def _scan_loop(self) -> None:
+        """Liveness + timeout scanning (reference start_worker_pinger
+        master.cpp:1837 and timeout scan master.cpp:1751-1776)."""
+        while not self._shutdown.is_set():
+            time.sleep(0.5)
+            now = time.time()
+            with self._lock:
+                # stale workers -> deactivate + requeue their tasks
+                for w in self._workers.values():
+                    if w.active and now - w.last_seen > WORKER_STALE_AFTER:
+                        w.active = False
+                        self._requeue_worker_tasks(w.worker_id)
+                bulk = self._bulk
+                if bulk is not None and not bulk.finished:
+                    # per-task timeout
+                    if bulk.task_timeout > 0:
+                        for key, (wid, t0) in list(bulk.outstanding.items()):
+                            if now - t0 > bulk.task_timeout:
+                                bulk.outstanding.pop(key)
+                                n = bulk.failures.get(key, 0) + 1
+                                bulk.failures[key] = n
+                                if n >= MAX_TASK_FAILURES:
+                                    self._blacklist_job(
+                                        bulk, key[0], "task timeout")
+                                else:
+                                    bulk.queue.append(key)
+                        self._maybe_finish_bulk(bulk)
+                    # no workers at all
+                    if not any(w.active for w in self._workers.values()):
+                        if now - self._no_worker_since > \
+                                self.no_workers_timeout:
+                            bulk.error = (
+                                f"no workers available after "
+                                f"{self.no_workers_timeout}s")
+                            bulk.finished = True
+                    else:
+                        self._no_worker_since = now
+                if self.enable_watchdog and \
+                        now - self._last_poke > 30.0:
+                    self._shutdown.set()
+
+    def _requeue_worker_tasks(self, wid: int) -> None:
+        bulk = self._bulk
+        if bulk is None or bulk.finished:
+            return
+        for key, (owner, _t0) in list(bulk.outstanding.items()):
+            if owner == wid:
+                bulk.outstanding.pop(key)
+                bulk.queue.append(key)
+
+    def wait_for_shutdown(self) -> None:
+        while not self._shutdown.is_set():
+            time.sleep(0.2)
+        self._server.stop()
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        self._server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Worker
+# ---------------------------------------------------------------------------
+
+class Worker:
+    """Executes tasks pulled from the master; one process per node.
+
+    Capability parity: reference WorkerImpl (worker.cpp) — job admission,
+    local DAG re-analysis, task execution, failure reporting.
+    """
+
+    def __init__(self, master_address: str, db_path: str, port: int = 0,
+                 storage_type: str = "posix",
+                 num_load_workers: int = 2, num_save_workers: int = 2):
+        self.db = Database(make_storage(storage_type, db_path=db_path))
+        self.master = rpc.RpcClient(master_address, MASTER_SERVICE,
+                                    timeout=10.0)
+        self.profiler = Profiler(node="worker")
+        self._shutdown = threading.Event()
+        self._server = rpc.RpcServer(WORKER_SERVICE, {
+            "Ping": lambda req: {"ok": True},
+            "Shutdown": self._rpc_shutdown,
+        }, port=port)
+        self.port = self._server.port
+        self._server.start()
+        self.executor = LocalExecutor(self.db, self.profiler,
+                                      num_load_workers=num_load_workers,
+                                      num_save_workers=num_save_workers)
+        rpc.wait_for_server(master_address, MASTER_SERVICE)
+        self.worker_id = self.master.call(
+            "RegisterWorker", address=f"localhost:{self.port}")["worker_id"]
+        # cached per-bulk state
+        self._bulk_id: Optional[int] = None
+        self._info = None
+        self._jobs = None
+        self._evaluator: Optional[TaskEvaluator] = None
+        self._posted_profiles: set = set()
+        # heartbeat runs on its own thread so a long task never makes the
+        # master think this worker died (stale-worker scan)
+        self._hb_reply: dict = {}
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, name="worker-hb", daemon=True)
+        self._hb_thread.start()
+        self._work_thread = threading.Thread(
+            target=self._work_loop, name="worker-loop", daemon=True)
+        self._work_thread.start()
+
+    def _heartbeat_loop(self) -> None:
+        while not self._shutdown.is_set():
+            hb = self.master.try_call("Heartbeat", worker_id=self.worker_id)
+            if hb is not None:
+                if hb.get("reregister"):
+                    reg = self.master.try_call(
+                        "RegisterWorker",
+                        address=f"localhost:{self.port}")
+                    if reg:
+                        self.worker_id = reg["worker_id"]
+                else:
+                    self._hb_reply = hb
+            time.sleep(PING_INTERVAL)
+
+    def _rpc_shutdown(self, req: dict) -> dict:
+        self._shutdown.set()
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+
+    def _work_loop(self) -> None:
+        while not self._shutdown.is_set():
+            bulk_id = self._hb_reply.get("active_bulk")
+            if bulk_id is None:
+                time.sleep(PING_INTERVAL / 4)
+                continue
+            try:
+                self._ensure_bulk(bulk_id)
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+                time.sleep(PING_INTERVAL)
+                continue
+            self._pull_and_run(bulk_id)
+            self._post_profile(bulk_id)
+
+    def _post_profile(self, bulk_id: int) -> None:
+        """Ship this worker's profile to the master once per bulk job
+        (reference: worker profile files, worker.cpp:2067-2138)."""
+        if bulk_id in self._posted_profiles:
+            return
+        self._posted_profiles.add(bulk_id)
+        self.master.try_call("PostProfile", bulk_id=bulk_id,
+                             profile=self.profiler.to_dict())
+
+    def _ensure_bulk(self, bulk_id: int) -> None:
+        if self._bulk_id == bulk_id:
+            return
+        spec = cloudpickle.loads(
+            self.master.call("GetJob", bulk_id=bulk_id)["spec"])
+        # master created tables after our metadata cache was filled
+        self.db.refresh_meta()
+        outputs = spec["outputs"]
+        perf = spec["perf"]
+        # fresh profiler per bulk so PostProfile ships only this job's spans
+        self.profiler = Profiler(node=f"worker{self.worker_id}")
+        self.executor.profiler = self.profiler
+        info, jobs = self.executor.prepare_readonly(outputs, perf)
+        if self._evaluator is not None:
+            self._evaluator.close()
+        self._evaluator = TaskEvaluator(info, self.profiler)
+        self._info, self._jobs = info, jobs
+        self._bulk_id = bulk_id
+
+    def _pull_and_run(self, bulk_id: int) -> None:
+        tls = threading.local()
+        try:
+            self._pull_loop(bulk_id, tls)
+        finally:
+            # release decoder handles held for this bulk
+            for auto in getattr(tls, "automata", {}).values():
+                auto.close()
+
+    def _pull_loop(self, bulk_id: int, tls) -> None:
+        while not self._shutdown.is_set():
+            if self._hb_reply.get("active_bulk") != bulk_id:
+                return  # bulk finished or superseded
+            reply = self.master.try_call("NextWork",
+                                         worker_id=self.worker_id,
+                                         bulk_id=bulk_id)
+            if reply is None or reply["status"] in ("none", "done"):
+                return
+            if reply["status"] == "wait":
+                time.sleep(0.2)
+                continue
+            j, t = reply["job_idx"], reply["task_idx"]
+            try:
+                with self.profiler.span("task", job=j, task=t):
+                    job = self._jobs[j]
+                    w = TaskItem(job, t, job.tasks[t])
+                    from ..graph import analysis as A
+                    w.plan = A.derive_task_streams(
+                        self._info, job.jr, w.output_range, job_idx=j,
+                        task_idx=t)
+                    w.elements = self.executor._load_sources(w, tls)
+                    w.results = self._evaluator.execute_task(
+                        job.jr, w.plan, w.elements)
+                    self.executor._save_task(self._info, w)
+                self.master.try_call("FinishedWork", bulk_id=bulk_id,
+                                     worker_id=self.worker_id,
+                                     job_idx=j, task_idx=t)
+            except Exception as e:  # noqa: BLE001
+                traceback.print_exc()
+                self.master.try_call(
+                    "FailedWork", bulk_id=bulk_id,
+                    worker_id=self.worker_id, job_idx=j, task_idx=t,
+                    error=f"{type(e).__name__}: {e}")
+
+    def wait_for_shutdown(self) -> None:
+        while not self._shutdown.is_set():
+            time.sleep(0.2)
+        self.stop()
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        self._server.stop()
+        if self._evaluator is not None:
+            self._evaluator.close()
+        self.master.close()
+
+
+# ---------------------------------------------------------------------------
+# Client side
+# ---------------------------------------------------------------------------
+
+class ClusterClient:
+    """Submits bulk jobs to a master and polls progress
+    (reference Client.run gRPC path + _start_heartbeat, client.py:324)."""
+
+    def __init__(self, master_address: str, db: Database,
+                 enable_watchdog: bool = False, poll_interval: float = 0.25,
+                 **_kw):
+        self.db = db
+        self.master = rpc.RpcClient(master_address, MASTER_SERVICE)
+        self.poll_interval = poll_interval
+        self._watchdog_stop = threading.Event()
+        if enable_watchdog:
+            t = threading.Thread(target=self._poke_loop, daemon=True)
+            t.start()
+
+    def _poke_loop(self) -> None:
+        while not self._watchdog_stop.is_set():
+            self.master.try_call("PokeWatchdog")
+            time.sleep(5.0)
+
+    def run(self, outputs, perf: PerfParams, cache_mode: CacheMode,
+            show_progress: bool) -> List[Profiler]:
+        spec = cloudpickle.dumps({
+            "outputs": list(outputs), "perf": perf,
+            "cache_mode": cache_mode.value})
+        reply = self.master.call("NewJob", spec=spec, timeout=120.0)
+        if "error" in reply:
+            raise JobException(reply["error"])
+        bulk_id = reply["bulk_id"]
+        while True:
+            st = self.master.call("GetJobStatus", bulk_id=bulk_id)
+            if show_progress:
+                print(f"\rtasks {st['tasks_done']}/{st['total_tasks']} "
+                      f"workers={st['num_workers']}", end="", flush=True)
+            if st.get("finished"):
+                if show_progress:
+                    print()
+                self.db.refresh_meta()
+                if st.get("error"):
+                    raise JobException(st["error"])
+                if st.get("failed_jobs"):
+                    raise JobException(
+                        f"jobs failed: {st['failed_jobs']}")
+                # workers post profiles right after their last task; give
+                # them a beat, then collect what arrived
+                time.sleep(2 * self.poll_interval)
+                reply = self.master.try_call("GetProfiles",
+                                             bulk_id=bulk_id) or {}
+                return [Profiler.from_dict(d)
+                        for d in reply.get("profiles", [])]
+            time.sleep(self.poll_interval)
+
+    def close(self) -> None:
+        self._watchdog_stop.set()
+        self.master.close()
+
+
+# ---------------------------------------------------------------------------
+# Process entry points (reference scannerpy start_master/start_worker,
+# client.py:1593/1651, tests/spawn_worker.py)
+# ---------------------------------------------------------------------------
+
+def start_master(db_path: str, port: int = 5000, block: bool = False,
+                 **kw) -> Master:
+    m = Master(db_path=db_path, port=port, **kw)
+    if block:
+        m.wait_for_shutdown()
+    return m
+
+
+def start_worker(master_address: str, db_path: str, port: int = 0,
+                 block: bool = False, **kw) -> Worker:
+    w = Worker(master_address, db_path=db_path, port=port, **kw)
+    if block:
+        w.wait_for_shutdown()
+    return w

@@ -33,7 +33,21 @@ class StoredStream:
 
     @property
     def db(self) -> Database:
+        if self._sc is None:
+            raise ScannerException(
+                f"stream {self.name} is unbound; it traveled over RPC and "
+                f"must be re-bound to a Database first")
         return self._sc._db if hasattr(self._sc, "_db") else self._sc
+
+    def bind(self, db: Database) -> None:
+        self._sc = db
+
+    def __getstate__(self) -> dict:
+        # streams travel to the master/workers inside cloudpickled graphs;
+        # the Client (grpc channels etc.) must not come along
+        d = self.__dict__.copy()
+        d["_sc"] = None
+        return d
 
     # -- engine-facing ------------------------------------------------------
 

@@ -1,0 +1,12 @@
+"""Standalone worker process for fault-tolerance tests
+(reference tests/spawn_worker.py)."""
+
+import sys
+
+from scanner_tpu.engine.service import start_worker
+
+if __name__ == "__main__":
+    master = sys.argv[1]
+    db_path = sys.argv[2]
+    port = int(sys.argv[3]) if len(sys.argv) > 3 else 0
+    start_worker(master, db_path=db_path, port=port, block=True)
