@@ -1,0 +1,62 @@
+"""Benchmark: end-to-end histogram pipeline, frames/sec/chip.
+
+BASELINE.json's metric is "frames/sec/chip (pose-detect + histogram
+pipelines)".  The reference repo publishes no numbers (BASELINE.md); the
+SIGGRAPH 2018 paper's GPU histogram throughput is on the order of 1000
+frames/sec/GPU, used here as the nominal baseline for vs_baseline.
+
+Runs on whatever JAX platform the environment provides (the real TPU chip
+under the driver).  Prints ONE JSON line.
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+BASELINE_FPS = 1000.0
+N_FRAMES = int(os.environ.get("BENCH_FRAMES", "600"))
+W, H = 640, 480
+
+
+def main():
+    root = tempfile.mkdtemp(prefix="scbench_")
+    try:
+        from scanner_tpu import (CacheMode, Client, NamedStream,
+                                 NamedVideoStream, PerfParams)
+        import scanner_tpu.kernels  # registers Histogram
+
+        vid = os.path.join(root, "bench.mp4")
+        from scanner_tpu import video as scv
+        scv.synthesize_video(vid, num_frames=N_FRAMES, width=W, height=H,
+                             fps=30, keyint=30)
+        sc = Client(db_path=os.path.join(root, "db"),
+                    num_load_workers=3, num_save_workers=1)
+        sc.ingest_videos([("bench", vid)])
+
+        def run_once(name):
+            frame = sc.io.Input([NamedVideoStream(sc, "bench")])
+            hist = sc.ops.Histogram(frame=frame)
+            out = NamedStream(sc, name)
+            t0 = time.time()
+            sc.run(sc.io.Output(hist, [out]), PerfParams.manual(32, 96),
+                   cache_mode=CacheMode.Overwrite, show_progress=False)
+            return time.time() - t0
+
+        run_once("warmup")        # compile + cache warm
+        dt = run_once("bench_out")
+        fps = N_FRAMES / dt
+        print(json.dumps({
+            "metric": "histogram_pipeline_throughput",
+            "value": round(fps, 2),
+            "unit": "frames/sec/chip",
+            "vs_baseline": round(fps / BASELINE_FPS, 4),
+        }))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""Tutorial 00: ingest a video, compute per-frame color histograms, read
+them back.  (Reference: examples/tutorials/00_basic.py.)
+
+Usage: python examples/00_basic.py path/to/video.mp4 [db_path]
+"""
+
+import sys
+
+from scanner_tpu import (CacheMode, Client, NamedStream, NamedVideoStream,
+                         PerfParams)
+import scanner_tpu.kernels  # registers the stdlib ops (Histogram, ...)
+
+
+def main():
+    video_path = sys.argv[1]
+    db_path = sys.argv[2] if len(sys.argv) > 2 else "/tmp/scanner_tpu_db"
+    sc = Client(db_path=db_path)
+
+    # declare the input stream; ingests (indexes) the file on first use
+    movie = NamedVideoStream(sc, "example_movie", path=video_path)
+
+    # build the computation graph: Input -> Histogram -> Output
+    frames = sc.io.Input([movie])
+    hists = sc.ops.Histogram(frame=frames)
+    out = NamedStream(sc, "example_hists")
+    sc.run(sc.io.Output(hists, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite)
+
+    for i, h in enumerate(out.load()):
+        if i < 3:
+            print(f"frame {i}: R-hist {h[0].tolist()}")
+    print(f"... {out.len()} histograms total")
+
+
+if __name__ == "__main__":
+    main()

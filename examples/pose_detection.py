@@ -1,0 +1,35 @@
+"""Pose detection app: per-frame keypoints over a sampled stream.
+(Reference: examples/apps/pose_detection/main.py.)
+
+Usage: python examples/pose_detection.py path/to/video.mp4 [stride]
+"""
+
+import sys
+
+from scanner_tpu import (CacheMode, Client, NamedStream, NamedVideoStream,
+                         PerfParams)
+import scanner_tpu.models  # registers PoseDetect
+
+
+def main():
+    video_path = sys.argv[1]
+    stride = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+    sc = Client(db_path="/tmp/scanner_tpu_db")
+    movie = NamedVideoStream(sc, "pose_movie", path=video_path)
+
+    frames = sc.io.Input([movie])
+    sampled = sc.streams.Stride(frames, [{"stride": stride}])
+    poses = sc.ops.PoseDetect(frame=sampled)
+    out = NamedStream(sc, "poses")
+    sc.run(sc.io.Output(poses, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite)
+
+    for i, kp in enumerate(out.load()):
+        if i < 3:
+            print(f"sampled frame {i}: {kp.shape[0]} keypoints, "
+                  f"top score {kp[:, 2].max():.3f}")
+    print(f"... {out.len()} frames processed")
+
+
+if __name__ == "__main__":
+    main()

@@ -202,6 +202,12 @@ class Client:
         self._job_profiles[job_id] = [prof]
         return job_id
 
+    def load_frames(self, table: str, rows, column: str = "frame"):
+        """Decode exact frames of a stored video stream (public accessor
+        for the client-side read path, reference storage.py load)."""
+        from ..video import load_frames
+        return load_frames(self._db, table, rows, column)
+
     def get_profile(self, job_id: int) -> Profile:
         if job_id not in self._job_profiles:
             raise ScannerException(f"no profile for job {job_id}")

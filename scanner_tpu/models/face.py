@@ -1,0 +1,65 @@
+"""Face detection and embedding.
+
+Capability parity: reference examples/apps/face_detection (MTCNN-style
+kernel) and the multi-worker face-embedding baseline config
+(BASELINE.json config 5).  Detection reuses the SSD family with a
+face-tuned anchor set; embeddings come from a compact backbone + projection
+head with L2-normalized output.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import DeviceType, FrameType
+from ..graph.ops import Kernel, register_op
+from .detection import ObjectDetect
+from .nets import Backbone
+
+
+@register_op(name="FaceDetect", device=DeviceType.TPU, batch=8)
+class FaceDetect(ObjectDetect):
+    """SSD detector with face-tuned defaults (reference face_detection
+    app)."""
+
+    def __init__(self, config, width: int = 32, score_thresh: float = 0.1,
+                 seed: int = 1):
+        super().__init__(config, width=width, num_classes=2,
+                         score_thresh=score_thresh, seed=seed)
+
+
+class EmbeddingNet(nn.Module):
+    dim: int = 128
+    width: int = 32
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, images):
+        feat = Backbone(width=self.width, dtype=self.dtype)(images)
+        pooled = feat.mean(axis=(1, 2))
+        emb = nn.Dense(self.dim, dtype=jnp.float32)(pooled)
+        return emb / jnp.linalg.norm(emb, axis=-1, keepdims=True)
+
+
+@register_op(device=DeviceType.TPU, batch=16)
+class FaceEmbedding(Kernel):
+    """L2-normalized face/crop embedding vectors (reference face-embedding
+    pipeline, BASELINE config 5)."""
+
+    def __init__(self, config, dim: int = 128, width: int = 32,
+                 seed: int = 2):
+        super().__init__(config)
+        self.model = EmbeddingNet(dim=dim, width=width)
+        self.params = self.model.init(
+            jax.random.PRNGKey(seed), jnp.zeros((1, 128, 128, 3), jnp.uint8))
+        self._apply = jax.jit(self.model.apply)
+
+    def execute(self, frame: Sequence[FrameType]) -> Sequence[Any]:
+        images = jnp.asarray(np.asarray(frame))
+        emb = np.asarray(self._apply(self.params, images))
+        return list(emb)

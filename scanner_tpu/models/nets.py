@@ -1,0 +1,136 @@
+"""Neural building blocks (flax.linen) shared by the model families.
+
+Capability parity: the reference delegates model compute to Caffe2/TF GPU
+kernels inside ops (OpenPose pose app, TF SSD detection app — SURVEY §2.4);
+here models are first-class JAX modules the kernel stdlib wraps.  bfloat16
+activations by default: matmuls/convs land on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ResBlock(nn.Module):
+    ch: int
+    stride: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Conv(self.ch, (3, 3), strides=(self.stride, self.stride),
+                    dtype=self.dtype, padding="SAME")(x)
+        h = nn.GroupNorm(num_groups=8, dtype=self.dtype)(h)
+        h = nn.relu(h)
+        h = nn.Conv(self.ch, (3, 3), dtype=self.dtype, padding="SAME")(h)
+        h = nn.GroupNorm(num_groups=8, dtype=self.dtype)(h)
+        if x.shape[-1] != self.ch or self.stride != 1:
+            x = nn.Conv(self.ch, (1, 1),
+                        strides=(self.stride, self.stride),
+                        dtype=self.dtype)(x)
+        return nn.relu(x + h)
+
+
+class Backbone(nn.Module):
+    """ResNet-lite feature extractor: (B, H, W, 3) -> (B, H/16, W/16, C).
+
+    Stands in for the reference apps' ResNet/VGG backbones (pose app
+    Caffe model, SSD mobilenet) in a TPU-native dress.
+    """
+
+    width: int = 64
+    depths: Sequence[int] = (2, 2, 2)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype) / 255.0
+        x = nn.Conv(self.width, (7, 7), strides=(4, 4), dtype=self.dtype,
+                    padding="SAME")(x)
+        x = nn.GroupNorm(num_groups=8, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        ch = self.width
+        for stage, depth in enumerate(self.depths):
+            for i in range(depth):
+                stride = 2 if (i == 0 and stage > 0) else 1
+                x = ResBlock(ch, stride=stride, dtype=self.dtype)(x)
+            ch *= 2
+        return x  # (B, H/16, W/16, width * 2^(len(depths)-1))
+
+
+class MoEMlp(nn.Module):
+    """Top-1 routed mixture-of-experts MLP over tokens (B, T, C).
+
+    Experts evaluate densely and the router's one-hot selects — compiler
+    friendly (no dynamic gather), fine for small expert counts; gives the
+    framework a real expert-parallel surface (experts shard over 'tp').
+    """
+
+    num_experts: int = 4
+    hidden: int = 256
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        gate = nn.Dense(self.num_experts, dtype=self.dtype, name="router")(x)
+        probs = jax.nn.softmax(gate.astype(jnp.float32), axis=-1)
+        sel = jax.nn.one_hot(jnp.argmax(probs, -1), self.num_experts,
+                             dtype=x.dtype)
+        # experts as one batched params tensor: (E, C, H) and (E, H, C)
+        C = x.shape[-1]
+        w1 = self.param("w1", nn.initializers.lecun_normal(),
+                        (self.num_experts, C, self.hidden)).astype(self.dtype)
+        w2 = self.param("w2", nn.initializers.lecun_normal(),
+                        (self.num_experts, self.hidden, C)).astype(self.dtype)
+        h = jnp.einsum("btc,ech->bteh", x, w1)
+        h = nn.relu(h)
+        y = jnp.einsum("bteh,ehc->btec", h, w2)
+        return jnp.einsum("btec,bte->btc", y, sel)
+
+
+class TemporalBlock(nn.Module):
+    """Pre-norm MHA + MoE-MLP over the time axis of (B, T, C) tokens.
+
+    attn_fn lets callers swap in ring attention (sequence sharded over the
+    'sp' mesh axis) without changing the module."""
+
+    heads: int = 4
+    dtype: Any = jnp.bfloat16
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        B, T, C = x.shape
+        D = C // self.heads
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        qkv = nn.Dense(3 * C, dtype=self.dtype, name="qkv")(h)
+        q, k, v = jnp.split(qkv.reshape(B, T, 3 * self.heads, D), 3, axis=2)
+        if self.attn_fn is not None:
+            att = self.attn_fn(q, k, v)
+        else:
+            from ..parallel.ring_attention import reference_attention
+            att = reference_attention(q, k, v)
+        att = att.reshape(B, T, C)
+        x = x + nn.Dense(C, dtype=self.dtype, name="proj")(att)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        return x + MoEMlp(dtype=self.dtype)(h)
+
+
+class DeconvHead(nn.Module):
+    """SimpleBaseline-style upsampling head producing K heatmaps."""
+
+    keypoints: int = 17
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        for _ in range(2):
+            x = nn.ConvTranspose(128, (4, 4), strides=(2, 2),
+                                 dtype=self.dtype)(x)
+            x = nn.relu(x)
+        return nn.Conv(self.keypoints, (1, 1), dtype=jnp.float32)(x)

@@ -1,0 +1,161 @@
+"""Pose estimation: the flagship model family.
+
+Capability parity: reference examples/apps/pose_detection (OpenPose Caffe
+kernel, main.py:50-56) — rebuilt as a TPU-native video pose network:
+per-frame conv backbone -> temporal attention over the clip (ring attention
+when the time axis is sharded over 'sp') -> MoE mixer -> deconv heatmap
+head.  The train step shards dp (batch), sp (time), tp (channels/experts)
+over one jax Mesh; XLA inserts all collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..common import DeviceType, FrameType
+from ..graph.ops import Kernel, register_op
+from .nets import Backbone, DeconvHead, TemporalBlock
+
+NUM_KEYPOINTS = 17
+
+
+class VideoPoseNet(nn.Module):
+    """(B, T, H, W, 3) uint8 clip -> (B, T, H/4, W/4, K) heatmaps."""
+
+    width: int = 32
+    temporal_layers: int = 2
+    keypoints: int = NUM_KEYPOINTS
+    dtype: Any = jnp.bfloat16
+    attn_fn: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, clip):
+        B, T, H, W, _ = clip.shape
+        frames = clip.reshape(B * T, H, W, 3)
+        feat = Backbone(width=self.width, dtype=self.dtype)(frames)
+        _, fh, fw, C = feat.shape
+        # clip-level context: GAP tokens mixed across time
+        tokens = feat.mean(axis=(1, 2)).reshape(B, T, C)
+        for _ in range(self.temporal_layers):
+            tokens = TemporalBlock(dtype=self.dtype,
+                                   attn_fn=self.attn_fn)(tokens)
+        # FiLM-style broadcast of temporal context back onto spatial maps
+        scale = nn.Dense(C, dtype=self.dtype, name="film")(tokens)
+        feat = feat.reshape(B, T, fh, fw, C)
+        feat = feat * (1.0 + scale[:, :, None, None, :])
+        heat = DeconvHead(keypoints=self.keypoints,
+                          dtype=self.dtype)(feat.reshape(B * T, fh, fw, C))
+        return heat.reshape(B, T, heat.shape[1], heat.shape[2],
+                            self.keypoints)
+
+
+def init_params(rng, clip_shape=(1, 4, 128, 128, 3), **kw):
+    model = VideoPoseNet(**kw)
+    clip = jnp.zeros(clip_shape, jnp.uint8)
+    return model, model.init(rng, clip)
+
+
+def param_shardings(params, mesh: Mesh):
+    """tp-shard the big tensors: dense/conv kernels on their output
+    channel, MoE expert tensors on the expert dim; everything else
+    replicated.  GSPMD propagates the rest."""
+    def spec_for(path, x):
+        name = "/".join(str(p.key) for p in path
+                        if hasattr(p, "key"))
+        if ("w1" in name or "w2" in name) and x.ndim == 3:
+            # MoE experts: expert-parallel over 'tp'
+            return NamedSharding(mesh, P("tp", None, None))
+        if x.ndim == 2 and x.shape[1] % mesh.shape["tp"] == 0:
+            return NamedSharding(mesh, P(None, "tp"))
+        if x.ndim == 4 and x.shape[3] % mesh.shape["tp"] == 0:
+            return NamedSharding(mesh, P(None, None, None, "tp"))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def make_train_step(model: VideoPoseNet, optimizer=None):
+    opt = optimizer or optax.adam(1e-3)
+
+    def loss_fn(params, clip, target):
+        heat = model.apply(params, clip)
+        return jnp.mean((heat - target) ** 2)
+
+    def train_step(params, opt_state, clip, target):
+        loss, grads = jax.value_and_grad(loss_fn)(params, clip, target)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return opt, train_step
+
+
+def make_sharded_train_step(mesh: Mesh, clip_shape=(8, 8, 64, 64, 3),
+                            width: int = 32):
+    """Build the full multi-chip training step: dp-sharded batch,
+    sp-sharded time (ring attention), tp-sharded params/experts.
+    Returns (jitted_step, params, opt_state, example batch)."""
+    from ..parallel.ring_attention import make_ring_attention
+    attn = make_ring_attention(mesh, axis="sp") \
+        if mesh.shape["sp"] > 1 else None
+    model, params = init_params(
+        jax.random.PRNGKey(0),
+        clip_shape=(1,) + tuple(clip_shape[1:]), width=width,
+        attn_fn=attn)
+    opt, step = make_train_step(model)
+    p_shard = param_shardings(params, mesh)
+    params = jax.device_put(params, p_shard)
+    opt_state = opt.init(params)
+    data_spec = NamedSharding(mesh, P("dp", "sp"))
+    B, T = clip_shape[0], clip_shape[1]
+    hm_h, hm_w = clip_shape[2] // 4, clip_shape[3] // 4
+    # deterministic nonzero data so the step exercises real numerics
+    clip = jax.device_put(
+        (np.arange(np.prod(clip_shape)) % 251).astype(np.uint8)
+        .reshape(clip_shape), data_spec)
+    tshape = (B, T, hm_h, hm_w, NUM_KEYPOINTS)
+    target = jax.device_put(
+        np.sin(np.arange(np.prod(tshape))).astype(np.float32)
+        .reshape(tshape), data_spec)
+    jit_step = jax.jit(step, donate_argnums=(0, 1))
+    return jit_step, params, opt_state, (clip, target)
+
+
+# ---------------------------------------------------------------------------
+# Engine op
+# ---------------------------------------------------------------------------
+
+def heatmaps_to_keypoints(heat: np.ndarray) -> np.ndarray:
+    """(h, w, K) heatmaps -> (K, 3) [x, y, score] in heatmap coords."""
+    h, w, K = heat.shape
+    flat = heat.reshape(-1, K)
+    idx = flat.argmax(axis=0)
+    scores = flat[idx, np.arange(K)]
+    ys, xs = np.divmod(idx, w)
+    return np.stack([xs, ys, scores], axis=1).astype(np.float32)
+
+
+@register_op(device=DeviceType.TPU, batch=8)
+class PoseDetect(Kernel):
+    """Per-frame pose keypoints (reference pose_detection app op)."""
+
+    def __init__(self, config, width: int = 32, seed: int = 0):
+        super().__init__(config)
+        self.model, self.params = init_params(
+            jax.random.PRNGKey(seed), clip_shape=(1, 1, 128, 128, 3),
+            width=width)
+        self._apply = jax.jit(self.model.apply)
+
+    def execute(self, frame: Sequence[FrameType]) -> Sequence[Any]:
+        frames = np.asarray(frame)
+        clip = jnp.asarray(frames)[:, None]  # (B, 1, H, W, 3)
+        heat = np.asarray(self._apply(self.params, clip))[:, 0]
+        return [heatmaps_to_keypoints(h) for h in heat]

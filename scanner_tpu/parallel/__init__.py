@@ -1,0 +1,9 @@
+from .mesh import AXIS_ORDER, auto_axes, make_mesh, shard_batch, sharding
+from .halo import sharded_stencil_map, temporal_diff
+from .ring_attention import make_ring_attention, reference_attention
+
+__all__ = [
+    "AXIS_ORDER", "auto_axes", "make_mesh", "shard_batch", "sharding",
+    "sharded_stencil_map", "temporal_diff", "make_ring_attention",
+    "reference_attention",
+]

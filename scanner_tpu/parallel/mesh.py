@@ -1,0 +1,88 @@
+"""Device mesh and sharding helpers.
+
+The reference scales by sharding (job, task) lists over worker processes
+(SURVEY §2.6); the TPU build adds in-program parallelism: a job's kernel can
+itself be a multi-chip XLA program laid out over a jax Mesh, with XLA
+inserting ICI collectives.  These helpers standardize mesh construction and
+axis conventions across the framework:
+
+    dp — data/batch parallel        sp — sequence/context parallel
+    tp — tensor/model parallel      (pp is intentionally absent: the
+                                     engine's task pipeline plays that role)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_ORDER = ("dp", "sp", "tp")
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh over `devices` (default: all) with the given axis
+    sizes; missing axes get size 1, and a single unconstrained axis absorbs
+    the remaining device count."""
+    if devices is None:
+        devices = jax.devices()
+    axes = dict(axes or {})
+    unknown = set(axes) - set(AXIS_ORDER)
+    if unknown:
+        raise ValueError(
+            f"unknown mesh axes {sorted(unknown)}; valid: {AXIS_ORDER}")
+    sizes = [axes.get(a, 0) for a in AXIS_ORDER]
+    known = [s for s in sizes if s > 0]
+    prod = math.prod(known) if known else 1
+    if 0 not in sizes and prod <= len(devices):
+        # fully specified: use a prefix of the device list
+        devices = list(devices)[:prod]
+    n = len(devices)
+    if 0 in sizes:
+        rem = n // prod
+        if prod * rem != n:
+            raise ValueError(
+                f"cannot factor {n} devices into axes {axes}")
+        # the first unspecified axis absorbs the remainder; others get 1
+        seen_unknown = False
+        fixed = []
+        for s in sizes:
+            if s > 0:
+                fixed.append(s)
+            elif not seen_unknown:
+                fixed.append(rem)
+                seen_unknown = True
+            else:
+                fixed.append(1)
+        sizes = fixed
+    if math.prod(sizes) != n:
+        raise ValueError(
+            f"mesh axes {dict(zip(AXIS_ORDER, sizes))} need "
+            f"{math.prod(sizes)} devices, have {n}")
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def auto_axes(n: int) -> Dict[str, int]:
+    """Factor n devices into a balanced (dp, sp, tp) assignment."""
+    def split(x):
+        f = int(math.sqrt(x))
+        while x % f:
+            f -= 1
+        return f, x // f
+    a, rest = split(n)
+    b, c = split(rest)
+    return {"dp": a, "sp": b, "tp": c}
+
+
+def sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_batch(mesh: Mesh, arr, axis: str = "dp"):
+    """Place a host array with its leading dim sharded over one mesh axis."""
+    return jax.device_put(arr, sharding(mesh, axis))

@@ -1,0 +1,99 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+The reference has no in-engine attention (SURVEY §5: "no ring attention /
+Ulysses — no tensor compute exists in-engine"); its long-context machinery is
+stencil/warmup/slice scheduling.  The TPU build adds model kernels, so
+long-sequence attention becomes first-class: K/V blocks rotate around the
+`sp` mesh axis via jax.lax.ppermute (ICI neighbor exchange) while each
+device keeps flash-style online-softmax accumulators for its local queries —
+memory O(T/n) per device, exact results (Liu et al., Ring Attention with
+Blockwise Transformers).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+NEG_INF = -1e30
+
+
+def _ring_attention_block(q, k, v, axis_name: str, causal: bool,
+                          scale: Optional[float]):
+    """Local computation: q,k,v are (B, Tl, H, D) blocks of a sequence
+    sharded over axis_name."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, Tl, H, D = q.shape
+    s = scale if scale is not None else (D ** -0.5)
+    qf = q.astype(jnp.float32) * s
+
+    # accumulators: running max m, normalizer l, weighted value sum acc.
+    # pcast marks them device-varying over the ring axis so the fori_loop
+    # carry types match (shard_map vma tracking).
+    vary = lambda x: jax.lax.pcast(x, (axis_name,), to="varying")
+    m0 = vary(jnp.full((B, H, Tl), NEG_INF, jnp.float32))
+    l0 = vary(jnp.zeros((B, H, Tl), jnp.float32))
+    acc0 = vary(jnp.zeros((B, H, Tl, D), jnp.float32))
+
+    q_pos = idx * Tl + jnp.arange(Tl)
+
+    def step(i, carry):
+        m, l, acc, kb, vb = carry
+        # the block arriving at step i originated on device (idx + i) % n
+        src = (idx + i) % n
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32))
+        if causal:
+            k_pos = src * Tl + jnp.arange(Tl)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(mask[None, None], logits, NEG_INF)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        # guard fully-masked rows (m_new == NEG_INF) against NaNs
+        m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(logits - m_safe[..., None])
+        p = jnp.where(jnp.isfinite(logits), p, 0.0)
+        correction = jnp.where(m <= NEG_INF / 2, 0.0,
+                               jnp.exp(m - m_safe))
+        l_new = l * correction + p.sum(axis=-1)
+        acc_new = acc * correction[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vb.astype(jnp.float32))
+        # rotate K/V to the next device over ICI
+        perm = [(j, (j - 1) % n) for j in range(n)]
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        return m_new, l_new, acc_new, kb, vb
+
+    m, l, acc, _, _ = jax.lax.fori_loop(0, n, step, (m0, l0, acc0, k, v))
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # (B,Tl,H,D)
+
+
+def make_ring_attention(mesh: Mesh, axis: str = "sp", causal: bool = False,
+                        scale: Optional[float] = None):
+    """Returns attn(q, k, v) over arrays (B, T, H, D) with T sharded on
+    `axis` (batch replicated or dp-sharded orthogonally)."""
+    fn = functools.partial(_ring_attention_block, axis_name=axis,
+                           causal=causal, scale=scale)
+    return shard_map(fn, mesh=mesh,
+                     in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+                     out_specs=P(None, axis))
+
+
+def reference_attention(q, k, v, causal: bool = False,
+                        scale: Optional[float] = None):
+    """Single-device exact attention for testing ring equivalence."""
+    B, T, H, D = q.shape
+    s = scale if scale is not None else (D ** -0.5)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * s,
+                        k.astype(jnp.float32))
+    if causal:
+        mask = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)

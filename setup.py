@@ -1,0 +1,32 @@
+import os
+import subprocess
+
+from setuptools import find_packages, setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNative(build_py):
+    """Builds libscvid.so (the native video layer) before the Python
+    package so ctypes finds it inside scanner_tpu/video/."""
+
+    def run(self):
+        here = os.path.dirname(os.path.abspath(__file__))
+        subprocess.check_call(["make", "-C", os.path.join(here, "cpp")])
+        super().run()
+
+
+setup(
+    name="scanner_tpu",
+    version="0.1.0",
+    description=("TPU-native framework for efficient analysis of large "
+                 "video datasets (scanner-research/scanner capabilities, "
+                 "JAX/XLA execution)"),
+    packages=find_packages(include=["scanner_tpu", "scanner_tpu.*"]),
+    package_data={"scanner_tpu.video": ["libscvid.so"]},
+    python_requires=">=3.10",
+    install_requires=[
+        "jax", "flax", "optax", "numpy", "msgpack", "cloudpickle",
+        "grpcio",
+    ],
+    cmdclass={"build_py": BuildWithNative},
+)

@@ -10,6 +10,12 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# the axon TPU plugin's sitecustomize overrides jax_platforms via jax.config
+# at interpreter start; force it back to cpu-only for tests
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
