@@ -1,0 +1,92 @@
+"""User configuration (~/.scanner_tpu.toml).
+
+Capability parity: reference scannerpy/config.py (Config:27-110 —
+storage type/db_path, master/worker network addresses).
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from typing import Any, Dict, Optional
+
+from .common import ScannerException
+
+DEFAULT_PATH = os.path.expanduser("~/.scanner_tpu.toml")
+
+
+def default_config() -> Dict[str, Any]:
+    return {
+        "storage": {
+            "type": "posix",
+            "db_path": os.path.expanduser("~/.scanner_tpu/db"),
+        },
+        "network": {
+            # empty master = run jobs in-process; set a hostname (even
+            # "localhost") to connect to a cluster master
+            "master": "",
+            "master_port": 5000,
+            "worker_port": 5001,
+        },
+    }
+
+
+def dump_toml(cfg: Dict[str, Any]) -> str:
+    """Minimal TOML writer (the environment has no toml-writing lib)."""
+    lines = []
+    for section, values in cfg.items():
+        lines.append(f"[{section}]")
+        for k, v in values.items():
+            if isinstance(v, str):
+                lines.append(f'{k} = "{v}"')
+            elif isinstance(v, bool):
+                lines.append(f"{k} = {str(v).lower()}")
+            else:
+                lines.append(f"{k} = {v}")
+        lines.append("")
+    return "\n".join(lines)
+
+
+class Config:
+    def __init__(self, config_path: Optional[str] = None,
+                 db_path: Optional[str] = None):
+        path = config_path or DEFAULT_PATH
+        cfg = default_config()
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                loaded = tomllib.load(f)
+            for section, values in loaded.items():
+                cfg.setdefault(section, {}).update(values)
+        elif config_path is not None:
+            raise ScannerException(f"config file not found: {config_path}")
+        if db_path is not None:
+            cfg["storage"]["db_path"] = db_path
+        self.config = cfg
+        self.config_path = path
+
+    @property
+    def storage_type(self) -> str:
+        return self.config["storage"]["type"]
+
+    @property
+    def db_path(self) -> str:
+        return self.config["storage"]["db_path"]
+
+    @property
+    def master_address(self) -> Optional[str]:
+        """host:port of the cluster master, or None for in-process
+        execution.  Accepts either master/master_port or a combined
+        master_address key."""
+        n = self.config["network"]
+        if n.get("master_address"):
+            return n["master_address"]
+        if n.get("master"):
+            return f"{n['master']}:{n['master_port']}"
+        return None
+
+    @staticmethod
+    def write_default(path: str = DEFAULT_PATH) -> str:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(dump_toml(default_config()))
+        return path

@@ -1,0 +1,192 @@
+"""Cluster deployment tooling for GKE TPU pods.
+
+Capability parity: reference scannerpy/kube.py (CloudConfig, MachineType,
+ClusterConfig with price estimation, Cluster create/scale/delete managing
+master + worker deployments, kube.py:38-779) — retargeted from GPU node
+pools to TPU node pools.  Manifest generation is pure (testable offline);
+the Cluster methods shell out to gcloud/kubectl when present.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .common import ScannerException
+
+# us-central1 on-demand ballpark $/hr (documented estimates, like the
+# reference's price table)
+TPU_PRICES = {
+    "v5litepod-1": 1.2,
+    "v5litepod-4": 4.8,
+    "v5litepod-8": 9.6,
+    "v5p-8": 16.6,
+}
+CPU_PRICE_PER_CORE = 0.033
+
+# GKE node-pool accelerator labels per slice family
+TPU_ACCELERATOR_LABELS = {
+    "v5litepod": "tpu-v5-lite-podslice",
+    "v5p": "tpu-v5p-slice",
+}
+
+
+def tpu_chips(tpu_type: str) -> int:
+    """Chip count from the slice name suffix ('v5litepod-4' -> 4)."""
+    try:
+        return int(tpu_type.rsplit("-", 1)[1])
+    except (IndexError, ValueError):
+        raise ScannerException(f"cannot parse TPU type: {tpu_type}")
+
+
+def tpu_accelerator_label(tpu_type: str) -> str:
+    family = tpu_type.rsplit("-", 1)[0]
+    if family not in TPU_ACCELERATOR_LABELS:
+        raise ScannerException(f"unknown TPU family: {family}")
+    return TPU_ACCELERATOR_LABELS[family]
+
+
+@dataclass
+class CloudConfig:
+    project: str
+    zone: str = "us-central1-a"
+    storage_bucket: Optional[str] = None
+
+
+@dataclass
+class MachineType:
+    """One worker node shape: a TPU slice + host CPU."""
+
+    tpu_type: str = "v5litepod-4"
+    cpus: int = 24
+    memory_gb: int = 96
+
+    def price_per_hour(self) -> float:
+        return TPU_PRICES.get(self.tpu_type, 0.0) \
+            + self.cpus * CPU_PRICE_PER_CORE
+
+
+@dataclass
+class ClusterConfig:
+    id: str
+    num_workers: int
+    master_cpus: int = 8
+    worker: MachineType = field(default_factory=MachineType)
+    image: str = "scanner-tpu:latest"
+    db_path: str = "/data/db"
+    master_port: int = 5000
+
+    def price_per_hour(self) -> float:
+        return (self.master_cpus * CPU_PRICE_PER_CORE
+                + self.num_workers * self.worker.price_per_hour())
+
+
+def master_manifest(cfg: ClusterConfig) -> Dict:
+    return {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": f"{cfg.id}-master"},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": f"{cfg.id}-master"}},
+            "template": {
+                "metadata": {"labels": {"app": f"{cfg.id}-master"}},
+                "spec": {"containers": [{
+                    "name": "master", "image": cfg.image,
+                    "command": ["python", "-c",
+                                ("from scanner_tpu.engine.service import "
+                                 "start_master; start_master("
+                                 f"'{cfg.db_path}', port={cfg.master_port},"
+                                 " block=True)")],
+                    "ports": [{"containerPort": cfg.master_port}],
+                    "resources": {"requests": {"cpu": str(cfg.master_cpus)}},
+                }]},
+            },
+        },
+    }
+
+
+def worker_manifest(cfg: ClusterConfig) -> Dict:
+    return {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": f"{cfg.id}-worker"},
+        "spec": {
+            "replicas": cfg.num_workers,
+            "selector": {"matchLabels": {"app": f"{cfg.id}-worker"}},
+            "template": {
+                "metadata": {"labels": {"app": f"{cfg.id}-worker"}},
+                "spec": {
+                    "nodeSelector": {
+                        "cloud.google.com/gke-tpu-accelerator":
+                            tpu_accelerator_label(cfg.worker.tpu_type),
+                    },
+                    "containers": [{
+                        "name": "worker", "image": cfg.image,
+                        "command": ["python", "-c",
+                                    ("from scanner_tpu.engine.service import"
+                                     " start_worker; start_worker("
+                                     f"'{cfg.id}-master:{cfg.master_port}',"
+                                     f" '{cfg.db_path}', block=True)")],
+                        "resources": {
+                            "requests": {"cpu": str(cfg.worker.cpus)},
+                            "limits": {"google.com/tpu":
+                                       str(tpu_chips(cfg.worker.tpu_type))},
+                        },
+                    }],
+                },
+            },
+        },
+    }
+
+
+def service_manifest(cfg: ClusterConfig) -> Dict:
+    return {
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": f"{cfg.id}-master"},
+        "spec": {
+            "selector": {"app": f"{cfg.id}-master"},
+            "ports": [{"port": cfg.master_port,
+                       "targetPort": cfg.master_port}],
+        },
+    }
+
+
+class Cluster:
+    """Lifecycle wrapper (reference kube.py Cluster): create/scale/delete
+    via gcloud/kubectl; manifests() works without either installed."""
+
+    def __init__(self, cloud: CloudConfig, cfg: ClusterConfig):
+        self.cloud = cloud
+        self.cfg = cfg
+
+    def manifests(self) -> List[Dict]:
+        return [master_manifest(self.cfg), service_manifest(self.cfg),
+                worker_manifest(self.cfg)]
+
+    def manifests_json(self) -> str:
+        return "\n---\n".join(json.dumps(m, indent=2)
+                              for m in self.manifests())
+
+    def _kubectl(self, *args, input_data: Optional[str] = None):
+        if shutil.which("kubectl") is None:
+            raise ScannerException(
+                "kubectl not available; use manifests_json() and apply "
+                "manually")
+        return subprocess.run(["kubectl", *args], input=input_data,
+                              text=True, check=True, capture_output=True)
+
+    def create(self) -> None:
+        self._kubectl("apply", "-f", "-", input_data=self.manifests_json())
+
+    def scale(self, num_workers: int) -> None:
+        self.cfg.num_workers = num_workers
+        self._kubectl("scale", f"deployment/{self.cfg.id}-worker",
+                      f"--replicas={num_workers}")
+
+    def delete(self) -> None:
+        self._kubectl("delete", "-f", "-", input_data=self.manifests_json())
+
+    def master_address(self) -> str:
+        return f"{self.cfg.id}-master:{self.cfg.master_port}"

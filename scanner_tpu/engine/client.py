@@ -75,7 +75,7 @@ class Client:
     """
 
     def __init__(self, db_path: Optional[str] = None,
-                 storage_type: str = "posix",
+                 storage_type: Optional[str] = None,
                  master: Optional[str] = None,
                  workers: Optional[List[str]] = None,
                  num_load_workers: int = 2,
@@ -84,12 +84,14 @@ class Client:
                  config_path: Optional[str] = None,
                  **kw):
         if config_path is not None:
-            import tomllib
-            with open(config_path, "rb") as f:
-                cfg = tomllib.load(f)
-            db_path = cfg.get("storage", {}).get("db_path", db_path)
-            storage_type = cfg.get("storage", {}).get("type", storage_type)
-            master = cfg.get("network", {}).get("master_address", master)
+            from ..config import Config
+            cfg = Config(config_path)
+            db_path = db_path or cfg.db_path
+            # explicit argument beats config beats default
+            storage_type = storage_type or cfg.storage_type
+            if master is None:
+                master = cfg.master_address
+        storage_type = storage_type or "posix"
         if db_path is None and storage_type == "posix":
             db_path = os.path.expanduser("~/.scanner_tpu/db")
         self._db = Database(make_storage(storage_type, db_path=db_path))
@@ -135,6 +137,48 @@ class Client:
     def ingest_videos(self, named_paths: Sequence, inplace: bool = False):
         from ..video import ingest_videos
         return ingest_videos(self._db, named_paths, inplace=inplace)
+
+    def ingest_images(self, name: str, paths: Sequence[str]):
+        from ..video.ingest import ingest_images
+        return ingest_images(self._db, name, paths)
+
+    def load_op(self, module_path: str, name: Optional[str] = None):
+        """Load a user op library: a Python module whose import registers
+        ops via @register_op (the TPU-native analogue of the reference's
+        dynamic .so op libraries, client.py:514 load_op)."""
+        import hashlib
+        import importlib.util
+        import sys
+        real = os.path.realpath(module_path)
+        # unique module name per file path: never collides with stdlib or
+        # a second op library of the same basename
+        digest = hashlib.md5(real.encode()).hexdigest()[:8]
+        base = os.path.splitext(os.path.basename(real))[0]
+        mod_name = name or f"scanner_tpu_userops_{base}_{digest}"
+        if mod_name in sys.modules:
+            return sys.modules[mod_name]
+        spec = importlib.util.spec_from_file_location(mod_name, module_path)
+        if spec is None or spec.loader is None:
+            raise ScannerException(f"cannot load op module: {module_path}")
+        mod = importlib.util.module_from_spec(spec)
+        # register before exec so pickled objects from the module resolve
+        # in other processes (workers load_op the same path)
+        sys.modules[mod_name] = mod
+        try:
+            spec.loader.exec_module(mod)
+        except Exception:
+            sys.modules.pop(mod_name, None)
+            raise
+        return mod
+
+    def batch_load(self, streams: Sequence, rows=None, workers: int = 4):
+        """Load several streams concurrently (reference Client.batch_load,
+        client.py:1270 multiprocessing pool -> thread pool here: the
+        decode path releases the GIL in C)."""
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            return list(pool.map(
+                lambda s: list(s.load(rows=rows)), streams))
 
     def new_table(self, name: str, columns: Sequence[str],
                   rows: Sequence[Sequence[bytes]],

@@ -56,6 +56,8 @@ class JobContext:
     # mixed-dtype frame outputs fail loudly instead of corrupting the table
     sink_modes: Dict[int, str] = field(default_factory=dict)
     sink_mode_lock: threading.Lock = field(default_factory=threading.Lock)
+    # sinks writing through a CustomStorage instead of the database
+    custom_sinks: Dict[int, Any] = field(default_factory=dict)
 
 
 @dataclass
@@ -133,6 +135,11 @@ class LocalExecutor:
             stream: StoredStream = n.extra["streams"][j]
             if getattr(stream, "_sc", False) is None:
                 stream.bind(self.db)  # arrived via RPC unbound
+            if getattr(stream, "is_custom", False):
+                # pluggable source (reference Source::read extension point)
+                source_info[n.id] = {"custom": stream, "is_video": False}
+                source_rows[n.id] = stream.len()
+                continue
             if isinstance(stream, NamedVideoStream):
                 stream.ensure_ingested()
             if not stream.committed():
@@ -149,9 +156,11 @@ class LocalExecutor:
                 vinfo = load_video_meta(self.db, stream.name, col)
                 if vinfo.fps:
                     fps = vinfo.fps
+            codec = next((c.codec for c in desc.columns if c.name == col),
+                         "raw")
             source_info[n.id] = {
                 "table": desc, "column": col, "is_video": is_video,
-                "video_meta": vinfo,
+                "video_meta": vinfo, "codec": codec,
             }
             source_rows[n.id] = desc.num_rows
 
@@ -162,16 +171,31 @@ class LocalExecutor:
         # master.cpp:1619-1663).  CacheMode.Ignore skips the job only when
         # EVERY sink output already exists committed (job-level resume,
         # reference client.py:1389-1430)
+        custom_sinks: Dict[int, Any] = {}
         sink_names = []
+        table_sinks = []
         for sink in info.sinks:
             out_stream = sink.extra["streams"][j]
             if getattr(out_stream, "_sc", False) is None:
                 out_stream.bind(self.db)
+            if getattr(out_stream, "is_custom", False):
+                # CacheMode applies to custom sinks too: stale rows from a
+                # previous (longer) run must not survive an Overwrite
+                if create_tables and out_stream.storage.exists(out_stream):
+                    if cache_mode == CacheMode.Error:
+                        raise JobException(
+                            f"custom output {out_stream.name} already "
+                            f"exists (pass cache_mode=CacheMode.Overwrite)")
+                    if cache_mode == CacheMode.Overwrite:
+                        out_stream.storage.delete_stream(out_stream)
+                custom_sinks[sink.id] = out_stream
+                continue
+            table_sinks.append(sink)
             sink_names.append(out_stream.name if hasattr(out_stream, "name")
                               else str(out_stream))
         if not create_tables:
             sink_tables = {}
-            for sink, name in zip(info.sinks, sink_names):
+            for sink, name in zip(table_sinks, sink_names):
                 if not self.db.has_table(name):
                     continue  # job skipped by the master
                 src_col = sink.input_columns()[0]
@@ -183,14 +207,16 @@ class LocalExecutor:
             return JobContext(job_idx=j, jr=jr, tasks=tasks,
                               source_info=source_info,
                               sink_tables=sink_tables, fps=fps,
-                              skipped=not sink_tables)
-        if cache_mode == CacheMode.Ignore and all(
+                              custom_sinks=custom_sinks,
+                              skipped=not sink_tables and not custom_sinks)
+        if table_sinks and not custom_sinks \
+                and cache_mode == CacheMode.Ignore and all(
                 self.db.table_is_committed(nm) for nm in sink_names):
             return JobContext(job_idx=j, jr=jr, tasks=tasks,
                               source_info=source_info, sink_tables={},
                               fps=fps, skipped=True)
         sink_tables: Dict[int, Tuple] = {}
-        for sink, name in zip(info.sinks, sink_names):
+        for sink, name in zip(table_sinks, sink_names):
             src_col = sink.input_columns()[0]
             codec = self._codec_for(src_col)
             if self.db.has_table(name):
@@ -211,7 +237,8 @@ class LocalExecutor:
             sink_tables[sink.id] = (desc, col.name, codec, enc)
         ctx = JobContext(job_idx=j, jr=jr, tasks=tasks,
                          source_info=source_info, sink_tables=sink_tables,
-                         fps=fps, skipped=not sink_tables)
+                         fps=fps, custom_sinks=custom_sinks,
+                         skipped=not sink_tables and not custom_sinks)
         return ctx
 
     @staticmethod
@@ -240,6 +267,9 @@ class LocalExecutor:
                 continue
             for desc, _c, _k, _e in job.sink_tables.values():
                 self.db.commit_table(desc.id)
+            for stream in job.custom_sinks.values():
+                # durability barrier (reference Sink::finished)
+                stream.storage.finished(stream, job.jr.output_rows)
         self.db.write_megafile()
         return jobs
 
@@ -390,7 +420,10 @@ class LocalExecutor:
         for node_id, rows in w.plan.source_rows.items():
             si = w.job.source_info[node_id]
             rows_l = [int(r) for r in rows]
-            if si["is_video"]:
+            if "custom" in si:
+                vals = si["custom"].storage.read_rows(si["custom"], rows_l)
+                out[node_id] = dict(zip(rows_l, vals))
+            elif si["is_video"]:
                 # rows are global; multi-item video tables (job outputs)
                 # hold one independently-decodable item per task
                 desc = si["table"]
@@ -408,13 +441,13 @@ class LocalExecutor:
                         elems[start + lr] = frames[i]
                 out[node_id] = elems
             else:
+                from ..storage.streams import decode_element
                 desc = si["table"]
                 vals = list(self.db.load_column(desc.id, si["column"],
                                                 rows=rows_l))
-                elems = {}
-                for r, v in zip(rows_l, vals):
-                    elems[r] = NullElement() if v is None else v
-                out[node_id] = elems
+                codec = si.get("codec", "raw")
+                out[node_id] = {r: decode_element(v, codec)
+                                for r, v in zip(rows_l, vals)}
         return out
 
     def _automata(self, tls, job: JobContext, node_id: int, si,
@@ -442,6 +475,12 @@ class LocalExecutor:
         PostEvaluateWorker video encode, evaluate_worker.cpp:1373-1560)."""
         start, end = w.output_range
         for sink in info.sinks:
+            if sink.id in w.job.custom_sinks:
+                stream = w.job.custom_sinks[sink.id]
+                elems = w.results[sink.id]
+                stream.storage.write_item(
+                    stream, start, [elems[r] for r in range(start, end)])
+                continue
             if sink.id not in w.job.sink_tables:
                 continue
             desc, col_name, codec, enc_opts = w.job.sink_tables[sink.id]

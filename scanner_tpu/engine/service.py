@@ -70,6 +70,9 @@ class _BulkJob:
     # job idx -> output table names, resolved at admission so completion
     # commits never deserialize the graph under the control-plane lock
     job_sink_names: Dict[int, List[str]] = field(default_factory=dict)
+    # job idx -> custom sink streams (finished() barrier on completion)
+    job_custom_sinks: Dict[int, list] = field(default_factory=dict)
+    job_output_rows: Dict[int, int] = field(default_factory=dict)
     committed_jobs: Set[int] = field(default_factory=set)
     finished: bool = False
     error: str = ""
@@ -174,6 +177,9 @@ class Master:
                     bulk.job_tasks[job.job_idx] = tasks
                     bulk.job_sink_names[job.job_idx] = [
                         d.name for d, _c, _k, _e in job.sink_tables.values()]
+                    bulk.job_custom_sinks[job.job_idx] = \
+                        list(job.custom_sinks.values())
+                    bulk.job_output_rows[job.job_idx] = job.jr.output_rows
                     bulk.queue.extend(sorted(tasks))
                     bulk.total_tasks += len(tasks)
                 self._bulk = bulk
@@ -318,6 +324,9 @@ class Master:
             for name in bulk.job_sink_names.get(j, []):
                 if self.db.has_table(name):
                     self.db.commit_table(name)
+            for stream in bulk.job_custom_sinks.get(j, []):
+                stream.storage.finished(stream,
+                                        bulk.job_output_rows.get(j, 0))
             bulk.committed_jobs.add(j)
 
     def _maybe_finish_bulk(self, bulk: _BulkJob) -> None:

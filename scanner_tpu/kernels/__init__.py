@@ -1,3 +1,3 @@
 # Importing registers the stdlib ops (like `import scannertools.imgproc`
 # in the reference tutorials).
-from . import imgproc, shot  # noqa: F401
+from . import image, imgproc, shot  # noqa: F401

@@ -1,10 +1,12 @@
 from .backend import StorageBackend, PosixStorage, MemoryStorage, make_storage
+from .custom import CustomStorage, CustomStream, FilesStorage, FilesStream
 from .database import Database
 from .metadata import (ColumnDescriptor, ColumnType, DatabaseMetadata,
                        TableDescriptor, VideoDescriptor)
 
 __all__ = [
     "StorageBackend", "PosixStorage", "MemoryStorage", "make_storage",
-    "Database", "ColumnDescriptor", "ColumnType", "DatabaseMetadata",
+    "Database", "CustomStorage", "CustomStream", "FilesStorage",
+    "FilesStream", "ColumnDescriptor", "ColumnType", "DatabaseMetadata",
     "TableDescriptor", "VideoDescriptor",
 ]

@@ -21,6 +21,18 @@ from . import metadata as md
 from .database import Database
 
 
+def decode_element(blob: Optional[bytes], codec: str):
+    """Single source of truth for row decoding by column codec."""
+    if blob is None:
+        return NullElement()
+    if codec == "pickle":
+        return pickle.loads(blob)
+    if codec == "image":
+        from ..video.ingest import decode_image
+        return decode_image(blob)
+    return blob
+
+
 class StoredStream:
     """Base: a named, typed, committed-or-not stream of rows."""
 
@@ -91,12 +103,7 @@ class StoredStream:
             if c.name == col:
                 codec = getattr(c, "codec", "pickle")
         for blob in self.db.load_column(self.name, col, rows=rows):
-            if blob is None:
-                yield NullElement()
-            elif codec == "pickle":
-                yield pickle.loads(blob)
-            else:
-                yield blob
+            yield decode_element(blob, codec or "raw")
 
 
 class NamedStream(StoredStream):

@@ -84,6 +84,42 @@ def _ingest_one(db: Database, name: str, path: str,
     return db.table_descriptor(desc.id)
 
 
+def ingest_images(db: Database, name: str, paths: Sequence[str]
+                  ) -> md.TableDescriptor:
+    """Ingest still images as a frame table (reference ingest.cpp image
+    ingest).  Images stay in their encoded form (codec 'image'); readers
+    and the engine decode to RGB numpy on demand via PIL."""
+    if db.has_table(name):
+        raise ScannerException(f"table already exists: {name}")
+    cols = [md.ColumnDescriptor("index", md.ColumnType.BYTES),
+            md.ColumnDescriptor("frame", md.ColumnType.BYTES,
+                                codec="image")]
+    blobs = []
+    for p in paths:
+        with open(p, "rb") as f:
+            blobs.append(f.read())
+    desc = db.create_table(name, cols, end_rows=[len(paths)])
+    try:
+        items.write_item(db.backend,
+                         md.column_item_path(desc.id, "frame", 0), blobs)
+        items.write_item(db.backend,
+                         md.column_item_path(desc.id, "index", 0),
+                         [struct.pack("<q", i) for i in range(len(paths))])
+    except Exception:
+        # don't leave an orphaned uncommitted table squatting the name
+        db.delete_table(name)
+        raise
+    db.commit_table(desc.id)
+    return desc
+
+
+def decode_image(blob: bytes) -> np.ndarray:
+    import io
+
+    from PIL import Image
+    return np.asarray(Image.open(io.BytesIO(blob)).convert("RGB"))
+
+
 def load_video_meta(db: Database, table, column: str = "frame",
                     item: int = 0) -> md.VideoDescriptor:
     desc = db.table_descriptor(table)

@@ -1,0 +1,154 @@
+"""Pluggable sources/sinks, image ingest, config, load_op, batch_load."""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from scanner_tpu import (CacheMode, Client, NamedStream, NamedVideoStream,
+                         PerfParams)
+from scanner_tpu.storage import FilesStream
+import scanner_tpu.kernels
+from scanner_tpu import video as scv
+
+
+@pytest.fixture(scope="module")
+def sc(tmp_path_factory):
+    root = tmp_path_factory.mktemp("ext")
+    vid = str(root / "v.mp4")
+    scv.synthesize_video(vid, num_frames=24, width=64, height=48, fps=24)
+    client = Client(db_path=str(root / "db"))
+    client.ingest_videos([("test1", vid)])
+    yield client, str(root)
+    client.stop()
+
+
+def test_files_source_and_sink(sc):
+    client, root = sc
+    # write input rows as files
+    src_dir = os.path.join(root, "files_in")
+    os.makedirs(os.path.join(src_dir, "nums"))
+    for i in range(10):
+        with open(os.path.join(src_dir, "nums", f"{i:08d}.bin"), "wb") as f:
+            f.write(struct.pack("<q", i * 3))
+    in_stream = FilesStream("nums", src_dir)
+    assert in_stream.len() == 10
+
+    import scanner_tpu
+    from typing import Any
+
+    @scanner_tpu.register_op(name="TripleUp")
+    class TripleUp(scanner_tpu.Kernel):
+        def execute(self, x: bytes) -> bytes:
+            (v,) = struct.unpack("<q", x)
+            return struct.pack("<q", v + 1)
+
+    data = client.io.Input([in_stream])
+    up = client.ops.TripleUp(x=data)
+    out_stream = FilesStream("nums_out", os.path.join(root, "files_out"))
+    client.run(client.io.Output(up, [out_stream]), PerfParams.manual(4, 4),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+    got = [struct.unpack("<q", b)[0] for b in out_stream.load()]
+    assert got == [i * 3 + 1 for i in range(10)]
+
+
+def test_files_to_table_and_back(sc):
+    client, root = sc
+    # video input -> files sink of pickled histograms
+    frame = client.io.Input([NamedVideoStream(client, "test1")])
+    hist = client.ops.Histogram(frame=frame)
+    out = FilesStream("hists", os.path.join(root, "files_out2"),
+                      codec="pickle")
+    client.run(client.io.Output(hist, [out]), PerfParams.manual(8, 8),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+    rows = list(out.load())
+    assert len(rows) == 24 and rows[0][0].shape == (16,)
+
+
+def test_image_ingest_and_pipeline(sc, tmp_path):
+    client, root = sc
+    from PIL import Image
+    paths = []
+    for i in range(5):
+        p = str(tmp_path / f"img{i}.png")
+        Image.fromarray(scv.frame_pattern(i, 48, 64)).save(p)
+        paths.append(p)
+    client.ingest_images("stills", paths)
+    t = client.table("stills")
+    assert t.num_rows() == 5
+    # through the engine
+    frame = client.io.Input([NamedVideoStream(client, "stills")])
+    hist = client.ops.Histogram(frame=frame)
+    out = NamedStream(client, "still_hists")
+    client.run(client.io.Output(hist, [out]), PerfParams.manual(4, 4),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+    rows = list(out.load())
+    assert len(rows) == 5
+    assert int(rows[0][0].sum()) == 64 * 48
+    # encode kernel roundtrip
+    frame = client.io.Input([NamedVideoStream(client, "stills")])
+    enc = client.ops.ImageEncode(frame=frame, format="png")
+    out2 = NamedStream(client, "still_pngs")
+    client.run(client.io.Output(enc, [out2]), PerfParams.manual(4, 4),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+    blobs = list(out2.load())
+    assert blobs[0][:8] == b"\x89PNG\r\n\x1a\n"
+
+
+def test_config_roundtrip(tmp_path):
+    from scanner_tpu.config import Config, default_config, dump_toml
+    p = str(tmp_path / "cfg.toml")
+    with open(p, "w") as f:
+        f.write(dump_toml(default_config()))
+    cfg = Config(p, db_path=str(tmp_path / "db"))
+    assert cfg.storage_type == "posix"
+    assert cfg.db_path == str(tmp_path / "db")
+    assert cfg.master_address is None  # default: in-process execution
+    # explicit master in config selects cluster mode, localhost included
+    with open(p, "w") as f:
+        f.write('[network]\nmaster = "localhost"\nmaster_port = 5055\n')
+    cfg = Config(p)
+    assert cfg.master_address == "localhost:5055"
+    # legacy combined key also accepted
+    with open(p, "w") as f:
+        f.write('[network]\nmaster_address = "10.0.0.5:5000"\n')
+    assert Config(p).master_address == "10.0.0.5:5000"
+
+
+def test_load_op(sc, tmp_path):
+    client, root = sc
+    mod = tmp_path / "user_ops.py"
+    mod.write_text(
+        "from scanner_tpu import Kernel, register_op\n"
+        "@register_op(name='UserDouble')\n"
+        "class UserDouble(Kernel):\n"
+        "    def execute(self, x: bytes) -> bytes:\n"
+        "        return x + x\n")
+    client.load_op(str(mod))
+    from scanner_tpu.graph.ops import registry
+    assert registry.has("UserDouble")
+
+
+def test_batch_load(sc):
+    client, root = sc
+    client.new_table("bl1", ["c"], [[b"a"], [b"b"]], overwrite=True)
+    client.new_table("bl2", ["c"], [[b"x"]], overwrite=True)
+    s1, s2 = NamedStream(client, "bl1"), NamedStream(client, "bl2")
+    res = client.batch_load([s1, s2])
+    assert res == [[b"a", b"b"], [b"x"]]
+
+
+def test_deploy_manifests():
+    from scanner_tpu.deploy import (CloudConfig, Cluster, ClusterConfig,
+                                    MachineType)
+    cfg = ClusterConfig(id="sc", num_workers=4,
+                        worker=MachineType(tpu_type="v5litepod-4"))
+    cluster = Cluster(CloudConfig(project="p"), cfg)
+    ms = cluster.manifests()
+    assert ms[0]["metadata"]["name"] == "sc-master"
+    assert ms[2]["spec"]["replicas"] == 4
+    assert "google.com/tpu" in \
+        ms[2]["spec"]["template"]["spec"]["containers"][0]["resources"]["limits"]
+    assert cfg.price_per_hour() > 0
+    assert "sc-master" in cluster.manifests_json()
