@@ -1,0 +1,51 @@
+"""Tutorial 01: defining your own ops (reference tutorials/01+02).
+
+Ops are Python classes (usually wrapping jitted JAX fns) registered with
+@register_op; input/output columns come from type annotations.
+"""
+
+import sys
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scanner_tpu import (CacheMode, Client, DeviceType, FrameType, Kernel,
+                         NamedStream, NamedVideoStream, PerfParams,
+                         register_op)
+
+
+@register_op(device=DeviceType.TPU, batch=16)
+class Brightness(Kernel):
+    """Mean luma per frame, batched through one jitted XLA program."""
+
+    def execute(self, frame: Sequence[FrameType]) -> Sequence[Any]:
+        frames = jnp.asarray(np.asarray(frame), jnp.float32)
+        w = jnp.asarray([0.299, 0.587, 0.114])
+        return [float(x) for x in (frames * w).sum(-1).mean((1, 2))]
+
+
+@register_op(stencil=[-1, 0, 1])
+class TemporalMedian(Kernel):
+    """3-frame temporal median — a stencil op: the engine hands each call
+    the [-1, 0, +1] window, decoding exactly the needed extra frames."""
+
+    def execute(self, frame: Sequence[FrameType]) -> FrameType:
+        return np.median(np.stack(frame), axis=0).astype(np.uint8)
+
+
+def main():
+    sc = Client(db_path="/tmp/scanner_tpu_db")
+    movie = NamedVideoStream(sc, "t01", path=sys.argv[1])
+    frames = sc.io.Input([movie])
+    bright = sc.ops.Brightness(frame=frames)
+    out = NamedStream(sc, "t01_brightness")
+    sc.run(sc.io.Output(bright, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite)
+    vals = list(out.load())
+    print(f"brightness: min {min(vals):.1f} max {max(vals):.1f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Tutorial 03: stream sampling (reference tutorials/03_sampling.py).
+
+Samplers select which rows flow downstream; the engine decodes ONLY the
+frames the sampled rows (plus stencils) require, seeking keyframe-exact.
+"""
+
+import sys
+
+from scanner_tpu import (CacheMode, Client, NamedStream, NamedVideoStream,
+                         PerfParams)
+import scanner_tpu.kernels
+
+
+def main():
+    sc = Client(db_path="/tmp/scanner_tpu_db")
+    movie = NamedVideoStream(sc, "t03", path=sys.argv[1])
+    frames = sc.io.Input([movie])
+
+    strided = sc.streams.Stride(frames, [{"stride": 10}])   # every 10th
+    # other samplers:
+    #   sc.streams.Range(frames, [(30, 60)])
+    #   sc.streams.Gather(frames, [[0, 99, 500]])
+    #   sc.streams.StridedRanges(frames, [[(0, 100), (500, 600)]], stride=5)
+
+    hist = sc.ops.Histogram(frame=strided)
+    out = NamedStream(sc, "t03_hists")
+    job = sc.run(sc.io.Output(hist, [out]), PerfParams.estimate(),
+                 cache_mode=CacheMode.Overwrite)
+    print(f"{out.len()} histograms from every 10th frame")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""Tutorial 05: pluggable sources/sinks (reference tutorials/05 +
+scannertools FilesStream).
+
+Any CustomStorage subclass can feed or receive a graph; FilesStream stores
+one file per row.
+"""
+
+import os
+import sys
+
+from scanner_tpu import CacheMode, Client, NamedVideoStream, PerfParams
+from scanner_tpu.storage import FilesStream
+import scanner_tpu.kernels
+
+
+def main():
+    sc = Client(db_path="/tmp/scanner_tpu_db")
+    movie = NamedVideoStream(sc, "t05", path=sys.argv[1])
+    frames = sc.io.Input([movie])
+    sampled = sc.streams.Stride(frames, [{"stride": 30}])
+    pngs = sc.ops.ImageEncode(frame=sampled, format="png")
+    out = FilesStream("thumbs", "/tmp/scanner_tpu_thumbs", ext="png")
+    sc.run(sc.io.Output(pngs, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite)
+    print(f"wrote {out.len()} thumbnails under /tmp/scanner_tpu_thumbs/thumbs")
+
+
+if __name__ == "__main__":
+    main()

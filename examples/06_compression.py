@@ -1,0 +1,26 @@
+"""Tutorial 06: output compression (reference tutorials/06_compression.py).
+
+Frame outputs re-encode to H.264 by default; .lossless() / .compress()
+tune it, save_mp4 exports a playable file without re-encoding.
+"""
+
+import sys
+
+from scanner_tpu import (CacheMode, Client, NamedVideoStream, PerfParams)
+import scanner_tpu.kernels
+
+
+def main():
+    sc = Client(db_path="/tmp/scanner_tpu_db")
+    movie = NamedVideoStream(sc, "t06", path=sys.argv[1])
+    frames = sc.io.Input([movie])
+    small = sc.ops.Resize(frame=frames, width=[320], height=[240])
+    out = NamedVideoStream(sc, "t06_small")
+    sc.run(sc.io.Output(small.compress("video", crf=28), [out]),
+           PerfParams.estimate(), cache_mode=CacheMode.Overwrite)
+    out.save_mp4("/tmp/t06_small.mp4")
+    print("wrote /tmp/t06_small.mp4")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Tutorial 07: profiling (reference tutorials/07_profiling.py).
+
+Every job records per-stage intervals; write_trace emits Chrome trace JSON
+(chrome://tracing or ui.perfetto.dev).
+"""
+
+import sys
+
+from scanner_tpu import (CacheMode, Client, NamedStream, NamedVideoStream,
+                         PerfParams)
+import scanner_tpu.kernels
+
+
+def main():
+    sc = Client(db_path="/tmp/scanner_tpu_db")
+    movie = NamedVideoStream(sc, "t07", path=sys.argv[1])
+    frames = sc.io.Input([movie])
+    hist = sc.ops.Histogram(frame=frames)
+    out = NamedStream(sc, "t07_hists")
+    job_id = sc.run(sc.io.Output(hist, [out]), PerfParams.estimate(),
+                    cache_mode=CacheMode.Overwrite)
+    profile = sc.get_profile(job_id)
+    profile.write_trace("/tmp/t07.trace.json")
+    for name, s in profile.statistics().items():
+        print(name, s)
+    print("trace: /tmp/t07.trace.json")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Tutorial 08: running on a cluster (reference master/worker bring-up).
+
+Start a master and workers (here: same machine; in production one worker
+per TPU host — see scanner_tpu/deploy.py for GKE manifests), then point a
+Client at the master: the API is unchanged.
+"""
+
+import sys
+
+from scanner_tpu import (CacheMode, Client, NamedStream, NamedVideoStream,
+                         PerfParams)
+from scanner_tpu.engine.service import Master, Worker
+import scanner_tpu.kernels
+
+
+def main():
+    db = "/tmp/scanner_tpu_db"
+    master = Master(db_path=db)
+    addr = f"localhost:{master.port}"
+    workers = [Worker(addr, db_path=db) for _ in range(2)]
+
+    sc = Client(db_path=db, master=addr)
+    movie = NamedVideoStream(sc, "t08", path=sys.argv[1])
+    movie.ensure_ingested()
+    frames = sc.io.Input([movie])
+    hist = sc.ops.Histogram(frame=frames)
+    out = NamedStream(sc, "t08_hists")
+    sc.run(sc.io.Output(hist, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite)
+    print(f"{out.len()} rows computed by {len(workers)} workers")
+    sc.stop()
+    for w in workers:
+        w.stop()
+    master.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -300,12 +300,7 @@ class LocalExecutor:
                             w: TaskItem = task_q.get_nowait()
                         except queue.Empty:
                             break
-                        with self.profiler.span("load", task=w.task_idx,
-                                                job=w.job.job_idx):
-                            w.plan = A.derive_task_streams(
-                                info, w.job.jr, w.output_range,
-                                job_idx=w.job.job_idx, task_idx=w.task_idx)
-                            w.elements = self._load_sources(w, tls)
+                        self.load_task(info, w, tls)
                         while not stop.is_set():
                             try:
                                 eval_q.put(w, timeout=0.25)
@@ -413,6 +408,18 @@ class LocalExecutor:
                 f"pipeline finished {done_count[0]}/{len(work)} tasks")
 
     # ------------------------------------------------------------------
+
+    def load_task(self, info: A.GraphInfo, w: TaskItem, tls) -> TaskItem:
+        """The load stage: derive the task's row plan and read/decode its
+        source elements (shared by the local pipeline and cluster
+        workers)."""
+        with self.profiler.span("load", task=w.task_idx,
+                                job=w.job.job_idx):
+            w.plan = A.derive_task_streams(
+                info, w.job.jr, w.output_range,
+                job_idx=w.job.job_idx, task_idx=w.task_idx)
+            w.elements = self._load_sources(w, tls)
+        return w
 
     def _load_sources(self, w: TaskItem, tls) -> Dict[int, Dict[int, Any]]:
         """Read/decode exactly the rows the task needs."""

@@ -105,6 +105,7 @@ class Master:
             "NewJob": self._rpc_new_job,
             "GetJob": self._rpc_get_job,
             "NextWork": self._rpc_next_work,
+            "StartedWork": self._rpc_started_work,
             "FinishedWork": self._rpc_finished_work,
             "FailedWork": self._rpc_failed_work,
             "GetJobStatus": self._rpc_job_status,
@@ -221,6 +222,22 @@ class Master:
             if bulk.outstanding:
                 return {"status": "wait"}
             return {"status": "done"}
+
+    def _rpc_started_work(self, req: dict) -> dict:
+        """Worker signals that evaluation of a prefetched task begins now:
+        restart its timeout clock so task_timeout measures execution, not
+        time spent queued behind the previous task."""
+        key = (req["job_idx"], req["task_idx"])
+        with self._lock:
+            self._touch_worker(req.get("worker_id"))
+            bulk = self._bulk
+            if bulk is None or bulk.bulk_id != req["bulk_id"]:
+                return {"ok": False}
+            cur = bulk.outstanding.get(key)
+            if cur is not None and cur[0] == req.get("worker_id"):
+                bulk.outstanding[key] = (cur[0], time.time())
+                return {"ok": True}
+        return {"ok": False, "revoked": True}
 
     def _rpc_finished_work(self, req: dict) -> dict:
         key = (req["job_idx"], req["task_idx"])
@@ -507,7 +524,10 @@ class Worker:
         self._bulk_id = bulk_id
 
     def _pull_and_run(self, bulk_id: int) -> None:
-        tls = threading.local()
+        # plain namespace, not threading.local: only the single prefetch
+        # thread loads, and cleanup must see its decoder cache
+        import types
+        tls = types.SimpleNamespace()
         try:
             self._pull_loop(bulk_id, tls)
         finally:
@@ -515,40 +535,84 @@ class Worker:
             for auto in getattr(tls, "automata", {}).values():
                 auto.close()
 
+    def _pull_next(self, bulk_id: int):
+        """Ask the master for one task; returns TaskItem, 'wait', None
+        (bulk over), or ('task_error', j, t, exc)."""
+        if self._hb_reply.get("active_bulk") != bulk_id:
+            return None
+        reply = self.master.try_call("NextWork", worker_id=self.worker_id,
+                                     bulk_id=bulk_id)
+        if reply is None or reply["status"] in ("none", "done"):
+            return None
+        if reply["status"] == "wait":
+            return "wait"
+        j, t = reply["job_idx"], reply["task_idx"]
+        try:
+            job = self._jobs[j]
+            return TaskItem(job, t, job.tasks[t])
+        except Exception as e:  # noqa: BLE001  (job-list skew etc.)
+            return ("task_error", j, t, e)
+
     def _pull_loop(self, bulk_id: int, tls) -> None:
-        while not self._shutdown.is_set():
-            if self._hb_reply.get("active_bulk") != bulk_id:
-                return  # bulk finished or superseded
-            reply = self.master.try_call("NextWork",
+        """Pull-execute loop with one-task prefetch: while the evaluator
+        runs task N, a background thread pulls and loads task N+1 (decode
+        releases the GIL), the in-worker analogue of the reference's
+        load -> evaluate pipeline stages (worker.cpp:1467-1724)."""
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=1,
+                                thread_name_prefix="prefetch") as pool:
+            pending = None  # Future of the next loaded TaskItem
+
+            def fetch():
+                nxt = self._pull_next(bulk_id)
+                if isinstance(nxt, TaskItem):
+                    try:
+                        return self.executor.load_task(self._info, nxt, tls)
+                    except Exception as e:  # noqa: BLE001
+                        # report the load failure from the main loop so
+                        # the master's failure accounting still runs
+                        return ("task_error", nxt.job.job_idx,
+                                nxt.task_idx, e)
+                return nxt
+
+            pending = pool.submit(fetch)
+            while not self._shutdown.is_set():
+                w = pending.result()
+                if w is None:
+                    return
+                if w == "wait":
+                    time.sleep(0.2)
+                    pending = pool.submit(fetch)
+                    continue
+                pending = pool.submit(fetch)  # overlap with evaluation
+                if isinstance(w, tuple) and w[0] == "task_error":
+                    _tag, j, t, exc = w
+                    traceback.print_exception(exc)
+                    self.master.try_call(
+                        "FailedWork", bulk_id=bulk_id,
+                        worker_id=self.worker_id, job_idx=j, task_idx=t,
+                        error=f"{type(exc).__name__}: {exc}")
+                    continue
+                j, t = w.job.job_idx, w.task_idx
+                try:
+                    # restart the master's timeout clock: evaluation of
+                    # this prefetched task starts now
+                    self.master.try_call(
+                        "StartedWork", bulk_id=bulk_id,
+                        worker_id=self.worker_id, job_idx=j, task_idx=t)
+                    with self.profiler.span("task", job=j, task=t):
+                        w.results = self._evaluator.execute_task(
+                            w.job.jr, w.plan, w.elements)
+                        self.executor._save_task(self._info, w)
+                    self.master.try_call("FinishedWork", bulk_id=bulk_id,
                                          worker_id=self.worker_id,
-                                         bulk_id=bulk_id)
-            if reply is None or reply["status"] in ("none", "done"):
-                return
-            if reply["status"] == "wait":
-                time.sleep(0.2)
-                continue
-            j, t = reply["job_idx"], reply["task_idx"]
-            try:
-                with self.profiler.span("task", job=j, task=t):
-                    job = self._jobs[j]
-                    w = TaskItem(job, t, job.tasks[t])
-                    from ..graph import analysis as A
-                    w.plan = A.derive_task_streams(
-                        self._info, job.jr, w.output_range, job_idx=j,
-                        task_idx=t)
-                    w.elements = self.executor._load_sources(w, tls)
-                    w.results = self._evaluator.execute_task(
-                        job.jr, w.plan, w.elements)
-                    self.executor._save_task(self._info, w)
-                self.master.try_call("FinishedWork", bulk_id=bulk_id,
-                                     worker_id=self.worker_id,
-                                     job_idx=j, task_idx=t)
-            except Exception as e:  # noqa: BLE001
-                traceback.print_exc()
-                self.master.try_call(
-                    "FailedWork", bulk_id=bulk_id,
-                    worker_id=self.worker_id, job_idx=j, task_idx=t,
-                    error=f"{type(e).__name__}: {e}")
+                                         job_idx=j, task_idx=t)
+                except Exception as e:  # noqa: BLE001
+                    traceback.print_exc()
+                    self.master.try_call(
+                        "FailedWork", bulk_id=bulk_id,
+                        worker_id=self.worker_id, job_idx=j, task_idx=t,
+                        error=f"{type(e).__name__}: {e}")
 
     def wait_for_shutdown(self) -> None:
         while not self._shutdown.is_set():

@@ -41,11 +41,23 @@ def _histogram_impl(frames: jnp.ndarray, bins: int = HISTOGRAM_BINS):
 @register_op(device=DeviceType.TPU, batch=16)
 class Histogram(Kernel):
     """Per-channel 16-bin color histogram; returns [r, g, b] int32 arrays
-    per frame (matching scannertools' UniformList(Histogram, parts=3))."""
+    per frame (matching scannertools' UniformList(Histogram, parts=3)).
+
+    On TPU the pallas compare+reduce kernel runs (kernels/pallas_ops.py);
+    elsewhere the vmapped-bincount XLA path."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        from . import pallas_ops
+        self._use_pallas = pallas_ops.HAVE_PALLAS and pallas_ops.on_tpu()
 
     def execute(self, frame: Sequence[FrameType]) -> Sequence[Any]:
         frames = jnp.asarray(np.asarray(frame))
-        hists = np.asarray(_histogram_impl(frames))
+        if self._use_pallas:
+            from .pallas_ops import histogram_frames
+            hists = np.asarray(histogram_frames(frames))
+        else:
+            hists = np.asarray(_histogram_impl(frames))
         return [[hists[i, c] for c in range(hists.shape[1])]
                 for i in range(hists.shape[0])]
 

@@ -1,0 +1,47 @@
+"""Training-state checkpointing (orbax).
+
+The reference's checkpoint story is job-level (committed tables +
+CacheMode.Ignore resume — SURVEY §5); model *training* is new in this
+framework, so its state gets first-class checkpointing: params + optimizer
+state + step, sharding-aware via orbax (restores onto the current mesh).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class TrainCheckpointer:
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
+
+    def save(self, step: int, params: Any, opt_state: Any) -> None:
+        self._mgr.save(step, args=ocp.args.Composite(
+            params=ocp.args.StandardSave(params),
+            opt_state=ocp.args.StandardSave(opt_state)))
+        self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, params_template: Any, opt_state_template: Any,
+                step: Optional[int] = None) -> Tuple[Any, Any, int]:
+        """Restore onto the templates' shardings (device_put'd trees)."""
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoints under {self.directory}")
+        restored = self._mgr.restore(step, args=ocp.args.Composite(
+            params=ocp.args.StandardRestore(params_template),
+            opt_state=ocp.args.StandardRestore(opt_state_template)))
+        return restored["params"], restored["opt_state"], step
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -1,0 +1,24 @@
+"""Driver contract: entry() compiles single-chip; dryrun_multichip runs a
+full sharded train step on the virtual mesh."""
+
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, "/root/repo")
+import __graft_entry__ as graft
+
+
+def test_entry_compiles():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape[0] == args[1].shape[0]
+    assert np.isfinite(np.asarray(out, np.float32)).all()
+
+
+def test_dryrun_multichip():
+    # n=8 exercises all three mesh axes (dp/sp/tp); smaller n collapse
+    # axes to 1 and were verified manually (they also triple suite time)
+    graft.dryrun_multichip(8)

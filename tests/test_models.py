@@ -95,3 +95,21 @@ def test_sharded_train_step_dp_sp_tp():
     params, opt_state, loss = step(params, opt_state, clip, target)
     params, opt_state, loss = step(params, opt_state, clip, target)
     assert np.isfinite(float(loss))
+
+
+def test_train_checkpoint_roundtrip(tmp_path):
+    import jax
+    from scanner_tpu.models.checkpoint import TrainCheckpointer
+    from scanner_tpu.models import make_sharded_train_step
+    mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2})
+    step, params, opt_state, (clip, target) = make_sharded_train_step(
+        mesh, clip_shape=(2, 4, 32, 32, 3), width=32)
+    params, opt_state, loss1 = step(params, opt_state, clip, target)
+    ck = TrainCheckpointer(str(tmp_path / "ckpt"))
+    ck.save(1, params, opt_state)
+    assert ck.latest_step() == 1
+    # restore onto the same shardings and take another step
+    p2, o2, s = ck.restore(params, opt_state)
+    p2, o2, loss2 = step(p2, o2, clip, target)
+    assert s == 1 and float(loss2) <= float(loss1) * 1.5
+    ck.close()
