@@ -31,6 +31,8 @@ extern "C" {
 #include <libswscale/swscale.h>
 }
 
+#include "scvid_api.h"
+
 #define SCVID_API extern "C" __attribute__((visibility("default")))
 
 namespace {
@@ -55,24 +57,8 @@ SCVID_API void scvid_set_log_level(int level) { av_log_set_level(level); }
 // Ingest: demux a container, write the packet stream, return the index.
 // ---------------------------------------------------------------------------
 
-struct ScvidIndex {
-  int32_t width = 0;
-  int32_t height = 0;
-  double fps = 0.0;
-  int64_t num_samples = 0;
-  char codec[32] = {0};
-  // pts/dts time base of the source stream
-  int32_t tb_num = 0;
-  int32_t tb_den = 1;
-  // arrays of length num_samples, decode order
-  uint64_t* sample_offsets = nullptr;
-  uint64_t* sample_sizes = nullptr;
-  int64_t* sample_pts = nullptr;
-  int64_t* sample_dts = nullptr;
-  uint8_t* keyflags = nullptr;
-  uint8_t* extradata = nullptr;
-  int64_t extradata_size = 0;
-};
+// ScvidIndex layout lives in scvid_api.h; `new ScvidIndex()` below
+// value-initializes every field to zero.
 
 SCVID_API void scvid_index_free(ScvidIndex* idx) {
   if (!idx) return;

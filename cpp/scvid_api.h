@@ -1,0 +1,70 @@
+// C ABI of libscvid (see scvid.cpp for semantics).
+#pragma once
+
+#include <cstdint>
+
+extern "C" {
+
+struct ScvidIndex {
+  int32_t width;
+  int32_t height;
+  double fps;
+  int64_t num_samples;
+  char codec[32];
+  int32_t tb_num;
+  int32_t tb_den;
+  uint64_t* sample_offsets;
+  uint64_t* sample_sizes;
+  int64_t* sample_pts;
+  int64_t* sample_dts;
+  uint8_t* keyflags;
+  uint8_t* extradata;
+  int64_t extradata_size;
+};
+
+struct ScvidDecoder;
+struct ScvidEncoder;
+
+const char* scvid_last_error();
+void scvid_set_log_level(int level);
+
+ScvidIndex* scvid_ingest(const char* in_path, const char* out_packets_path);
+void scvid_index_free(ScvidIndex* idx);
+
+ScvidDecoder* scvid_decoder_create(const char* codec_name,
+                                   const uint8_t* extradata,
+                                   int64_t extradata_size, int32_t width,
+                                   int32_t height, int32_t n_threads);
+void scvid_decoder_destroy(ScvidDecoder* d);
+void scvid_decoder_reset(ScvidDecoder* d);
+int64_t scvid_decode_run(ScvidDecoder* d, const uint8_t* packets,
+                         const uint64_t* pkt_sizes, int64_t n_packets,
+                         const uint8_t* wanted, int64_t n_wanted,
+                         int32_t flush, uint8_t* out, int64_t out_capacity,
+                         int64_t* out_dims);
+int64_t scvid_decoder_emitted(ScvidDecoder* d);
+
+ScvidEncoder* scvid_encoder_create(int32_t width, int32_t height,
+                                   int32_t fps_num, int32_t fps_den,
+                                   const char* codec_name, int64_t bitrate,
+                                   int32_t crf, int32_t keyint);
+void scvid_encoder_destroy(ScvidEncoder* e);
+int64_t scvid_encoder_extradata(ScvidEncoder* e, uint8_t* buf,
+                                int64_t bufsize);
+int32_t scvid_encoder_feed(ScvidEncoder* e, const uint8_t* rgb,
+                           int64_t n_frames);
+int32_t scvid_encoder_flush(ScvidEncoder* e);
+int64_t scvid_encoder_pending(ScvidEncoder* e);
+int64_t scvid_encoder_pending_bytes(ScvidEncoder* e);
+void scvid_encoder_take(ScvidEncoder* e, uint8_t* data, uint64_t* sizes,
+                        uint8_t* keys, int64_t* pts, int64_t* dts);
+
+int32_t scvid_mp4_write(const char* path, int32_t width, int32_t height,
+                        int32_t fps_num, int32_t fps_den, int32_t tb_num,
+                        int32_t tb_den, const char* codec_name,
+                        const uint8_t* extradata, int64_t extradata_size,
+                        const uint8_t* packets, const uint64_t* pkt_sizes,
+                        const uint8_t* keys, const int64_t* pts,
+                        const int64_t* dts, int64_t n_packets);
+
+}  // extern "C"

@@ -1,0 +1,135 @@
+// Native test harness for libscvid (reference analogue:
+// tests/ffmpeg_test.cpp + scanner/video/decoder_automata_test.cpp gtest).
+//
+// Exercises encode -> mux -> ingest/index -> selective decode without
+// Python, so it can run under ASan/UBSan/TSan (`make asan && ./test_scvid`).
+// Exits nonzero on any failure; prints one line per check.
+
+#include <cassert>
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "scvid_api.h"
+
+#define CHECK(cond, msg)                                       \
+  do {                                                         \
+    if (!(cond)) {                                             \
+      fprintf(stderr, "FAIL: %s (%s:%d)\n", msg, __FILE__,     \
+              __LINE__);                                       \
+      exit(1);                                                 \
+    }                                                          \
+    printf("ok: %s\n", msg);                                   \
+  } while (0)
+
+static const int W = 64, H = 48, N = 40, KEYINT = 8;
+
+static void fill_frame(uint8_t* rgb, int i) {
+  for (int p = 0; p < W * H; ++p) {
+    rgb[3 * p + 0] = (uint8_t)((i * 16) % 224);
+    rgb[3 * p + 1] = (uint8_t)(p % 240);
+    rgb[3 * p + 2] = 0;
+  }
+}
+
+static int frame_id(const uint8_t* rgb) {
+  long sum = 0;
+  for (int p = 0; p < W * H; ++p) sum += rgb[3 * p];
+  return (int)((sum / (W * H) + 8) / 16) % 14;
+}
+
+int main() {
+  const char* mp4 = "/tmp/scvid_test.mp4";
+  const char* pkts = "/tmp/scvid_test.pkts";
+
+  // --- encode a deterministic clip -------------------------------------
+  ScvidEncoder* enc = scvid_encoder_create(W, H, 24, 1, "libx264", 0, 18,
+                                           KEYINT);
+  CHECK(enc != nullptr, "encoder create");
+  std::vector<uint8_t> frame(W * H * 3);
+  for (int i = 0; i < N; ++i) {
+    fill_frame(frame.data(), i);
+    CHECK(scvid_encoder_feed(enc, frame.data(), 1) == 0, "encoder feed");
+  }
+  CHECK(scvid_encoder_flush(enc) == 0, "encoder flush");
+  int64_t np = scvid_encoder_pending(enc);
+  CHECK(np == N, "one packet per frame");
+  int64_t nbytes = scvid_encoder_pending_bytes(enc);
+  std::vector<uint8_t> data(nbytes);
+  std::vector<uint64_t> sizes(np);
+  std::vector<uint8_t> keys(np);
+  std::vector<int64_t> pts(np), dts(np);
+  scvid_encoder_take(enc, data.data(), sizes.data(), keys.data(),
+                     pts.data(), dts.data());
+  CHECK(keys[0] == 1, "first packet is a keyframe");
+
+  int64_t xsz = scvid_encoder_extradata(enc, nullptr, 0);
+  CHECK(xsz > 0, "encoder extradata present");
+  std::vector<uint8_t> extradata(xsz);
+  scvid_encoder_extradata(enc, extradata.data(), xsz);
+
+  // --- mux to mp4 -------------------------------------------------------
+  CHECK(scvid_mp4_write(mp4, W, H, 24, 1, 1, 24, "h264", extradata.data(),
+                        xsz, data.data(), sizes.data(), keys.data(),
+                        pts.data(), dts.data(), np) == 0,
+        "mp4 write");
+  scvid_encoder_destroy(enc);
+
+  // --- ingest/index -----------------------------------------------------
+  ScvidIndex* idx = scvid_ingest(mp4, pkts);
+  CHECK(idx != nullptr, "ingest");
+  CHECK(idx->num_samples == N, "sample count");
+  CHECK(idx->width == W && idx->height == H, "geometry");
+  int nkeys = 0;
+  for (int i = 0; i < N; ++i) nkeys += idx->keyflags[i];
+  CHECK(nkeys >= N / KEYINT, "keyframe count");
+
+  // --- selective decode: one mid-GOP frame ------------------------------
+  // find the keyframe governing display frame 13
+  int kf = 0;
+  for (int i = 0; i <= 13; ++i)
+    if (idx->keyflags[i]) kf = i;
+  ScvidDecoder* dec = scvid_decoder_create("h264", idx->extradata,
+                                           idx->extradata_size, W, H, 1);
+  CHECK(dec != nullptr, "decoder create");
+  FILE* f = fopen(pkts, "rb");
+  CHECK(f != nullptr, "packet file open");
+  long off = (long)idx->sample_offsets[kf];
+  long end = (long)(idx->sample_offsets[13] + idx->sample_sizes[13]);
+  std::vector<uint8_t> run(end - off);
+  fseek(f, off, SEEK_SET);
+  CHECK(fread(run.data(), 1, run.size(), f) == run.size(), "packet read");
+  fclose(f);
+  std::vector<uint64_t> run_sizes;
+  for (int i = kf; i <= 13; ++i) run_sizes.push_back(idx->sample_sizes[i]);
+  std::vector<uint8_t> wanted(13 - kf + 1, 0);
+  wanted.back() = 1;
+  std::vector<uint8_t> out(W * H * 3);
+  int64_t dims[2] = {0, 0};
+  int64_t got = scvid_decode_run(dec, run.data(), run_sizes.data(),
+                                 (int64_t)run_sizes.size(), wanted.data(),
+                                 (int64_t)wanted.size(), 1, out.data(),
+                                 (int64_t)out.size(), dims);
+  CHECK(got == 1, "exactly one frame decoded");
+  CHECK(dims[0] == H && dims[1] == W, "decoded geometry");
+  CHECK(frame_id(out.data()) == (13 * 16 % 224 + 8) / 16 % 14,
+        "decoded frame identity");
+
+  // --- capacity guard ---------------------------------------------------
+  scvid_decoder_reset(dec);
+  int64_t bad = scvid_decode_run(dec, run.data(), run_sizes.data(),
+                                 (int64_t)run_sizes.size(), wanted.data(),
+                                 (int64_t)wanted.size(), 1, out.data(),
+                                 16 /* too small */, dims);
+  CHECK(bad == -1, "undersized buffer rejected");
+
+  scvid_decoder_destroy(dec);
+  scvid_index_free(idx);
+  remove(mp4);
+  remove(pkts);
+  printf("all native checks passed\n");
+  return 0;
+}

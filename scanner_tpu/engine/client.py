@@ -81,6 +81,7 @@ class Client:
                  num_load_workers: int = 2,
                  num_save_workers: int = 2,
                  pipeline_instances: int = 1,
+                 decoder_threads: int = 1,
                  config_path: Optional[str] = None,
                  **kw):
         if config_path is not None:
@@ -118,7 +119,8 @@ class Client:
             self._db, self._profiler,
             num_load_workers=num_load_workers,
             num_save_workers=num_save_workers,
-            pipeline_instances=pipeline_instances)
+            pipeline_instances=pipeline_instances,
+            decoder_threads=decoder_threads)
 
     # -- context manager ----------------------------------------------------
 
@@ -237,6 +239,7 @@ class Client:
             self._db, prof,
             num_load_workers=self._executor.num_load_workers,
             num_save_workers=self._executor.num_save_workers,
+            decoder_threads=self._executor.decoder_threads,
             pipeline_instances=kw.get(
                 "pipeline_instances",
                 perf.pipeline_instances_per_node

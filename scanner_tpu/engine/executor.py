@@ -73,13 +73,17 @@ class TaskItem:
 class LocalExecutor:
     def __init__(self, db: Database, profiler: Optional[Profiler] = None,
                  num_load_workers: int = 2, num_save_workers: int = 2,
-                 pipeline_instances: int = 1, node_id: int = 0):
+                 pipeline_instances: int = 1, node_id: int = 0,
+                 decoder_threads: int = 1):
         self.db = db
         self.profiler = profiler or Profiler()
         self.num_load_workers = num_load_workers
         self.num_save_workers = num_save_workers
         self.pipeline_instances = pipeline_instances
         self.node_id = node_id
+        # libav threads per decoder handle (frame threading); total decode
+        # parallelism = num_load_workers x decoder_threads
+        self.decoder_threads = decoder_threads
 
     # ------------------------------------------------------------------
     # Job-set preparation (reference master.cpp:1367 process_job admission)
@@ -116,12 +120,32 @@ class LocalExecutor:
                 raise ScannerException(
                     "io_packet_size must be a multiple of work_packet_size")
             return perf
-        # heuristic: frame pipelines move big elements -> smaller packets
-        any_video = any(
-            getattr(s, "is_video", False)
-            for n in info.sources for s in n.extra["streams"])
-        perf.io_packet_size = 64 if any_video else 512
-        perf.work_packet_size = 16 if any_video else 128
+        # geometry-aware sizing (the reference's PerfParams.estimate
+        # analog, common.py:78-160): target ~64 MB of decoded frames per
+        # io packet so tasks neither thrash tiny items nor blow host RAM
+        frame_bytes = 0
+        for n in info.sources:
+            for s in n.extra["streams"]:
+                if getattr(s, "is_video", False) \
+                        and hasattr(s, "estimate_size"):
+                    # real errors (bad path, storage failure) propagate:
+                    # silently mis-sizing a 4K stream as VGA would blow
+                    # host RAM far from the actual cause
+                    frame_bytes = max(frame_bytes, s.estimate_size())
+        if frame_bytes > 0:
+            target = 64 << 20
+            io = max(16, min(512, target // frame_bytes))
+            work = max(4, min(16, io // 4))
+            io = (io // work) * work
+            perf.io_packet_size = int(io)
+            perf.work_packet_size = int(work)
+        else:
+            perf.io_packet_size = 512
+            perf.work_packet_size = 128
+        # resolution happens exactly once: cluster workers receive the
+        # concrete sizes and must not re-estimate (estimate_size does I/O
+        # and could diverge from the master's task partitioning)
+        perf._estimate = False  # type: ignore[attr-defined]
         return perf
 
     def _prepare_job(self, info: A.GraphInfo, j: int, perf: PerfParams,
@@ -474,7 +498,8 @@ class LocalExecutor:
                     md.video_meta_path(desc.id, si["column"], item)))
             cache[key] = DecoderAutomata(
                 self.db.backend, vd,
-                md.column_item_path(desc.id, si["column"], item))
+                md.column_item_path(desc.id, si["column"], item),
+                n_threads=self.decoder_threads)
         return cache[key]
 
     def _save_task(self, info: A.GraphInfo, w: TaskItem) -> None:

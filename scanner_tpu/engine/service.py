@@ -426,7 +426,8 @@ class Worker:
 
     def __init__(self, master_address: str, db_path: str, port: int = 0,
                  storage_type: str = "posix",
-                 num_load_workers: int = 2, num_save_workers: int = 2):
+                 num_load_workers: int = 2, num_save_workers: int = 2,
+                 decoder_threads: int = 1):
         self.db = Database(make_storage(storage_type, db_path=db_path))
         self.master = rpc.RpcClient(master_address, MASTER_SERVICE,
                                     timeout=10.0)
@@ -440,7 +441,8 @@ class Worker:
         self._server.start()
         self.executor = LocalExecutor(self.db, self.profiler,
                                       num_load_workers=num_load_workers,
-                                      num_save_workers=num_save_workers)
+                                      num_save_workers=num_save_workers,
+                                      decoder_threads=decoder_threads)
         rpc.wait_for_server(master_address, MASTER_SERVICE)
         self.worker_id = self.master.call(
             "RegisterWorker", address=f"localhost:{self.port}")["worker_id"]
