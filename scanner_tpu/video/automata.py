@@ -121,9 +121,19 @@ class DecoderAutomata:
         self._external = bool(vd.data_path)
         self.decoder = Decoder(vd.codec, vd.extradata, vd.width, vd.height,
                                n_threads)
+        # reused decode scratch (grown geometrically) — avoids a fresh
+        # multi-MB allocation per decode run (reference keeps pooled
+        # buffers for the same reason, util/memory.cpp BlockAllocator)
+        self._scratch = np.empty(0, np.uint8)
+
+    def _scratch_buf(self, nbytes: int) -> np.ndarray:
+        if self._scratch.nbytes < nbytes:
+            self._scratch = np.empty(int(nbytes * 1.5) + 1, np.uint8)
+        return self._scratch
 
     def close(self):
         self.decoder.close()
+        self._scratch = np.empty(0, np.uint8)
 
     def _read_packets(self, start_dec: int, end_dec: int
                       ) -> Tuple[bytes, np.ndarray]:
@@ -160,10 +170,16 @@ class DecoderAutomata:
             return np.zeros((0, self.vd.height, self.vd.width, 3), np.uint8)
         runs = self.index.plan(rows_arr)
         h, w = self.vd.height, self.vd.width
-        frames: dict = {}
+        frame_bytes = h * w * 3
+        result = np.empty((len(rows_arr), h, w, 3), np.uint8)
+        # request-order positions of each decoded display index
+        positions: dict = {}
+        for i, r in enumerate(rows_arr.tolist()):
+            positions.setdefault(int(r), []).append(i)
         for run in runs:
             n_out = len(run.out_disp)
-            out = np.empty(n_out * h * w * 3, np.uint8)
+            scratch = self._scratch_buf(n_out * frame_bytes)
+            out = scratch[:n_out * frame_bytes]
             data, sizes = self._read_packets(run.start_dec, run.end_dec)
             self.decoder.reset()
             n, oh, ow = self.decoder.decode_run(data, sizes, run.mask, out,
@@ -177,5 +193,6 @@ class DecoderAutomata:
                     f"decoded geometry {oh}x{ow} != descriptor {h}x{w}")
             out = out.reshape(n_out, h, w, 3)
             for i, d in enumerate(run.out_disp):
-                frames[int(d)] = out[i]
-        return np.stack([frames[int(r)] for r in rows_arr])
+                for pos in positions.get(int(d), ()):
+                    result[pos] = out[i]
+        return result

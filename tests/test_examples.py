@@ -1,0 +1,39 @@
+"""Tutorial smoke tests (reference py_test.py test_tutorial): run example
+scripts as subprocesses against a synthesized clip so they cannot rot."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from scanner_tpu import video as scv
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# representative self-contained examples; the rest of the tutorial flows
+# are covered in-process by the engine/model/distributed suites (each
+# subprocess pays a full jax import + jit compile, so keep this short)
+EXAMPLES = ["00_basic.py", "04_slicing.py"]
+
+
+@pytest.fixture(scope="module")
+def clip(tmp_path_factory):
+    p = str(tmp_path_factory.mktemp("ex") / "clip.mp4")
+    scv.synthesize_video(p, num_frames=48, width=64, height=48, fps=24)
+    return p
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("example", EXAMPLES)
+def test_example_runs(example, clip, tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    # examples default to /tmp/scanner_tpu_db; isolate via HOME-less args
+    args = [sys.executable, os.path.join(REPO, "examples", example), clip]
+    if example == "00_basic.py":
+        args.append(str(tmp_path / "db"))
+    r = subprocess.run(args, env=env, capture_output=True, text=True,
+                       timeout=240)
+    assert r.returncode == 0, f"{example} failed:\n{r.stdout}\n{r.stderr}"
