@@ -1,0 +1,93 @@
+"""JAX backend-selection hardening.
+
+The ambient environment may inject an accelerator PJRT plugin into *every*
+Python interpreter via sitecustomize (triggered by its own env vars) and
+point ``JAX_PLATFORMS`` at it.  When that accelerator tunnel is wedged, any
+``jax.devices()`` call — in this process or any child — hangs.  Tests,
+subprocess workers, and the driver's multi-chip dryrun must therefore be
+able to force a deterministic CPU backend:
+
+- for *child processes*: strip the plugin trigger vars so the sitecustomize
+  block never runs, and set ``JAX_PLATFORMS=cpu`` (`cpu_only_env`);
+- for *this process*, before the first backend touch: set the env vars and
+  ``jax.config`` override (`force_cpu_platform`).
+
+Reference counterpart: the reference forces device selection per-process
+via its own flags (scanner/engine/worker.cpp device registration); on TPU
+the equivalent hazard is PJRT plugin registration order.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+# Env vars that trigger ambient accelerator-plugin registration in child
+# interpreters (sitecustomize).  Stripping them is the only reliable way to
+# keep a wedged tunnel from hanging a child at interpreter start.
+_PLUGIN_TRIGGER_VARS = (
+    "PALLAS_AXON_POOL_IPS",
+    "PALLAS_AXON_TPU_GEN",
+    "PALLAS_AXON_REMOTE_COMPILE",
+)
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def _set_device_count(flags: str, n: int) -> str:
+    """Set (or replace) the virtual CPU device-count flag in XLA_FLAGS."""
+    kept = [f for f in flags.split() if not f.startswith(_COUNT_FLAG)]
+    kept.append(f"{_COUNT_FLAG}={n}")
+    return " ".join(kept)
+
+
+def cpu_only_env(base: Optional[Dict[str, str]] = None,
+                 n_devices: Optional[int] = None) -> Dict[str, str]:
+    """Environment for a child Python process that must use JAX on CPU.
+
+    Strips accelerator-plugin trigger vars, sets ``JAX_PLATFORMS=cpu``, and
+    (optionally) requests ``n_devices`` virtual CPU devices so sharded code
+    paths run without hardware.
+    """
+    env = dict(os.environ if base is None else base)
+    for var in _PLUGIN_TRIGGER_VARS:
+        env.pop(var, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        env["XLA_FLAGS"] = _set_device_count(
+            env.get("XLA_FLAGS", ""), n_devices)
+    return env
+
+
+def force_cpu_platform(n_devices: Optional[int] = None) -> None:
+    """Force THIS process's JAX onto the CPU backend.
+
+    Must run before the first ``jax.devices()`` / backend initialization.
+    Safe to call whether or not jax is already imported (the sitecustomize
+    may have registered an accelerator plugin, but platform selection is
+    still open until a backend is materialized).
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        os.environ["XLA_FLAGS"] = _set_device_count(
+            os.environ.get("XLA_FLAGS", ""), n_devices)
+    import jax
+    # an ambient sitecustomize may have set jax_platforms at config level,
+    # which outranks the env var — override it the same way
+    jax.config.update("jax_platforms", "cpu")
+    # Env/config are only read at backend init, so a too-late call would
+    # otherwise degrade silently — fail fast instead.  (This materializes
+    # the CPU backend, which is fine: that's what we're forcing.)
+    plat = jax.devices()[0].platform
+    if plat != "cpu":
+        raise RuntimeError(
+            f"force_cpu_platform() too late: JAX backend already "
+            f"initialized on '{plat}'; call it before the first "
+            "jax.devices()/computation")
+    if n_devices is not None:
+        have = len(jax.devices())
+        if have < n_devices:
+            raise RuntimeError(
+                f"force_cpu_platform({n_devices}) too late: JAX backend "
+                f"already initialized with {have} CPU device(s); call it "
+                "before the first jax.devices()/computation")

@@ -1,20 +1,10 @@
-import os
-
 # Tests always run on a virtual 8-device CPU mesh so multi-chip sharding
 # logic is exercised without TPU hardware (the ambient environment may point
 # JAX_PLATFORMS at a real chip — override it).  bench.py does NOT import
 # this — it runs on the real chip.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+from scanner_tpu.util.jaxenv import force_cpu_platform
 
-# the axon TPU plugin's sitecustomize overrides jax_platforms via jax.config
-# at interpreter start; force it back to cpu-only for tests
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+force_cpu_platform(n_devices=8)
 
 import pytest  # noqa: E402
 

@@ -178,9 +178,9 @@ def test_fault_tolerance(tmp_path):
     seed.ingest_videos([("test1", vid)])
     master = Master(db_path=db_path, no_workers_timeout=60.0)
     addr = f"localhost:{master.port}"
-    env = dict(os.environ)
+    from scanner_tpu.util.jaxenv import cpu_only_env
+    env = cpu_only_env()
     env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
-    env["JAX_PLATFORMS"] = "cpu"
     spawn = os.path.join(os.path.dirname(__file__), "spawn_worker.py")
 
     def spawn_worker():

@@ -27,9 +27,9 @@ def clip(tmp_path_factory):
 @pytest.mark.slow
 @pytest.mark.parametrize("example", EXAMPLES)
 def test_example_runs(example, clip, tmp_path):
-    env = dict(os.environ)
+    from scanner_tpu.util.jaxenv import cpu_only_env
+    env = cpu_only_env()
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["JAX_PLATFORMS"] = "cpu"
     # examples default to /tmp/scanner_tpu_db; isolate via HOME-less args
     args = [sys.executable, os.path.join(REPO, "examples", example), clip]
     if example == "00_basic.py":
