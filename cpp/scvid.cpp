@@ -310,6 +310,13 @@ SCVID_API int64_t scvid_decode_run(ScvidDecoder* d, const uint8_t* packets,
         out_dims[0] = d->frame->height;
         out_dims[1] = d->frame->width;
         frame_bytes = (int64_t)d->frame->height * d->frame->width * 3;
+      } else if (d->frame->height != out_dims[0] ||
+                 d->frame->width != out_dims[1]) {
+        // mid-stream geometry change (new SPS): frames of differing size
+        // can't be packed into the caller's uniform array — writing one at
+        // an offset computed with the old frame_bytes would overrun.
+        set_error("frame geometry changed mid-run (mid-stream SPS change?)");
+        return -1;
       }
       int64_t fi = d->emitted++;
       if (fi < n_wanted && wanted[fi]) {
@@ -579,6 +586,18 @@ SCVID_API int32_t scvid_mp4_write(const char* path, int32_t width,
     pkt->size = (int)pkt_sizes[i];
     pkt->pts = av_rescale_q(pts[i], {tb_num, tb_den}, stream->time_base);
     pkt->dts = av_rescale_q(dts[i], {tb_num, tb_den}, stream->time_base);
+    // Every packet needs a duration: without it the final sample gets
+    // stts delta 0, the track/edit-list duration excludes the last frame
+    // period, and (depending on ms rounding of the edit list) demuxers
+    // drop the final frame and misreport avg_frame_rate.
+    int64_t next = (i + 1 < n_packets)
+                       ? av_rescale_q(dts[i + 1], {tb_num, tb_den},
+                                      stream->time_base)
+                       : 0;
+    pkt->duration = (i + 1 < n_packets)
+                        ? next - pkt->dts
+                        : av_rescale_q(1, {fps_den, fps_num},
+                                       stream->time_base);
     pkt->flags = keys[i] ? AV_PKT_FLAG_KEY : 0;
     pkt->stream_index = 0;
     cur += pkt_sizes[i];

@@ -522,16 +522,19 @@ class LocalExecutor:
             if codec == "frame":
                 mode = "video" if self._is_encodable(rows) else "pickle"
                 with w.job.sink_mode_lock:
-                    prev = w.job.sink_modes.setdefault(sink.id, mode)
-                    if prev == mode:
-                        # cross-worker guard: the first writer durably
-                        # records the mode; others must agree (distributed
-                        # savers share no process state)
+                    prev = w.job.sink_modes.get(sink.id)
+                    if prev is None:
+                        # cross-worker guard: exactly one writer (across all
+                        # processes) creates the durable marker; everyone
+                        # else reads the winner's mode (distributed savers
+                        # share no process state)
                         marker = f"{md.table_dir(desc.id)}/.{col_name}.mode"
-                        if self.db.backend.exists(marker):
-                            prev = self.db.backend.read(marker).decode()
+                        if self.db.backend.write_exclusive(
+                                marker, mode.encode()):
+                            prev = mode
                         else:
-                            self.db.backend.write(marker, mode.encode())
+                            prev = self.db.backend.read(marker).decode()
+                        w.job.sink_modes[sink.id] = prev
                     if prev != mode:
                         raise JobException(
                             f"{desc.name}: mixed frame output types across "
@@ -590,7 +593,8 @@ class LocalExecutor:
 
     def _demote_video_column(self, desc: md.TableDescriptor) -> None:
         col = desc.columns[0]
-        if col.type != md.ColumnType.VIDEO or col.codec != "pickle":
+        already = (col.type == md.ColumnType.BYTES and col.codec == "pickle")
+        if not already:
             col.type = md.ColumnType.BYTES
             col.codec = "pickle"
             self.db.write_table_descriptor(desc)

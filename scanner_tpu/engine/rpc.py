@@ -108,6 +108,14 @@ class RpcClient:
             return self.call(method, timeout=timeout, **payload)
         except RpcError:
             return None
+        except ValueError as e:
+            # grpc raises ValueError ("Cannot invoke RPC on closed
+            # channel!") after close() — treat a racing shutdown like any
+            # other transport failure so ping/heartbeat threads die
+            # quietly; any other ValueError is a real bug, let it surface
+            if "closed channel" in str(e):
+                return None
+            raise
 
     def close(self) -> None:
         self._channel.close()

@@ -32,6 +32,15 @@ class StorageBackend:
     def write(self, path: str, data: bytes) -> None:
         raise NotImplementedError
 
+    def write_exclusive(self, path: str, data: bytes) -> bool:
+        """Create `path` with `data` only if it does not exist.
+
+        Returns True when this call created the blob, False when it already
+        existed (data untouched).  Atomic across processes — used for
+        cross-worker arbitration markers (first writer wins).
+        """
+        raise NotImplementedError
+
     def exists(self, path: str) -> bool:
         raise NotImplementedError
 
@@ -85,6 +94,26 @@ class PosixStorage(StorageBackend):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, p)
+
+    def write_exclusive(self, path: str, data: bytes) -> bool:
+        p = self._abs(path)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        # write a private tmp first, then link() it into place: the blob
+        # becomes visible fully written (a losing racer must never read a
+        # partially-written marker), and link() fails with EEXIST for all
+        # but exactly one concurrent creator
+        tmp = p + f".xtmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            os.link(tmp, p)
+            return True
+        except FileExistsError:
+            return False
+        finally:
+            os.unlink(tmp)
 
     def exists(self, path: str) -> bool:
         return os.path.exists(self._abs(path))
@@ -143,6 +172,13 @@ class MemoryStorage(StorageBackend):
     def write(self, path: str, data: bytes) -> None:
         with self._lock:
             self._blobs[path] = bytes(data)
+
+    def write_exclusive(self, path: str, data: bytes) -> bool:
+        with self._lock:
+            if path in self._blobs:
+                return False
+            self._blobs[path] = bytes(data)
+            return True
 
     def exists(self, path: str) -> bool:
         with self._lock:

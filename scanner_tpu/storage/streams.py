@@ -93,11 +93,21 @@ class StoredStream:
 
     def load(self, rows: Optional[Sequence[int]] = None,
              column: Optional[str] = None) -> Iterator[Any]:
-        """Deserialize rows (reference StoredStream.load, storage.py:135)."""
+        """Deserialize rows (reference StoredStream.load, storage.py:135).
+
+        Dispatches on the stored column type, so a NamedStream bound to a
+        frame column an engine job wrote in video mode decodes correctly
+        (the items under it are H.264 packet runs, not blob rows)."""
         desc = self.db.table_descriptor(self.name)
         col = column or (
             self.column if self.column in desc.column_names()
             else next(c for c in desc.column_names() if c != "index"))
+        if desc.column_type(col) == md.ColumnType.VIDEO:
+            from ..video.ingest import iter_frames
+            if rows is None:
+                rows = range(desc.num_rows)
+            yield from iter_frames(self.db, self.name, list(rows), col)
+            return
         codec = None
         for c in desc.columns:
             if c.name == col:
@@ -149,16 +159,10 @@ class NamedVideoStream(StoredStream):
         return load_video_meta(self.db, self.name, self.column)
 
     def load(self, rows: Optional[Sequence[int]] = None) -> Iterator[Any]:
-        """Decode frames (reference NamedVideoStream.load via hwang)."""
+        """Decode frames (reference NamedVideoStream.load via hwang);
+        the column-type dispatch lives in StoredStream.load."""
         self.ensure_ingested()
-        desc = self.db.table_descriptor(self.name)
-        if desc.column_type(self.column) != md.ColumnType.VIDEO:
-            yield from super().load(rows=rows)
-            return
-        from ..video.ingest import iter_frames
-        if rows is None:
-            rows = range(desc.num_rows)
-        yield from iter_frames(self.db, self.name, list(rows), self.column)
+        yield from super().load(rows=rows)
 
     def save_mp4(self, path: str) -> None:
         from ..video import export_mp4

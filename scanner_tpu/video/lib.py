@@ -46,9 +46,27 @@ def get_lib():
     global _lib
     if _lib is None:
         if not os.path.exists(_LIB_PATH):
+            # binaries aren't committed — build on first use when the
+            # source tree + toolchain are present (setup.py does the same).
+            # flock serializes concurrent worker processes so none of them
+            # CDLLs a partially-linked .so.
+            cpp_dir = os.path.join(
+                os.path.dirname(__file__), "..", "..", "cpp")
+            build_err = ""
+            if os.path.exists(os.path.join(cpp_dir, "Makefile")):
+                import fcntl
+                import subprocess
+                with open(os.path.join(cpp_dir, ".build.lock"), "w") as lk:
+                    fcntl.flock(lk, fcntl.LOCK_EX)
+                    if not os.path.exists(_LIB_PATH):
+                        r = subprocess.run(["make", "-C", cpp_dir],
+                                           capture_output=True, text=True)
+                        if r.returncode != 0:
+                            build_err = f"\nbuild failed:\n{r.stderr}"
+        if not os.path.exists(_LIB_PATH):
             raise ScannerException(
                 f"libscvid.so not built; run `make -C cpp` (expected at "
-                f"{_LIB_PATH})")
+                f"{_LIB_PATH}){build_err}")
         lib = C.CDLL(_LIB_PATH)
         lib.scvid_last_error.restype = C.c_char_p
         lib.scvid_set_log_level.argtypes = [C.c_int]
@@ -266,8 +284,11 @@ class Encoder:
 def _fps_to_rational(fps: float) -> Tuple[int, int]:
     if abs(fps - round(fps)) < 1e-6:
         return int(round(fps)), 1
-    # NTSC-style rates
-    return int(round(fps * 1001)), 1001
+    # exact small rationals (12.5 -> 25/2) fall out naturally; NTSC rates
+    # (29.97...) resolve to their x1001 form (30000/1001) within the bound
+    from fractions import Fraction
+    frac = Fraction(fps).limit_denominator(100000)
+    return frac.numerator, frac.denominator
 
 
 def write_mp4(path: str, width: int, height: int, fps: float, codec: str,

@@ -19,6 +19,25 @@ def test_posix_atomic_roundtrip(tmp_path):
     assert not s.exists("a/b/c.bin")
 
 
+@pytest.mark.parametrize("make", [
+    lambda p: PosixStorage(str(p)), lambda p: MemoryStorage()])
+def test_write_exclusive_first_writer_wins(tmp_path, make):
+    s = make(tmp_path)
+    assert s.write_exclusive("m/marker", b"video") is True
+    assert s.write_exclusive("m/marker", b"pickle") is False
+    assert s.read("m/marker") == b"video"
+    # concurrent creators: exactly one wins
+    import threading
+    wins = []
+    def race(i):
+        if s.write_exclusive("m/race", f"w{i}".encode()):
+            wins.append(i)
+    ts = [threading.Thread(target=race, args=(i,)) for i in range(8)]
+    [t.start() for t in ts]; [t.join() for t in ts]
+    assert len(wins) == 1
+    assert s.read("m/race") == f"w{wins[0]}".encode()
+
+
 def test_item_format_roundtrip():
     s = MemoryStorage()
     rows = [b"abc", NullElement(), b"", b"xyz" * 100]

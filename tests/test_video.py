@@ -105,6 +105,35 @@ def test_export_mp4_roundtrip(tmp_db, clip, tmp_path):
     assert vd.num_frames == 90
 
 
+@pytest.mark.parametrize("fps", [24.0, 12.5, 30000 / 1001])
+def test_mux_preserves_frame_count_and_fps(tmp_path, fps):
+    """Regression: without per-packet durations the mp4 edit list could
+    exclude the final sample (lost frame at 12.5 fps) and avg_frame_rate
+    was overestimated (24 fps clips reported ~25.04)."""
+    from scanner_tpu.video import lib
+    from scanner_tpu.video.ingest import frame_pattern
+    p = str(tmp_path / "clip.mp4")
+    enc = lib.Encoder(64, 48, fps=fps, keyint=12, crf=18)
+    for i in range(24):
+        enc.feed(frame_pattern(i, 48, 64))
+    enc.flush()
+    data, sizes, keys, pts, dts = enc.take_packets()
+    lib.write_mp4(p, 64, 48, fps, "h264", enc.extradata, data, sizes, keys,
+                  pts, dts)
+    enc.close()
+    vd = lib.ingest_file(p, str(tmp_path / "clip.pkts"))
+    assert vd.num_frames == 24
+    assert vd.fps == pytest.approx(fps, rel=1e-6)
+
+
+def test_fps_to_rational():
+    from scanner_tpu.video.lib import _fps_to_rational
+    assert _fps_to_rational(24) == (24, 1)
+    assert _fps_to_rational(12.5) == (25, 2)       # not NTSC-mangled
+    assert _fps_to_rational(30000 / 1001) == (30000, 1001)
+    assert _fps_to_rational(24000 / 1001) == (24000, 1001)
+
+
 def test_encoder_decoder_roundtrip_lossless_geometry():
     enc = scv.Encoder(64, 48, fps=30, keyint=8)
     frames = np.stack([scv.frame_pattern(i, 48, 64) for i in range(20)])
