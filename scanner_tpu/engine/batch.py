@@ -1,0 +1,199 @@
+"""Batched column data for task evaluation.
+
+The reference keeps per-element buffers from a pooled block allocator and
+re-packs them into batches at each kernel call
+(scanner/util/memory.cpp:269 BlockAllocator,
+scanner/engine/evaluate_worker.cpp:1040-1100 batching).  On TPU the natural
+design is stronger: a task's column is ONE contiguous array the whole way —
+decoded straight into a batch buffer, moved host->device once, sliced (not
+copied) into kernel calls, chained op-to-op as device arrays, and fetched
+back exactly once at the sink.
+
+`ColumnBatch` is that representation.  `data` is one of
+  - ``np.ndarray``  — host batch, axis 0 = rows (uniform frames/blobs)
+  - ``jax.Array``   — device batch, axis 0 = rows
+  - ``list``        — arbitrary python objects (ragged frames, tuples, ...)
+plus a sorted ``rows`` vector naming the (stream-local or global) row ids
+and an optional ``nulls`` mask.  Gathers/slices on array data are views or
+device ops; nothing round-trips through per-row python objects unless a
+per-row (batch=1, non-array) consumer asks for it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..common import NullElement
+
+Elem = Any
+
+
+def _is_jax(x) -> bool:
+    # cheap structural check that avoids importing jax for pure-host runs
+    return type(x).__module__.startswith("jax")
+
+
+def is_array_data(data) -> bool:
+    return isinstance(data, np.ndarray) or _is_jax(data)
+
+
+class ColumnBatch:
+    """One column of one task: row ids + batched data (+ null mask)."""
+
+    __slots__ = ("rows", "data", "nulls", "_row_pos")
+
+    def __init__(self, rows: np.ndarray, data,
+                 nulls: Optional[np.ndarray] = None):
+        self.rows = np.asarray(rows, np.int64)
+        self.data = data
+        self.nulls = nulls if nulls is None or nulls.any() else None
+        self._row_pos = None
+        if not is_array_data(data) and len(data) != len(self.rows):
+            raise ValueError(
+                f"ColumnBatch: {len(data)} elements for {len(self.rows)} rows")
+        if len(self.rows) > 1 and (np.diff(self.rows) <= 0).any():
+            raise ValueError("ColumnBatch rows must be strictly increasing")
+
+    # -- construction ---------------------------------------------------
+
+    @staticmethod
+    def from_elements(rows: Sequence[int], elems: Sequence[Elem]
+                      ) -> "ColumnBatch":
+        """Build from per-row elements; packs uniform ndarrays into one
+        host batch, otherwise stores the object list."""
+        rows = np.asarray(list(rows), np.int64)
+        elems = list(elems)
+        nulls = np.array([isinstance(e, NullElement) or e is None
+                          for e in elems], bool)
+        if nulls.all():
+            return ColumnBatch(rows, [NullElement()] * len(elems), nulls)
+        live = [e for e, n in zip(elems, nulls) if not n]
+        first = live[0]
+        if (isinstance(first, np.ndarray)
+                and all(isinstance(e, np.ndarray) and e.shape == first.shape
+                        and e.dtype == first.dtype for e in live)):
+            if not nulls.any() and len(live) == len(elems):
+                return ColumnBatch(rows, np.stack(elems))
+            batch = np.zeros((len(elems),) + first.shape, first.dtype)
+            batch[~nulls] = np.stack(live)
+            return ColumnBatch(rows, batch, nulls)
+        return ColumnBatch(rows, elems, nulls if nulls.any() else None)
+
+    # -- row lookup -----------------------------------------------------
+
+    def positions(self, rows: np.ndarray) -> np.ndarray:
+        """Positions of `rows` (must all be present) in this batch."""
+        pos = np.searchsorted(self.rows, rows)
+        if (pos >= len(self.rows)).any() or (self.rows[pos] != rows).any():
+            missing = sorted(set(np.asarray(rows).tolist())
+                             - set(self.rows.tolist()))
+            raise KeyError(f"rows not in batch: {missing[:5]}...")
+        return pos
+
+    # -- transforms (device-aware; views/slices where possible) ---------
+
+    def take(self, positions: np.ndarray,
+             new_rows: np.ndarray) -> "ColumnBatch":
+        """Gather positions (−1 ⇒ null row) and relabel to new_rows."""
+        positions = np.asarray(positions, np.int64)
+        new_rows = np.asarray(new_rows, np.int64)
+        neg = positions < 0
+        nulls = None
+        if self.nulls is not None:
+            nulls = np.where(neg, True, self.nulls[np.where(neg, 0,
+                                                            positions)])
+        elif neg.any():
+            nulls = neg
+        safe = np.where(neg, 0, positions)
+        if isinstance(self.data, np.ndarray):
+            # contiguous slice stays a view
+            if (not neg.any() and len(safe)
+                    and np.array_equal(safe,
+                                       np.arange(safe[0],
+                                                 safe[0] + len(safe)))):
+                data = self.data[safe[0]:safe[0] + len(safe)]
+            else:
+                data = self.data[safe]
+        elif _is_jax(self.data):
+            data = self.data[safe]  # on-device gather
+        else:
+            data = [NullElement() if neg[i] else self.data[int(p)]
+                    for i, p in enumerate(safe)]
+        return ColumnBatch(new_rows, data, nulls)
+
+    def take_rows(self, rows: np.ndarray,
+                  new_rows: Optional[np.ndarray] = None) -> "ColumnBatch":
+        return self.take(self.positions(np.asarray(rows, np.int64)),
+                         rows if new_rows is None else new_rows)
+
+    def relabel(self, new_rows: np.ndarray) -> "ColumnBatch":
+        """Same data, new row ids (slice/unslice row renumbering)."""
+        return ColumnBatch(new_rows, self.data, self.nulls)
+
+    # -- device movement ------------------------------------------------
+
+    def to_device(self) -> "ColumnBatch":
+        """Host -> default device, one async transfer for the whole batch."""
+        if isinstance(self.data, np.ndarray):
+            import jax
+            return ColumnBatch(self.rows, jax.device_put(self.data),
+                               self.nulls)
+        return self
+
+    def to_host(self) -> "ColumnBatch":
+        """Materialize device data on host (the single sink-side fetch)."""
+        if _is_jax(self.data):
+            return ColumnBatch(self.rows, np.asarray(self.data), self.nulls)
+        return self
+
+    # -- per-row access (host materialization boundary) -----------------
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def is_null_pos(self, pos: int) -> bool:
+        return self.nulls is not None and bool(self.nulls[pos])
+
+    def element_at(self, pos: int) -> Elem:
+        """Element at position `pos` (a view for host arrays)."""
+        if self.is_null_pos(pos):
+            return NullElement()
+        if _is_jax(self.data):
+            return np.asarray(self.data[pos])
+        return self.data[pos]
+
+    def elements(self) -> List[Elem]:
+        """All elements as per-row host objects (sink/write boundary)."""
+        host = self.to_host()
+        return [host.element_at(i) for i in range(len(host))]
+
+    def get_row(self, row: int) -> Elem:
+        return self.element_at(int(self.positions(
+            np.asarray([row], np.int64))[0]))
+
+
+def concat_batches(parts: List[ColumnBatch]) -> ColumnBatch:
+    """Concatenate row-disjoint batches (already in row order)."""
+    if len(parts) == 1:
+        return parts[0]
+    rows = np.concatenate([p.rows for p in parts])
+    nulls = None
+    if any(p.nulls is not None for p in parts):
+        nulls = np.concatenate(
+            [p.nulls if p.nulls is not None else np.zeros(len(p), bool)
+             for p in parts])
+    datas = [p.data for p in parts]
+    if all(isinstance(d, np.ndarray) for d in datas) and \
+            len({(d.shape[1:], d.dtype) for d in datas}) == 1:
+        return ColumnBatch(rows, np.concatenate(datas), nulls)
+    if all(_is_jax(d) for d in datas):
+        import jax.numpy as jnp
+        if len({(tuple(d.shape[1:]), d.dtype) for d in datas}) == 1:
+            return ColumnBatch(rows, jnp.concatenate(datas), nulls)
+    # mixed / ragged: fall back to object list
+    elems: List[Elem] = []
+    for p in parts:
+        elems.extend(p.elements())
+    return ColumnBatch(rows, elems, nulls)

@@ -1,14 +1,17 @@
-"""Per-task graph evaluation.
+"""Per-task graph evaluation over batched columns.
 
 Capability parity: reference scanner/engine/evaluate_worker.cpp:408-1328
 (EvaluateWorker: row bookkeeping, stencil cache, batching, builtin
 sample/space/slice/unslice remapping, per-slice arg rebinding, state reset).
 
 One TaskEvaluator owns the kernel instances of one pipeline instance and
-executes tasks end-to-end in element space: {(node_id, column): {row: elem}}.
-Frames are numpy uint8 arrays; TPU kernels receive whole batches and jit
-internally.
-"""
+executes tasks end-to-end in column space: {(node_id, column): ColumnBatch}.
+A task's frames live in ONE contiguous batch from decode to sink — builtins
+are vectorized gathers/relabels on the batch, device kernels receive
+on-device slices and chain device-to-device (the reference's pooled
+block-allocator + per-call repacking, memory.cpp:269 /
+evaluate_worker.cpp:1040-1100, replaced by zero-copy views + a single
+host->device transfer per column)."""
 
 from __future__ import annotations
 
@@ -21,6 +24,7 @@ from ..common import (DeviceType, GraphException, JobException, NullElement,
 from ..graph import analysis as A
 from ..graph import ops as O
 from ..util.profiler import Profiler
+from .batch import ColumnBatch, concat_batches, is_array_data
 
 Elem = Any  # np.ndarray | bytes | arbitrary python object | NullElement
 ColKey = Tuple[int, str]  # (node id, column name)
@@ -28,6 +32,19 @@ ColKey = Tuple[int, str]  # (node id, column name)
 
 def _is_null(e: Elem) -> bool:
     return isinstance(e, NullElement)
+
+
+_BACKEND: Optional[str] = None
+
+
+def _accel_backend() -> bool:
+    """True when the default JAX backend is an accelerator.  Device staging
+    is pointless (an extra copy) when jax itself runs on host."""
+    global _BACKEND
+    if _BACKEND is None:
+        import jax
+        _BACKEND = jax.default_backend()
+    return _BACKEND != "cpu"
 
 
 class KernelInstance:
@@ -106,18 +123,17 @@ class TaskEvaluator:
     # ------------------------------------------------------------------
 
     def execute_task(self, jr: A.JobRows, plan: A.TaskPlan,
-                     source_elements: Dict[int, Dict[int, Elem]]
-                     ) -> Dict[int, Dict[int, Elem]]:
-        """Run one task.  source_elements: Input node id -> {row: elem}.
-        Returns sink node id -> {output row: elem}."""
-        store: Dict[ColKey, Dict[int, Elem]] = {}
-        results: Dict[int, Dict[int, Elem]] = {}
+                     source_batches: Dict[int, ColumnBatch]
+                     ) -> Dict[int, ColumnBatch]:
+        """Run one task.  source_batches: Input node id -> ColumnBatch.
+        Returns sink node id -> ColumnBatch of output rows."""
+        store: Dict[ColKey, ColumnBatch] = {}
+        results: Dict[int, ColumnBatch] = {}
 
         for n in self.info.ops:
             ts = plan.streams[n.id]
             if n.name == O.INPUT_OP:
-                elems = source_elements[n.id]
-                store[(n.id, "output")] = elems
+                store[(n.id, "output")] = source_batches[n.id]
             elif n.name in (O.SAMPLE_OP, O.SPACE_OP):
                 store[(n.id, "output")] = self._run_sampler(n, jr, plan, store)
             elif n.name == O.SLICE_OP:
@@ -126,65 +142,72 @@ class TaskEvaluator:
                 store[(n.id, "output")] = self._run_unslice(n, jr, plan, store)
             elif n.name == O.OUTPUT_OP:
                 src = n.input_columns()[0]
-                elems = store[(src.op.id, src.column)]
-                results[n.id] = {r: elems[r]
-                                 for r in ts.valid_output_rows.tolist()}
+                results[n.id] = store[(src.op.id, src.column)].take_rows(
+                    ts.valid_output_rows)
             else:
                 outs = self._run_kernel(n, jr, plan, store)
-                for col, elems in outs.items():
-                    store[(n.id, col)] = elems
+                for col, b in outs.items():
+                    store[(n.id, col)] = b
         return results
 
-    # -- builtins ------------------------------------------------------
+    # -- builtins (vectorized gathers on the batch) ---------------------
 
-    def _input_elems(self, n: O.OpNode, store) -> Dict[int, Elem]:
+    def _input_batch(self, n: O.OpNode, store) -> ColumnBatch:
         src = n.input_columns()[0]
         return store[(src.op.id, src.column)]
 
-    def _run_sampler(self, n, jr, plan, store) -> Dict[int, Elem]:
+    def _run_sampler(self, n, jr, plan, store) -> ColumnBatch:
         ts = plan.streams[n.id]
         g = plan.slice_group if self.info.slice_level[n.id] > 0 else 0
         sampler = jr.samplers[n.id][g]
-        in_elems = self._input_elems(n, store)
+        in_b = self._input_batch(n, store)
         up_rows = ts.valid_input_rows
         down_rows, mapping = sampler.downstream_map(up_rows)
-        needed = set(ts.valid_output_rows.tolist())
-        out: Dict[int, Elem] = {}
-        for d, m in zip(down_rows.tolist(), mapping.tolist()):
-            if d in needed:
-                out[d] = NullElement() if m < 0 else in_elems[int(up_rows[m])]
-        missing = needed - out.keys()
-        if missing:
+        need = np.asarray(ts.valid_output_rows, np.int64)
+        pos_in_down = {int(d): i for i, d in enumerate(down_rows.tolist())}
+        try:
+            sel = np.array([pos_in_down[int(d)] for d in need.tolist()],
+                           np.int64)
+        except KeyError:
+            missing = sorted(set(need.tolist()) - pos_in_down.keys())
             raise JobException(
-                f"{n.name}: missing output rows {sorted(missing)[:5]}...")
-        return out
+                f"{n.name}: missing output rows {missing[:5]}...")
+        m_sel = np.asarray(mapping, np.int64)[sel] if len(sel) else sel
+        if not len(up_rows) or (m_sel < 0).all():
+            return ColumnBatch.from_elements(
+                need, [NullElement()] * len(need))
+        src_rows = up_rows[np.maximum(m_sel, 0)]
+        positions = in_b.positions(np.asarray(src_rows, np.int64))
+        positions = np.where(m_sel < 0, -1, positions)
+        return in_b.take(positions, need)
 
-    def _run_slice(self, n, jr, plan, store) -> Dict[int, Elem]:
+    def _run_slice(self, n, jr, plan, store) -> ColumnBatch:
         ts = plan.streams[n.id]
         group = jr.partitioners[n.id].group_at(plan.slice_group)
-        in_elems = self._input_elems(n, store)
-        return {int(r): in_elems[int(group[r])]
-                for r in ts.valid_output_rows.tolist()}
+        in_b = self._input_batch(n, store)
+        need = np.asarray(ts.valid_output_rows, np.int64)
+        src = np.asarray(group, np.int64)[need]
+        return in_b.take(in_b.positions(src), need)
 
-    def _run_unslice(self, n, jr, plan, store) -> Dict[int, Elem]:
+    def _run_unslice(self, n, jr, plan, store) -> ColumnBatch:
         ts = plan.streams[n.id]
         inp = n.input_columns()[0].op
         offset = int(np.concatenate(
             [[0], np.cumsum(jr.rows[inp.id])])[plan.slice_group])
-        in_elems = self._input_elems(n, store)
-        return {int(r): in_elems[int(r) - offset]
-                for r in ts.valid_output_rows.tolist()}
+        in_b = self._input_batch(n, store)
+        need = np.asarray(ts.valid_output_rows, np.int64)
+        return in_b.take(in_b.positions(need - offset), need)
 
     # -- regular kernels -----------------------------------------------
 
     def _run_kernel(self, n: O.OpNode, jr: A.JobRows, plan: A.TaskPlan,
-                    store) -> Dict[str, Dict[int, Elem]]:
+                    store) -> Dict[str, ColumnBatch]:
         ts = plan.streams[n.id]
         ki = self.kernels[n.id]
         ki.bind_stream(plan.job_idx, plan.slice_group)
 
         in_cols = n.input_columns()
-        in_maps = [store[(c.op.id, c.column)] for c in in_cols]
+        in_batches = [store[(c.op.id, c.column)] for c in in_cols]
         g = plan.slice_group if self.info.slice_level[n.id] > 0 else 0
         in_op = in_cols[0].op
         max_in = jr.rows[in_op.id][g]
@@ -192,92 +215,194 @@ class TaskEvaluator:
         has_stencil = stencil != [0]
         batch = max(1, n.effective_batch())
 
-        compute = ts.compute_rows.tolist()
+        # Device staging: a device kernel gets its inputs moved host->device
+        # ONCE per task column (async, whole batch); a host kernel gets
+        # device inputs fetched once.  Updated in the store so sibling
+        # consumers of the same column reuse the placement.
+        is_device_kernel = (n.effective_device() == DeviceType.TPU
+                            and _accel_backend())
+        for i, (c, b) in enumerate(zip(in_cols, in_batches)):
+            if is_device_kernel and isinstance(b.data, np.ndarray) \
+                    and b.data.dtype != object:
+                b = b.to_device()
+            elif not is_device_kernel:
+                b = b.to_host()
+            in_batches[i] = b
+            store[(c.op.id, c.column)] = b
+
+        compute = np.asarray(ts.compute_rows, np.int64)
         out_cols = [c for c, _ in n.spec.output_columns]
-        outputs: Dict[str, Dict[int, Elem]] = {c: {} for c in out_cols}
-        valid_out = set(ts.valid_output_rows.tolist())
+        valid_out = np.asarray(ts.valid_output_rows, np.int64)
+        valid_set = set(valid_out.tolist())
 
-        def put(row: int, result: Any) -> None:
-            if row not in valid_out:
-                return  # warmup row output discarded
+        # window positions per compute row per input column (REPEAT_EDGE)
+        sten = np.asarray(stencil, np.int64)
+        win_rows = np.clip(compute[:, None] + sten[None, :], 0, max_in - 1)
+        col_pos = [b.positions(win_rows.reshape(-1)).reshape(win_rows.shape)
+                   for b in in_batches]
+
+        # null propagation: a row whose inputs (or stencil window) contain a
+        # null yields null without running the kernel
+        null_in = np.zeros(len(compute), bool)
+        for b, pos in zip(in_batches, col_pos):
+            if b.nulls is not None:
+                null_in |= b.nulls[pos].any(axis=1)
+
+        # contiguous runs of compute rows; reset state between runs
+        run_bounds: List[Tuple[int, int]] = []
+        start = 0
+        for i in range(1, len(compute) + 1):
+            if i == len(compute) or compute[i] != compute[i - 1] + 1:
+                run_bounds.append((start, i))
+                start = i
+        out_parts: Dict[str, List[ColumnBatch]] = {c: [] for c in out_cols}
+
+        def emit(col: str, rows: np.ndarray, data, per_row: bool) -> None:
+            """Append kernel results, dropping warmup rows."""
+            keep = np.isin(rows, valid_out)
+            if not keep.any():
+                return
+            if per_row:
+                kept = [d for d, k in zip(data, keep) if k]
+                out_parts[col].append(
+                    ColumnBatch.from_elements(rows[keep], kept))
+            else:
+                if keep.all():
+                    out_parts[col].append(ColumnBatch(rows, data))
+                else:
+                    idx = np.flatnonzero(keep)
+                    out_parts[col].append(
+                        ColumnBatch(rows[keep], data[idx]))
+
+        def emit_result(rows: np.ndarray, res) -> None:
+            """Dispatch one kernel call's result to output columns.
+
+            Multi-output batch kernels may return either a tuple of
+            per-column batches or a list of per-row tuples (the classic
+            protocol) — both are accepted."""
             if len(out_cols) == 1:
-                outputs[out_cols[0]][row] = result
+                cols_res = (res,)
+            elif isinstance(res, tuple) and len(res) == len(out_cols):
+                cols_res = res
+            elif (isinstance(res, list) and len(res) == len(rows)
+                  and all(isinstance(r, tuple) and len(r) == len(out_cols)
+                          for r in res)):
+                cols_res = tuple(list(col) for col in zip(*res))
             else:
-                if not isinstance(result, tuple) or len(result) != len(out_cols):
-                    raise JobException(
-                        f"{n.name}: expected {len(out_cols)}-tuple output")
-                for c, v in zip(out_cols, result):
-                    outputs[c][row] = v
+                raise JobException(
+                    f"{n.name}: expected {len(out_cols)}-tuple output")
+            for col, r in zip(out_cols, cols_res):
+                if is_array_data(r) and len(r) == len(rows):
+                    emit(col, rows, r, per_row=False)
+                else:
+                    if r is None or len(r) != len(rows):
+                        raise JobException(
+                            f"{n.name}: batch kernel returned "
+                            f"{0 if r is None else len(r)} results "
+                            f"for {len(rows)} inputs")
+                    emit(col, rows, list(r), per_row=True)
 
-        def gather(row: int, col_map: Dict[int, Elem]):
-            """Stencil window (REPEAT_EDGE clamp) or single element."""
-            if has_stencil:
-                window = []
-                for s_off in stencil:
-                    rr = min(max(row + s_off, 0), max_in - 1)
-                    window.append(col_map[rr])
-                return window
-            return col_map[row]
+        null_out_rows: List[int] = []
 
-        # split compute rows into contiguous runs; reset state between runs
-        runs: List[List[int]] = []
-        for r in compute:
-            if runs and r == runs[-1][-1] + 1:
-                runs[-1].append(r)
-            else:
-                runs.append([r])
+        def null_rows(rows: np.ndarray) -> None:
+            keep = np.isin(rows, valid_out)
+            if keep.any():
+                null_out_rows.extend(rows[keep].tolist())
+
+        def call_args_for(sel: np.ndarray) -> List[Any]:
+            """Kernel arguments for compute positions `sel` (indices into
+            the compute/col_pos arrays): per input column either a
+            (k, ...) batch slice, a (k, W, ...) stencil gather, or per-row
+            python objects."""
+            args = []
+            for b, pos in zip(in_batches, col_pos):
+                p = pos[sel]           # (k, W)
+                if is_array_data(b.data):
+                    if has_stencil:
+                        args.append(b.data[p.reshape(-1)].reshape(
+                            p.shape + tuple(b.data.shape[1:])))
+                    else:
+                        q = p[:, 0]
+                        if len(q) and np.array_equal(
+                                q, np.arange(q[0], q[0] + len(q))):
+                            args.append(b.data[q[0]:q[0] + len(q)])
+                        else:
+                            args.append(b.data[q])
+                else:
+                    if has_stencil:
+                        args.append([[b.data[int(j)] for j in row]
+                                     for row in p])
+                    else:
+                        args.append([b.data[int(j)] for j in p[:, 0]])
+            return args
 
         with self.profiler.span("evaluate:" + n.name, rows=len(compute)):
-            for run in runs:
-                ki.maybe_reset(run[0])
-                ki._last_row = run[-1]
-                for i in range(0, len(run), batch):
-                    chunk = run[i:i + batch]
-                    # null propagation: a row whose inputs (or stencil
-                    # window) contain a null yields null without running
-                    # the kernel
-                    live_rows = []
-                    for r in chunk:
-                        window_rows = [min(max(r + s, 0), max_in - 1)
-                                       for s in stencil]
-                        if any(_is_null(m[wr]) for m in in_maps
-                               for wr in window_rows):
-                            put(r, NullElement())
-                        else:
-                            live_rows.append(r)
-                    if not live_rows:
+            for lo, hi in run_bounds:
+                ki.maybe_reset(int(compute[lo]))
+                ki._last_row = int(compute[hi - 1])
+                i = lo
+                while i < hi:
+                    j = min(i + batch, hi)
+                    sel = np.arange(i, j)
+                    live = sel[~null_in[sel]]
+                    dead = sel[null_in[sel]]
+                    if len(dead):
+                        null_rows(compute[dead])
+                    if not len(live):
+                        i = j
                         continue
-                    args_per_col = []
-                    for m in in_maps:
-                        col_vals = [gather(r, m) for r in live_rows]
-                        args_per_col.append(col_vals)
                     if batch > 1:
-                        call_args = [self._maybe_stack(c)
-                                     for c in args_per_col]
-                        res = ki.kernel.execute(*call_args)
-                        if res is None or len(res) != len(live_rows):
-                            raise JobException(
-                                f"{n.name}: batch kernel returned "
-                                f"{0 if res is None else len(res)} results "
-                                f"for {len(live_rows)} inputs")
-                        for r, v in zip(live_rows, res):
-                            put(r, v)
+                        args = call_args_for(live)
+                        res = ki.kernel.execute(*args)
+                        emit_result(compute[live], res)
                     else:
-                        for r, cols_v in zip(
-                                live_rows,
-                                zip(*args_per_col) if args_per_col
-                                else [()] * len(live_rows)):
-                            res = ki.kernel.execute(*cols_v)
-                            put(r, res)
+                        args = call_args_for(live)
+                        row_args = []
+                        for a in args:
+                            e = a[0]
+                            if has_stencil and is_array_data(a):
+                                e = list(a[0])
+                            row_args.append(e)
+                        res = ki.kernel.execute(*row_args)
+                        emit_result(compute[live], _single(res, n, out_cols))
+                    i = j
+
+        # assemble output columns in row order; null-propagated rows (rare)
+        # interleave with kernel results, so columns containing them fall
+        # back to per-element assembly
+        null_set = set(null_out_rows)
+        outputs: Dict[str, ColumnBatch] = {}
+        for col in out_cols:
+            parts = out_parts[col]
+            if not parts and not null_set:
+                outputs[col] = ColumnBatch(np.zeros(0, np.int64), [])
+                continue
+            if null_set:
+                by_row: Dict[int, Elem] = {int(r): NullElement()
+                                           for r in null_set}
+                for p in parts:
+                    for r, e in zip(p.rows.tolist(), p.elements()):
+                        by_row[r] = e
+                rows_sorted = np.asarray(sorted(by_row), np.int64)
+                outputs[col] = ColumnBatch.from_elements(
+                    rows_sorted, [by_row[int(r)] for r in rows_sorted])
+            else:
+                parts.sort(
+                    key=lambda p: int(p.rows[0]) if len(p.rows) else 0)
+                outputs[col] = concat_batches(parts)
+            got = set(outputs[col].rows.tolist())
+            if got != valid_set:
+                missing = sorted(valid_set - got)
+                raise JobException(
+                    f"{n.name}: missing output rows {missing[:5]}...")
         return outputs
 
-    @staticmethod
-    def _maybe_stack(vals: List[Any]):
-        """Stack uniform frame batches into one array so TPU kernels get a
-        single device transfer; fall back to lists for ragged/objects."""
-        if (vals and isinstance(vals[0], np.ndarray)
-                and all(isinstance(v, np.ndarray)
-                        and v.shape == vals[0].shape
-                        and v.dtype == vals[0].dtype for v in vals)):
-            return np.stack(vals)
-        return vals
+
+def _single(res, n, out_cols):
+    """Wrap a batch=1 result to per-row list form for emit_result."""
+    if len(out_cols) == 1:
+        return [res]
+    if not isinstance(res, tuple) or len(res) != len(out_cols):
+        raise JobException(
+            f"{n.name}: expected {len(out_cols)}-tuple output")
+    return tuple([v] for v in res)

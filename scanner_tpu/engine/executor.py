@@ -35,6 +35,7 @@ from ..storage import items as IT
 from ..storage import metadata as md
 from ..storage.streams import NamedVideoStream, StoredStream
 from ..util.profiler import Profiler
+from .batch import ColumnBatch, concat_batches
 from .evaluate import TaskEvaluator
 
 _SENTINEL = object()
@@ -445,15 +446,18 @@ class LocalExecutor:
             w.elements = self._load_sources(w, tls)
         return w
 
-    def _load_sources(self, w: TaskItem, tls) -> Dict[int, Dict[int, Any]]:
-        """Read/decode exactly the rows the task needs."""
-        out: Dict[int, Dict[int, Any]] = {}
+    def _load_sources(self, w: TaskItem, tls) -> Dict[int, ColumnBatch]:
+        """Read/decode exactly the rows the task needs.  Video sources
+        arrive as ONE contiguous (N, H, W, 3) batch straight from the
+        decoder — the zero-copy head of the batched data path."""
+        out: Dict[int, ColumnBatch] = {}
         for node_id, rows in w.plan.source_rows.items():
             si = w.job.source_info[node_id]
+            rows_arr = np.asarray(rows, np.int64)
             rows_l = [int(r) for r in rows]
             if "custom" in si:
                 vals = si["custom"].storage.read_rows(si["custom"], rows_l)
-                out[node_id] = dict(zip(rows_l, vals))
+                out[node_id] = ColumnBatch.from_elements(rows_arr, vals)
             elif si["is_video"]:
                 # rows are global; multi-item video tables (job outputs)
                 # hold one independently-decodable item per task
@@ -463,22 +467,22 @@ class LocalExecutor:
                     it = desc.item_of_row(r)
                     start, _ = desc.item_bounds(it)
                     by_item.setdefault(it, []).append(r - start)
-                elems: Dict[int, Any] = {}
-                for it, local in by_item.items():
+                parts: List[ColumnBatch] = []
+                for it, local in sorted(by_item.items()):
                     start, _ = desc.item_bounds(it)
                     auto = self._automata(tls, w.job, node_id, si, it)
                     frames = auto.get_frames(local)
-                    for i, lr in enumerate(local):
-                        elems[start + lr] = frames[i]
-                out[node_id] = elems
+                    parts.append(ColumnBatch(
+                        np.asarray(local, np.int64) + start, frames))
+                out[node_id] = concat_batches(parts)
             else:
                 from ..storage.streams import decode_element
                 desc = si["table"]
                 vals = list(self.db.load_column(desc.id, si["column"],
                                                 rows=rows_l))
                 codec = si.get("codec", "raw")
-                out[node_id] = {r: decode_element(v, codec)
-                                for r, v in zip(rows_l, vals)}
+                out[node_id] = ColumnBatch.from_elements(
+                    rows_arr, [decode_element(v, codec) for v in vals])
         return out
 
     def _automata(self, tls, job: JobContext, node_id: int, si,
@@ -509,15 +513,15 @@ class LocalExecutor:
         for sink in info.sinks:
             if sink.id in w.job.custom_sinks:
                 stream = w.job.custom_sinks[sink.id]
-                elems = w.results[sink.id]
                 stream.storage.write_item(
-                    stream, start, [elems[r] for r in range(start, end)])
+                    stream, start,
+                    self._sink_rows(w.results[sink.id], start, end))
                 continue
             if sink.id not in w.job.sink_tables:
                 continue
             desc, col_name, codec, enc_opts = w.job.sink_tables[sink.id]
-            elems = w.results[sink.id]
-            rows = [elems[r] for r in range(start, end)]
+            # the single device->host fetch of the batched data path
+            rows = self._sink_rows(w.results[sink.id], start, end)
             item_idx = w.task_idx
             if codec == "frame":
                 mode = "video" if self._is_encodable(rows) else "pickle"
@@ -575,6 +579,13 @@ class LocalExecutor:
                 IT.write_item(self.db.backend,
                               md.column_item_path(desc.id, col_name,
                                                   item_idx), blobs)
+
+    @staticmethod
+    def _sink_rows(batch, start: int, end: int) -> List[Any]:
+        """Materialize a sink ColumnBatch's rows [start, end) as host
+        elements (one device fetch; array rows become views)."""
+        host = batch.take_rows(np.arange(start, end, dtype=np.int64))
+        return host.elements()
 
     @staticmethod
     def _is_encodable(rows: List[Any]) -> bool:

@@ -50,14 +50,37 @@ class Histogram(Kernel):
         super().__init__(config)
         from . import pallas_ops
         self._use_pallas = pallas_ops.HAVE_PALLAS and pallas_ops.on_tpu()
+        # on a host-only backend numpy's C bincount beats the XLA-CPU
+        # scatter lowering; accelerators take the XLA/pallas path
+        self._use_numpy = (not self._use_pallas
+                           and jax.default_backend() == "cpu")
+
+    @staticmethod
+    def _histogram_np(frames: np.ndarray) -> np.ndarray:
+        b, c = frames.shape[0], frames.shape[-1]
+        bins = HISTOGRAM_BINS
+        assert bins == 16, "np fast path assumes 16 bins (uint8 >> 4)"
+        v = (frames >> 4).astype(np.int32)
+        v += np.arange(c, dtype=np.int32) * bins
+        flat = v.reshape(b, -1)
+        # int32, matching the XLA/pallas paths so stored output dtype does
+        # not depend on which backend ran the job
+        out = np.empty((b, c, bins), np.int32)
+        for i in range(b):
+            out[i] = np.bincount(flat[i], minlength=c * bins).reshape(c,
+                                                                      bins)
+        return out
 
     def execute(self, frame: Sequence[FrameType]) -> Sequence[Any]:
-        frames = jnp.asarray(np.asarray(frame))
-        if self._use_pallas:
+        if self._use_numpy and isinstance(frame, np.ndarray):
+            hists = self._histogram_np(frame)
+        elif self._use_pallas:
             from .pallas_ops import histogram_frames
-            hists = np.asarray(histogram_frames(frames))
+            hists = np.asarray(histogram_frames(jnp.asarray(frame)))
         else:
-            hists = np.asarray(_histogram_impl(frames))
+            hists = np.asarray(_histogram_impl(jnp.asarray(frame)))
+        # output column is per-row [r, g, b] objects (pickle codec), so the
+        # batch is fetched once here and split into host views
         return [[hists[i, c] for c in range(hists.shape[1])]
                 for i in range(hists.shape[0])]
 
@@ -86,9 +109,8 @@ class Resize(Kernel):
             self.height = int(height)
 
     def execute(self, frame: Sequence[FrameType]) -> Sequence[FrameType]:
-        frames = jnp.asarray(np.asarray(frame))
-        out = np.asarray(_resize_impl(frames, self.height, self.width))
-        return list(out)
+        # device in -> device out: chained TPU ops never bounce to host
+        return _resize_impl(jnp.asarray(frame), self.height, self.width)
 
 
 def _gaussian_kernel1d(ksize: int, sigma: float) -> np.ndarray:
@@ -123,9 +145,8 @@ class Blur(Kernel):
         self.kern = jnp.asarray(_gaussian_kernel1d(self.ksize, float(sigma)))
 
     def execute(self, frame: Sequence[FrameType]) -> Sequence[FrameType]:
-        frames = jnp.asarray(np.asarray(frame))
-        out = np.asarray(_blur_impl(frames, self.kern, self.ksize))
-        return list(out)
+        # device in -> device out: chained TPU ops never bounce to host
+        return _blur_impl(jnp.asarray(frame), self.kern, self.ksize)
 
 
 @jax.jit
@@ -176,7 +197,13 @@ class OpticalFlow(Kernel):
 
     def execute(self, frame: Sequence[Sequence[FrameType]]
                 ) -> Sequence[FrameType]:
-        prev = jnp.asarray(np.stack([w[0] for w in frame]))
-        nxt = jnp.asarray(np.stack([w[1] for w in frame]))
-        flow = np.asarray(_horn_schunck(_grayscale(prev), _grayscale(nxt)))
-        return list(flow)
+        from ..engine.batch import is_array_data
+        if is_array_data(frame):
+            # engine-gathered (batch, window, H, W, C) array: slice, don't
+            # restack
+            arr = jnp.asarray(frame)
+            prev, nxt = arr[:, 0], arr[:, 1]
+        else:
+            prev = jnp.asarray(np.stack([w[0] for w in frame]))
+            nxt = jnp.asarray(np.stack([w[1] for w in frame]))
+        return _horn_schunck(_grayscale(prev), _grayscale(nxt))

@@ -32,8 +32,13 @@ class HistDiff(Kernel):
 
     def execute(self, frame: Sequence[Sequence[FrameType]]
                 ) -> Sequence[Any]:
-        prev = jnp.asarray(np.stack([w[0] for w in frame]))
-        cur = jnp.asarray(np.stack([w[1] for w in frame]))
+        from ..engine.batch import is_array_data
+        if is_array_data(frame):
+            arr = jnp.asarray(frame)  # engine-gathered (batch, 2, H, W, C)
+            prev, cur = arr[:, 0], arr[:, 1]
+        else:
+            prev = jnp.asarray(np.stack([w[0] for w in frame]))
+            cur = jnp.asarray(np.stack([w[1] for w in frame]))
         hp = _histogram_impl(prev).astype(jnp.float32)
         hc = _histogram_impl(cur).astype(jnp.float32)
         d = jnp.abs(hp - hc).sum(axis=(1, 2))

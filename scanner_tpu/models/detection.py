@@ -140,7 +140,7 @@ class ObjectDetect(Kernel):
         self._infer = infer
 
     def execute(self, frame: Sequence[FrameType]) -> Sequence[Any]:
-        images = jnp.asarray(np.asarray(frame))
+        images = jnp.asarray(frame)
         # SAME-padded stride-16 backbone -> ceil-divided feature map
         fh = -(-images.shape[1] // 16)
         fw = -(-images.shape[2] // 16)

@@ -60,6 +60,6 @@ class FaceEmbedding(Kernel):
         self._apply = jax.jit(self.model.apply)
 
     def execute(self, frame: Sequence[FrameType]) -> Sequence[Any]:
-        images = jnp.asarray(np.asarray(frame))
+        images = jnp.asarray(frame)
         emb = np.asarray(self._apply(self.params, images))
         return list(emb)

@@ -155,7 +155,6 @@ class PoseDetect(Kernel):
         self._apply = jax.jit(self.model.apply)
 
     def execute(self, frame: Sequence[FrameType]) -> Sequence[Any]:
-        frames = np.asarray(frame)
-        clip = jnp.asarray(frames)[:, None]  # (B, 1, H, W, 3)
+        clip = jnp.asarray(frame)[:, None]  # (B, 1, H, W, 3)
         heat = np.asarray(self._apply(self.params, clip))[:, 0]
         return [heatmaps_to_keypoints(h) for h in heat]

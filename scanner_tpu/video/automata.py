@@ -172,6 +172,24 @@ class DecoderAutomata:
         h, w = self.vd.height, self.vd.width
         frame_bytes = h * w * 3
         result = np.empty((len(rows_arr), h, w, 3), np.uint8)
+        if len(runs) == 1 and np.array_equal(
+                np.asarray(runs[0].out_disp, np.int64), rows_arr):
+            # fast path: the run emits exactly the requested rows in
+            # request order — decode straight into the result batch (the
+            # zero-copy head of the engine's batched column path)
+            run = runs[0]
+            data, sizes = self._read_packets(run.start_dec, run.end_dec)
+            self.decoder.reset()
+            n, oh, ow = self.decoder.decode_run(
+                data, sizes, run.mask, result.reshape(-1), flush=True)
+            if n != len(rows_arr):
+                raise ScannerException(
+                    f"decode returned {n} frames, wanted {len(rows_arr)} "
+                    f"(run {run.start_dec}..{run.end_dec})")
+            if (oh, ow) != (h, w):
+                raise ScannerException(
+                    f"decoded geometry {oh}x{ow} != descriptor {h}x{w}")
+            return result
         # request-order positions of each decoded display index
         positions: dict = {}
         for i, r in enumerate(rows_arr.tolist()):

@@ -157,6 +157,27 @@ def test_space(sc):
             assert isinstance(v, NullElement)
 
 
+def test_null_rows_through_kernel(sc):
+    """Regression: interleaved null/live rows inside one batch chunk must
+    survive kernel output assembly (null propagation through a batched
+    kernel after RepeatNull)."""
+    spacing = 2
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    ranged = sc.streams.Range(frame, [(0, 8)])
+    spaced = sc.streams.RepeatNull(ranged, [spacing])
+    t = sc.ops.TestPyBatch(frame=spaced, batch=50)
+    out = NamedStream(sc, "null_through_kernel_out")
+    sc.run(sc.io.Output(t, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    rows = list(out.load())
+    assert len(rows) == 8 * spacing
+    for i, v in enumerate(rows):
+        if i % spacing == 0:
+            assert v == b"point"
+        else:
+            assert isinstance(v, NullElement)
+
+
 def test_stream_args(sc):
     frame = sc.io.Input([NamedVideoStream(sc, "test1")])
     resized = sc.ops.Resize(frame=frame, width=[64], height=[48])
