@@ -20,6 +20,7 @@ from __future__ import annotations
 import queue
 import struct
 import threading
+import time
 import traceback
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -67,8 +68,11 @@ class TaskItem:
     task_idx: int
     output_range: Tuple[int, int]
     plan: Optional[A.TaskPlan] = None
-    elements: Optional[Dict[int, Dict[int, Any]]] = None
-    results: Optional[Dict[int, Dict[int, Any]]] = None
+    elements: Optional[Dict[int, Any]] = None
+    results: Optional[Dict[int, Any]] = None
+    # master-assigned attempt id (cluster mode): distinguishes re-issues
+    # of the same task after a timeout revocation
+    attempt: int = 0
 
 
 class LocalExecutor:
@@ -300,11 +304,46 @@ class LocalExecutor:
 
     def _run_pipeline(self, info: A.GraphInfo, work: List[TaskItem],
                       show_progress: bool) -> None:
-        eval_q: "queue.Queue" = queue.Queue(maxsize=4)
-        save_q: "queue.Queue" = queue.Queue(maxsize=4)
-        task_q: "queue.Queue" = queue.Queue()
-        for w in work:
-            task_q.put(w)
+        pending = list(work)
+        src_lock = threading.Lock()
+
+        def source():
+            with src_lock:
+                return pending.pop(0) if pending else None
+
+        done = self.run_pipeline(info, source, show_progress=show_progress,
+                                 total=len(work))
+        if done != len(work):
+            raise JobException(
+                f"pipeline finished {done}/{len(work)} tasks")
+
+    def run_pipeline(self, info: A.GraphInfo, source,
+                     on_start=None, on_done=None, on_task_error=None,
+                     evaluator_factory=None, close_evaluators: bool = True,
+                     queue_size: Optional[int] = None,
+                     show_progress: bool = False, total: int = 0) -> int:
+        """Multi-stage streaming pipeline (reference worker.cpp:1467-1724
+        load/evaluate/save stage drivers): N loaders pull TaskItems from
+        `source` and decode, P evaluator instances execute, S savers
+        persist.  Shared by the local executor (source = task list) and the
+        cluster worker (source = master NextWork pull), so a cluster worker
+        keeps every stage of the node busy instead of running one task at a
+        time.
+
+        source() -> TaskItem | "wait" (retry shortly) | None (exhausted);
+        called concurrently from loader threads.
+        on_start(w) -> bool | None: evaluation-begin hook (cluster:
+        StartedWork RPC); returning False drops the task without
+        evaluating (revoked attempt).  on_done(w): save-complete hook
+        (cluster: FinishedWork RPC).
+        on_task_error(w, exc) -> bool: True = task failure is reported and
+        the pipeline continues (cluster); False/None = abort (local).
+        evaluator_factory(idx, skip_fetch) -> TaskEvaluator: override to
+        reuse evaluators across pipeline entries (cluster worker).
+        Returns the number of tasks fully saved."""
+        qsize = queue_size or 4
+        eval_q: "queue.Queue" = queue.Queue(maxsize=qsize)
+        save_q: "queue.Queue" = queue.Queue(maxsize=qsize)
         errors: List[BaseException] = []
         err_lock = threading.Lock()
         stop = threading.Event()
@@ -314,6 +353,13 @@ class LocalExecutor:
                 errors.append(e)
             stop.set()
 
+        def task_failed(w: TaskItem, e: BaseException) -> None:
+            """Route one task's failure; abort unless the error handler
+            accepts it (cluster mode reports FailedWork and moves on)."""
+            if on_task_error is not None and on_task_error(w, e):
+                return
+            record_err(e)
+
         # loader cache: (thread, job, node) -> DecoderAutomata
         tls = threading.local()
 
@@ -321,11 +367,17 @@ class LocalExecutor:
             try:
                 try:
                     while not stop.is_set():
-                        try:
-                            w: TaskItem = task_q.get_nowait()
-                        except queue.Empty:
+                        w = source()
+                        if w is None:
                             break
-                        self.load_task(info, w, tls)
+                        if w == "wait":
+                            time.sleep(0.2)
+                            continue
+                        try:
+                            self.load_task(info, w, tls)
+                        except Exception as e:  # noqa: BLE001
+                            task_failed(w, e)
+                            continue
                         while not stop.is_set():
                             try:
                                 eval_q.put(w, timeout=0.25)
@@ -341,44 +393,55 @@ class LocalExecutor:
             except BaseException as e:  # noqa: BLE001
                 record_err(e)
 
+        def make_evaluator(idx: int, skip_fetch: bool) -> TaskEvaluator:
+            if evaluator_factory is not None:
+                return evaluator_factory(idx, skip_fetch)
+            return TaskEvaluator(info, self.profiler,
+                                 skip_fetch_resources=skip_fetch)
+
         def evaluator(evaluator_idx: int):
+            te = None
             try:
                 # fetch_resources runs once per node: instance 0 fetches,
                 # the rest only setup (reference evaluate_worker.cpp:488-534)
                 if evaluator_idx > 0:
                     fetch_done.wait()
-                te = TaskEvaluator(
-                    info, self.profiler,
-                    skip_fetch_resources=evaluator_idx > 0)
+                te = make_evaluator(evaluator_idx, evaluator_idx > 0)
                 if evaluator_idx == 0:
                     fetch_done.set()
-                try:
-                    while not stop.is_set():
-                        try:
-                            w: TaskItem = eval_q.get(timeout=0.25)
-                        except queue.Empty:
-                            if loaders_done.is_set() and eval_q.empty():
-                                break
-                            continue
-                        if w is _SENTINEL:
+                while not stop.is_set():
+                    try:
+                        w: TaskItem = eval_q.get(timeout=0.25)
+                    except queue.Empty:
+                        if loaders_done.is_set() and eval_q.empty():
                             break
+                        continue
+                    if w is _SENTINEL:
+                        break
+                    try:
+                        if on_start is not None and on_start(w) is False:
+                            continue  # revoked attempt: drop silently
                         with self.profiler.span("evaluate",
                                                 task=w.task_idx,
                                                 job=w.job.job_idx):
                             w.results = te.execute_task(
                                 w.job.jr, w.plan, w.elements)
                         w.elements = None
-                        while not stop.is_set():
-                            try:
-                                save_q.put(w, timeout=0.25)
-                                break
-                            except queue.Full:
-                                pass
-                finally:
-                    te.close()
+                    except Exception as e:  # noqa: BLE001
+                        task_failed(w, e)
+                        continue
+                    while not stop.is_set():
+                        try:
+                            save_q.put(w, timeout=0.25)
+                            break
+                        except queue.Full:
+                            pass
             except BaseException as e:  # noqa: BLE001
                 record_err(e)
+            finally:
                 fetch_done.set()  # never leave siblings waiting
+                if te is not None and close_evaluators:
+                    te.close()
 
         done_count = [0]
         done_lock = threading.Lock()
@@ -392,13 +455,19 @@ class LocalExecutor:
                         if evals_done.is_set() and save_q.empty():
                             break
                         continue
-                    with self.profiler.span("save", task=w.task_idx,
-                                            job=w.job.job_idx):
-                        self._save_task(info, w)
+                    try:
+                        with self.profiler.span("save", task=w.task_idx,
+                                                job=w.job.job_idx):
+                            self._save_task(info, w)
+                        if on_done is not None:
+                            on_done(w)
+                    except Exception as e:  # noqa: BLE001
+                        task_failed(w, e)
+                        continue
                     with done_lock:
                         done_count[0] += 1
                         if show_progress:
-                            print(f"\rtasks {done_count[0]}/{len(work)}",
+                            print(f"\rtasks {done_count[0]}/{total}",
                                   end="", flush=True)
             except BaseException as e:  # noqa: BLE001
                 record_err(e)
@@ -428,9 +497,7 @@ class LocalExecutor:
             print()
         if errors:
             raise errors[0]
-        if done_count[0] != len(work):
-            raise JobException(
-                f"pipeline finished {done_count[0]}/{len(work)} tasks")
+        return done_count[0]
 
     # ------------------------------------------------------------------
 

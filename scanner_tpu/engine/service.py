@@ -60,8 +60,15 @@ class _BulkJob:
     spec_blob: bytes                    # graph + resolved perf + cache mode
     task_timeout: float
     queue: List[Tuple[int, int]] = field(default_factory=list)
-    outstanding: Dict[Tuple[int, int], Tuple[int, float]] = \
+    # (job, task) -> (worker id, clock start, attempt id).  The attempt id
+    # makes assignments distinguishable: after a timeout revocation the
+    # same worker may legitimately be re-assigned the task while its stale
+    # attempt still runs, and only the *current* attempt's completion may
+    # count (reference master.cpp:2111 stop_job_on_worker kills the stale
+    # attempt instead; here it reports and is ignored).
+    outstanding: Dict[Tuple[int, int], Tuple[int, float, int]] = \
         field(default_factory=dict)
+    next_attempt: int = 0
     done: Set[Tuple[int, int]] = field(default_factory=set)
     failures: Dict[Tuple[int, int], int] = field(default_factory=dict)
     blacklisted_jobs: Set[int] = field(default_factory=set)
@@ -205,6 +212,7 @@ class Master:
     def _rpc_next_work(self, req: dict) -> dict:
         wid = req["worker_id"]
         bulk_id = req["bulk_id"]
+        window = int(req.get("window") or 0)
         with self._lock:
             self._touch_worker(wid)
             bulk = self._bulk
@@ -213,12 +221,22 @@ class Master:
             w = self._workers.get(wid)
             if w is None or not w.active:
                 return {"status": "none"}
+            if window:
+                # per-worker in-flight window: don't let one node's
+                # loaders hoard the queue while its siblings idle
+                held = sum(1 for (hw, _t0, _a) in bulk.outstanding.values()
+                           if hw == wid)
+                if held >= window and bulk.queue:
+                    return {"status": "wait"}
             while bulk.queue:
                 j, t = bulk.queue.pop(0)
                 if j in bulk.blacklisted_jobs or (j, t) in bulk.done:
                     continue
-                bulk.outstanding[(j, t)] = (wid, time.time())
-                return {"status": "task", "job_idx": j, "task_idx": t}
+                attempt = bulk.next_attempt
+                bulk.next_attempt += 1
+                bulk.outstanding[(j, t)] = (wid, time.time(), attempt)
+                return {"status": "task", "job_idx": j, "task_idx": t,
+                        "attempt": attempt}
             if bulk.outstanding:
                 return {"status": "wait"}
             return {"status": "done"}
@@ -234,8 +252,9 @@ class Master:
             if bulk is None or bulk.bulk_id != req["bulk_id"]:
                 return {"ok": False}
             cur = bulk.outstanding.get(key)
-            if cur is not None and cur[0] == req.get("worker_id"):
-                bulk.outstanding[key] = (cur[0], time.time())
+            if cur is not None and cur[0] == req.get("worker_id") \
+                    and cur[2] == req.get("attempt"):
+                bulk.outstanding[key] = (cur[0], time.time(), cur[2])
                 return {"ok": True}
         return {"ok": False, "revoked": True}
 
@@ -247,11 +266,13 @@ class Master:
             if bulk is None or bulk.bulk_id != req["bulk_id"]:
                 return {"ok": False}
             # a completion only counts if this worker still holds the
-            # assignment — revoked (timed-out/reassigned) attempts are
-            # ignored, the in-process equivalent of the reference killing
-            # the slow worker (stop_job_on_worker, master.cpp:2111)
-            holder = bulk.outstanding.get(key, (None, 0.0))[0]
-            if holder != req.get("worker_id"):
+            # assignment WITH the same attempt id — revoked
+            # (timed-out/reassigned) attempts are ignored, the in-process
+            # equivalent of the reference killing the slow worker
+            # (stop_job_on_worker, master.cpp:2111)
+            cur = bulk.outstanding.get(key)
+            if cur is None or cur[0] != req.get("worker_id") \
+                    or cur[2] != req.get("attempt"):
                 return {"ok": False, "revoked": True}
             bulk.outstanding.pop(key, None)
             if key in bulk.done or key[0] in bulk.blacklisted_jobs:
@@ -269,8 +290,9 @@ class Master:
             bulk = self._bulk
             if bulk is None or bulk.bulk_id != req["bulk_id"]:
                 return {"ok": False}
-            holder = bulk.outstanding.get(key, (None, 0.0))[0]
-            if holder != req.get("worker_id"):
+            cur = bulk.outstanding.get(key)
+            if cur is None or cur[0] != req.get("worker_id") \
+                    or cur[2] != req.get("attempt"):
                 return {"ok": False, "revoked": True}
             bulk.outstanding.pop(key, None)
             if key in bulk.done:
@@ -369,7 +391,8 @@ class Master:
                 if bulk is not None and not bulk.finished:
                     # per-task timeout
                     if bulk.task_timeout > 0:
-                        for key, (wid, t0) in list(bulk.outstanding.items()):
+                        for key, (wid, t0, _a) in \
+                                list(bulk.outstanding.items()):
                             if now - t0 > bulk.task_timeout:
                                 bulk.outstanding.pop(key)
                                 n = bulk.failures.get(key, 0) + 1
@@ -398,7 +421,7 @@ class Master:
         bulk = self._bulk
         if bulk is None or bulk.finished:
             return
-        for key, (owner, _t0) in list(bulk.outstanding.items()):
+        for key, (owner, _t0, _a) in list(bulk.outstanding.items()):
             if owner == wid:
                 bulk.outstanding.pop(key)
                 bulk.queue.append(key)
@@ -427,6 +450,7 @@ class Worker:
     def __init__(self, master_address: str, db_path: str, port: int = 0,
                  storage_type: str = "posix",
                  num_load_workers: int = 2, num_save_workers: int = 2,
+                 pipeline_instances: int = 1,
                  decoder_threads: int = 1):
         self.db = Database(make_storage(storage_type, db_path=db_path))
         self.master = rpc.RpcClient(master_address, MASTER_SERVICE,
@@ -442,6 +466,7 @@ class Worker:
         self.executor = LocalExecutor(self.db, self.profiler,
                                       num_load_workers=num_load_workers,
                                       num_save_workers=num_save_workers,
+                                      pipeline_instances=pipeline_instances,
                                       decoder_threads=decoder_threads)
         rpc.wait_for_server(master_address, MASTER_SERVICE)
         self.worker_id = self.master.call(
@@ -450,7 +475,11 @@ class Worker:
         self._bulk_id: Optional[int] = None
         self._info = None
         self._jobs = None
-        self._evaluator: Optional[TaskEvaluator] = None
+        self._queue_size: Optional[int] = None
+        self._default_pipeline_instances = pipeline_instances
+        # evaluator instances reused across pipeline entries of one bulk
+        self._evaluators: Dict[int, TaskEvaluator] = {}
+        self._eval_lock = threading.Lock()
         self._posted_profiles: set = set()
         # heartbeat runs on its own thread so a long task never makes the
         # master think this worker died (stale-worker scan)
@@ -490,12 +519,19 @@ class Worker:
                 continue
             try:
                 self._ensure_bulk(bulk_id)
+                self._pull_loop(bulk_id)
             except Exception:  # noqa: BLE001
+                # a pipeline-level failure (e.g. evaluator construction)
+                # must not kill this thread while the heartbeat keeps the
+                # worker looking alive — back off and retry
                 traceback.print_exc()
                 time.sleep(PING_INTERVAL)
                 continue
-            self._pull_and_run(bulk_id)
             self._post_profile(bulk_id)
+            # the master may report the bulk active for up to one ping
+            # after its last task: don't respin the whole pipeline
+            # (threads + NextWork RPCs) in a tight loop meanwhile
+            time.sleep(PING_INTERVAL / 4)
 
     def _post_profile(self, bulk_id: int) -> None:
         """Ship this worker's profile to the master once per bulk job
@@ -518,103 +554,109 @@ class Worker:
         # fresh profiler per bulk so PostProfile ships only this job's spans
         self.profiler = Profiler(node=f"worker{self.worker_id}")
         self.executor.profiler = self.profiler
+        # the job's PerfParams drive this node's pipeline shape (reference
+        # worker.cpp:1467 pipeline instance spin-up from job params); an
+        # unset knob restores the worker's constructor default rather than
+        # inheriting the previous bulk's override
+        self.executor.pipeline_instances = int(
+            getattr(perf, "pipeline_instances_per_node", None)
+            or self._default_pipeline_instances)
+        self._queue_size = int(getattr(perf, "queue_size_per_pipeline", 4))
         info, jobs = self.executor.prepare_readonly(outputs, perf)
-        if self._evaluator is not None:
-            self._evaluator.close()
-        self._evaluator = TaskEvaluator(info, self.profiler)
+        with self._eval_lock:
+            for te in self._evaluators.values():
+                te.close()
+            self._evaluators = {}
         self._info, self._jobs = info, jobs
         self._bulk_id = bulk_id
-
-    def _pull_and_run(self, bulk_id: int) -> None:
-        # plain namespace, not threading.local: only the single prefetch
-        # thread loads, and cleanup must see its decoder cache
-        import types
-        tls = types.SimpleNamespace()
-        try:
-            self._pull_loop(bulk_id, tls)
-        finally:
-            # release decoder handles held for this bulk
-            for auto in getattr(tls, "automata", {}).values():
-                auto.close()
 
     def _pull_next(self, bulk_id: int):
         """Ask the master for one task; returns TaskItem, 'wait', None
         (bulk over), or ('task_error', j, t, exc)."""
         if self._hb_reply.get("active_bulk") != bulk_id:
             return None
+        window = (self.executor.pipeline_instances
+                  + self.executor.num_load_workers)
         reply = self.master.try_call("NextWork", worker_id=self.worker_id,
-                                     bulk_id=bulk_id)
+                                     bulk_id=bulk_id, window=window)
         if reply is None or reply["status"] in ("none", "done"):
             return None
         if reply["status"] == "wait":
             return "wait"
         j, t = reply["job_idx"], reply["task_idx"]
+        attempt = reply.get("attempt", 0)
         try:
             job = self._jobs[j]
-            return TaskItem(job, t, job.tasks[t])
+            return TaskItem(job, t, job.tasks[t], attempt=attempt)
         except Exception as e:  # noqa: BLE001  (job-list skew etc.)
-            return ("task_error", j, t, e)
+            return ("task_error", j, t, attempt, e)
 
-    def _pull_loop(self, bulk_id: int, tls) -> None:
-        """Pull-execute loop with one-task prefetch: while the evaluator
-        runs task N, a background thread pulls and loads task N+1 (decode
-        releases the GIL), the in-worker analogue of the reference's
-        load -> evaluate pipeline stages (worker.cpp:1467-1724)."""
-        from concurrent.futures import ThreadPoolExecutor
-        with ThreadPoolExecutor(max_workers=1,
-                                thread_name_prefix="prefetch") as pool:
-            pending = None  # Future of the next loaded TaskItem
+    def _pull_loop(self, bulk_id: int) -> None:
+        """Drive the full multi-stage pipeline from the master's queue:
+        N loaders pull+decode concurrently (decode releases the GIL), P
+        evaluator instances execute, S savers persist — the reference
+        worker's per-node stage threads (worker.cpp:1467-1724, 1876-1890).
+        The worker keeps up to (loaders + queue depths + P) tasks in
+        flight; the master's timeout clock restarts per task at
+        StartedWork."""
 
-            def fetch():
-                nxt = self._pull_next(bulk_id)
-                if isinstance(nxt, TaskItem):
-                    try:
-                        return self.executor.load_task(self._info, nxt, tls)
-                    except Exception as e:  # noqa: BLE001
-                        # report the load failure from the main loop so
-                        # the master's failure accounting still runs
-                        return ("task_error", nxt.job.job_idx,
-                                nxt.task_idx, e)
-                return nxt
+        def source():
+            if self._shutdown.is_set():
+                return None
+            nxt = self._pull_next(bulk_id)
+            if isinstance(nxt, tuple) and nxt[0] == "task_error":
+                _tag, j, t, attempt, exc = nxt
+                traceback.print_exception(exc)
+                self.master.try_call(
+                    "FailedWork", bulk_id=bulk_id,
+                    worker_id=self.worker_id, job_idx=j, task_idx=t,
+                    attempt=attempt,
+                    error=f"{type(exc).__name__}: {exc}")
+                return "wait"
+            return nxt
 
-            pending = pool.submit(fetch)
-            while not self._shutdown.is_set():
-                w = pending.result()
-                if w is None:
-                    return
-                if w == "wait":
-                    time.sleep(0.2)
-                    pending = pool.submit(fetch)
-                    continue
-                pending = pool.submit(fetch)  # overlap with evaluation
-                if isinstance(w, tuple) and w[0] == "task_error":
-                    _tag, j, t, exc = w
-                    traceback.print_exception(exc)
-                    self.master.try_call(
-                        "FailedWork", bulk_id=bulk_id,
-                        worker_id=self.worker_id, job_idx=j, task_idx=t,
-                        error=f"{type(exc).__name__}: {exc}")
-                    continue
-                j, t = w.job.job_idx, w.task_idx
-                try:
-                    # restart the master's timeout clock: evaluation of
-                    # this prefetched task starts now
-                    self.master.try_call(
-                        "StartedWork", bulk_id=bulk_id,
-                        worker_id=self.worker_id, job_idx=j, task_idx=t)
-                    with self.profiler.span("task", job=j, task=t):
-                        w.results = self._evaluator.execute_task(
-                            w.job.jr, w.plan, w.elements)
-                        self.executor._save_task(self._info, w)
-                    self.master.try_call("FinishedWork", bulk_id=bulk_id,
-                                         worker_id=self.worker_id,
-                                         job_idx=j, task_idx=t)
-                except Exception as e:  # noqa: BLE001
-                    traceback.print_exc()
-                    self.master.try_call(
-                        "FailedWork", bulk_id=bulk_id,
-                        worker_id=self.worker_id, job_idx=j, task_idx=t,
-                        error=f"{type(e).__name__}: {e}")
+        def on_start(w) -> bool:
+            # restart the master's timeout clock: evaluation of this
+            # prefetched task starts now.  A revoked reply means this
+            # attempt timed out in our queue and was re-assigned — drop it
+            # rather than evaluate/save a stale attempt concurrently with
+            # its replacement (reference stop_job_on_worker,
+            # master.cpp:2111)
+            reply = self.master.try_call(
+                "StartedWork", bulk_id=bulk_id, worker_id=self.worker_id,
+                job_idx=w.job.job_idx, task_idx=w.task_idx,
+                attempt=w.attempt)
+            return reply is None or bool(reply.get("ok"))
+
+        def on_done(w) -> None:
+            self.master.try_call(
+                "FinishedWork", bulk_id=bulk_id, worker_id=self.worker_id,
+                job_idx=w.job.job_idx, task_idx=w.task_idx,
+                attempt=w.attempt)
+
+        def on_task_error(w, exc) -> bool:
+            traceback.print_exception(exc)
+            self.master.try_call(
+                "FailedWork", bulk_id=bulk_id, worker_id=self.worker_id,
+                job_idx=w.job.job_idx, task_idx=w.task_idx,
+                attempt=w.attempt,
+                error=f"{type(exc).__name__}: {exc}")
+            return True  # keep the pipeline running
+
+        def evaluator_factory(idx: int, skip_fetch: bool) -> TaskEvaluator:
+            with self._eval_lock:
+                te = self._evaluators.get(idx)
+                if te is None:
+                    te = TaskEvaluator(self._info, self.profiler,
+                                       skip_fetch_resources=skip_fetch)
+                    self._evaluators[idx] = te
+                return te
+
+        self.executor.run_pipeline(
+            self._info, source, on_start=on_start, on_done=on_done,
+            on_task_error=on_task_error,
+            evaluator_factory=evaluator_factory, close_evaluators=False,
+            queue_size=self._queue_size)
 
     def wait_for_shutdown(self) -> None:
         while not self._shutdown.is_set():
@@ -624,8 +666,10 @@ class Worker:
     def stop(self) -> None:
         self._shutdown.set()
         self._server.stop()
-        if self._evaluator is not None:
-            self._evaluator.close()
+        with self._eval_lock:
+            for te in self._evaluators.values():
+                te.close()
+            self._evaluators = {}
         self.master.close()
 
 
