@@ -87,9 +87,19 @@ class PerfParams:
     pipeline_instances_per_node: Optional[int] = None
     load_sparsity_threshold: int = 8
     queue_size_per_pipeline: int = 4
-    cpu_pool: Optional[str] = None
     task_timeout: float = 0.0  # seconds; 0 = no timeout
     checkpoint_frequency: int = 10
+
+    # reference-compat kwargs that are meaningless on TPU and accepted but
+    # ignored (XLA owns device/host memory pooling; there is no CUDA pool
+    # to size — reference common.py cpu_pool/gpu_pool)
+    _IGNORED_KWARGS = ("cpu_pool", "gpu_pool", "pinned_cpu_pool")
+
+    @classmethod
+    def _strip_ignored(cls, kw: dict) -> dict:
+        for k in cls._IGNORED_KWARGS:
+            kw.pop(k, None)
+        return kw
 
     @classmethod
     def manual(cls, work_packet_size: int, io_packet_size: int, **kw) -> "PerfParams":
@@ -98,13 +108,13 @@ class PerfParams:
                 f"io_packet_size ({io_packet_size}) must be a multiple of "
                 f"work_packet_size ({work_packet_size})")
         return cls(work_packet_size=work_packet_size,
-                   io_packet_size=io_packet_size, **kw)
+                   io_packet_size=io_packet_size, **cls._strip_ignored(kw))
 
     @classmethod
     def estimate(cls, **kw) -> "PerfParams":
         """Auto-tuned variant; heuristics are applied at job-launch time when
         stream geometry is known (engine/executor.py)."""
-        p = cls(**kw)
+        p = cls(**cls._strip_ignored(kw))
         p._estimate = True  # type: ignore[attr-defined]
         return p
 

@@ -213,7 +213,19 @@ class TaskEvaluator:
         max_in = jr.rows[in_op.id][g]
         stencil = n.effective_stencil()
         has_stencil = stencil != [0]
-        batch = max(1, n.effective_batch())
+        # The batch DECLARATION fixes the calling convention (batched
+        # kernels always receive row batches, even 1-row ones) and CAPS
+        # the per-call batch (ops declare it as a memory bound); within
+        # that cap, PerfParams.work_packet_size sets the chunk — the XLA
+        # batch dimension (reference io/work packet split, master.cpp:1421)
+        # — unless the op was constructed with an explicit batch= override.
+        batched_call = n.effective_batch() > 1
+        if batched_call and n.batch is None:
+            batch = max(1, min(n.effective_batch(),
+                               int(getattr(jr, "work_packet_size",
+                                           n.effective_batch()))))
+        else:
+            batch = max(1, n.effective_batch())
 
         # Device staging: a device kernel gets its inputs moved host->device
         # ONCE per task column (async, whole batch); a host kernel gets
@@ -351,7 +363,7 @@ class TaskEvaluator:
                     if not len(live):
                         i = j
                         continue
-                    if batch > 1:
+                    if batched_call:
                         args = call_args_for(live)
                         res = ki.kernel.execute(*args)
                         emit_result(compute[live], res)

@@ -54,6 +54,9 @@ class JobContext:
     fps: float = 30.0
     skipped: bool = False
     tasks_done: int = 0
+    # sparse-read crossover for column loads (PerfParams
+    # .load_sparsity_threshold -> items.read_item_rows)
+    sparsity_threshold: int = 8
     # per sink id: "video" | "pickle", fixed by the first task written so
     # mixed-dtype frame outputs fail loudly instead of corrupting the table
     sink_modes: Dict[int, str] = field(default_factory=dict)
@@ -194,6 +197,7 @@ class LocalExecutor:
             source_rows[n.id] = desc.num_rows
 
         jr = A.job_rows(info, j, source_rows)
+        jr.work_packet_size = int(perf.work_packet_size)
         tasks = A.generate_tasks(jr, perf.io_packet_size)
 
         # output tables (pre-created uncommitted, reference
@@ -234,6 +238,7 @@ class LocalExecutor:
                 sink_tables[sink.id] = (desc, desc.columns[0].name, codec,
                                         enc)
             return JobContext(job_idx=j, jr=jr, tasks=tasks,
+                          sparsity_threshold=int(perf.load_sparsity_threshold),
                               source_info=source_info,
                               sink_tables=sink_tables, fps=fps,
                               custom_sinks=custom_sinks,
@@ -242,6 +247,7 @@ class LocalExecutor:
                 and cache_mode == CacheMode.Ignore and all(
                 self.db.table_is_committed(nm) for nm in sink_names):
             return JobContext(job_idx=j, jr=jr, tasks=tasks,
+                          sparsity_threshold=int(perf.load_sparsity_threshold),
                               source_info=source_info, sink_tables={},
                               fps=fps, skipped=True)
         sink_tables: Dict[int, Tuple] = {}
@@ -265,6 +271,7 @@ class LocalExecutor:
             enc = dict(sink.extra.get("encode_options") or {})
             sink_tables[sink.id] = (desc, col.name, codec, enc)
         ctx = JobContext(job_idx=j, jr=jr, tasks=tasks,
+                          sparsity_threshold=int(perf.load_sparsity_threshold),
                          source_info=source_info, sink_tables=sink_tables,
                          fps=fps, custom_sinks=custom_sinks,
                          skipped=not sink_tables and not custom_sinks)
@@ -290,7 +297,8 @@ class LocalExecutor:
                 for job in jobs if not job.skipped
                 for t, rng in enumerate(job.tasks)]
         if work:
-            self._run_pipeline(info, work, show_progress)
+            self._run_pipeline(info, work, show_progress,
+                               queue_size=int(perf.queue_size_per_pipeline))
         for job in jobs:
             if job.skipped:
                 continue
@@ -303,7 +311,8 @@ class LocalExecutor:
         return jobs
 
     def _run_pipeline(self, info: A.GraphInfo, work: List[TaskItem],
-                      show_progress: bool) -> None:
+                      show_progress: bool,
+                      queue_size: Optional[int] = None) -> None:
         pending = list(work)
         src_lock = threading.Lock()
 
@@ -312,7 +321,7 @@ class LocalExecutor:
                 return pending.pop(0) if pending else None
 
         done = self.run_pipeline(info, source, show_progress=show_progress,
-                                 total=len(work))
+                                 total=len(work), queue_size=queue_size)
         if done != len(work):
             raise JobException(
                 f"pipeline finished {done}/{len(work)} tasks")
@@ -545,8 +554,9 @@ class LocalExecutor:
             else:
                 from ..storage.streams import decode_element
                 desc = si["table"]
-                vals = list(self.db.load_column(desc.id, si["column"],
-                                                rows=rows_l))
+                vals = list(self.db.load_column(
+                    desc.id, si["column"], rows=rows_l,
+                    sparsity_threshold=w.job.sparsity_threshold))
                 codec = si.get("codec", "raw")
                 out[node_id] = ColumnBatch.from_elements(
                     rows_arr, [decode_element(v, codec) for v in vals])

@@ -59,14 +59,22 @@ class _BulkJob:
     bulk_id: int
     spec_blob: bytes                    # graph + resolved perf + cache mode
     task_timeout: float
+    # write the table megafile every N completed tasks so a master crash
+    # mid-bulk loses at most N tasks of metadata (reference checkpoint
+    # every N jobs, master.cpp:1100-1113); 0 disables
+    checkpoint_frequency: int = 0
     queue: List[Tuple[int, int]] = field(default_factory=list)
-    # (job, task) -> (worker id, clock start, attempt id).  The attempt id
+    # (job, task) -> (worker id, clock start, attempt id, started).  The
+    # `started` flag records whether StartedWork arrived for this attempt:
+    # a timeout revocation of a task that only WAITED in a worker's queue
+    # is a scheduling artifact and must not count toward job blacklisting.
+    # The attempt id
     # makes assignments distinguishable: after a timeout revocation the
     # same worker may legitimately be re-assigned the task while its stale
     # attempt still runs, and only the *current* attempt's completion may
     # count (reference master.cpp:2111 stop_job_on_worker kills the stale
     # attempt instead; here it reports and is ignored).
-    outstanding: Dict[Tuple[int, int], Tuple[int, float, int]] = \
+    outstanding: Dict[Tuple[int, int], Tuple[int, float, int, bool]] = \
         field(default_factory=dict)
     next_attempt: int = 0
     done: Set[Tuple[int, int]] = field(default_factory=set)
@@ -176,7 +184,9 @@ class Master:
                     spec_blob=cloudpickle.dumps(
                         {"outputs": outputs, "perf": perf,
                          "cache_mode": cache_mode.value}),
-                    task_timeout=float(getattr(perf, "task_timeout", 0.0)))
+                    task_timeout=float(getattr(perf, "task_timeout", 0.0)),
+                    checkpoint_frequency=int(
+                        getattr(perf, "checkpoint_frequency", 0) or 0))
                 self._next_bulk_id += 1
                 for job in jobs:
                     if job.skipped:
@@ -224,8 +234,8 @@ class Master:
             if window:
                 # per-worker in-flight window: don't let one node's
                 # loaders hoard the queue while its siblings idle
-                held = sum(1 for (hw, _t0, _a) in bulk.outstanding.values()
-                           if hw == wid)
+                held = sum(1 for a in bulk.outstanding.values()
+                           if a[0] == wid)
                 if held >= window and bulk.queue:
                     return {"status": "wait"}
             while bulk.queue:
@@ -234,7 +244,8 @@ class Master:
                     continue
                 attempt = bulk.next_attempt
                 bulk.next_attempt += 1
-                bulk.outstanding[(j, t)] = (wid, time.time(), attempt)
+                bulk.outstanding[(j, t)] = (wid, time.time(), attempt,
+                                            False)
                 return {"status": "task", "job_idx": j, "task_idx": t,
                         "attempt": attempt}
             if bulk.outstanding:
@@ -254,7 +265,7 @@ class Master:
             cur = bulk.outstanding.get(key)
             if cur is not None and cur[0] == req.get("worker_id") \
                     and cur[2] == req.get("attempt"):
-                bulk.outstanding[key] = (cur[0], time.time(), cur[2])
+                bulk.outstanding[key] = (cur[0], time.time(), cur[2], True)
                 return {"ok": True}
         return {"ok": False, "revoked": True}
 
@@ -279,7 +290,16 @@ class Master:
                 return {"ok": True}
             bulk.done.add(key)
             self._maybe_finish_job(bulk, key[0])
+            need_ckpt = (bulk.checkpoint_frequency > 0 and not bulk.finished
+                         and len(bulk.done) % bulk.checkpoint_frequency == 0)
             self._maybe_finish_bulk(bulk)
+        if need_ckpt:
+            # periodic metadata checkpoint: a master restart mid-bulk finds
+            # committed-so-far tables in the megafile.  Written OUTSIDE the
+            # control-plane lock — the Database has its own lock, and
+            # stalling heartbeats on a storage write would let the stale
+            # scan deactivate live workers.
+            self.db.write_megafile()
         return {"ok": True}
 
     def _rpc_failed_work(self, req: dict) -> dict:
@@ -391,10 +411,15 @@ class Master:
                 if bulk is not None and not bulk.finished:
                     # per-task timeout
                     if bulk.task_timeout > 0:
-                        for key, (wid, t0, _a) in \
+                        for key, (wid, t0, _a, started) in \
                                 list(bulk.outstanding.items()):
                             if now - t0 > bulk.task_timeout:
                                 bulk.outstanding.pop(key)
+                                if not started:
+                                    # never began executing: a queue-wait
+                                    # artifact, not a task failure
+                                    bulk.queue.append(key)
+                                    continue
                                 n = bulk.failures.get(key, 0) + 1
                                 bulk.failures[key] = n
                                 if n >= MAX_TASK_FAILURES:
@@ -421,7 +446,7 @@ class Master:
         bulk = self._bulk
         if bulk is None or bulk.finished:
             return
-        for key, (owner, _t0, _a) in list(bulk.outstanding.items()):
+        for key, (owner, _t0, _a, _s) in list(bulk.outstanding.items()):
             if owner == wid:
                 bulk.outstanding.pop(key)
                 bulk.queue.append(key)

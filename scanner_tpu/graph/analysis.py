@@ -175,6 +175,10 @@ class JobRows:
     # output-domain slice-group boundaries (cumulative ends); [output_rows]
     # when no slicing
     group_ends: List[int]
+    # rows per compute batch pushed to a batch-capable kernel (the XLA
+    # batch dimension) — resolved from PerfParams.work_packet_size at job
+    # preparation (reference io/work packet split, master.cpp:1421)
+    work_packet_size: int = 16
 
 
 def _sampler_args_for(node: O.OpNode, job_idx: int):

@@ -140,6 +140,26 @@ def test_pipelined_worker_speedup(tmp_path):
         master.stop()
 
 
+def test_checkpoint_frequency_periodic_megafile(cluster, monkeypatch):
+    """checkpoint_frequency=1 makes the master write the metadata megafile
+    as tasks complete, not only at bulk end (reference master.cpp:1100-1113
+    checkpoint every N jobs)."""
+    sc, master, workers, _dbp, _addr = cluster
+    calls = []
+    orig = master.db.write_megafile
+    monkeypatch.setattr(master.db, "write_megafile",
+                        lambda: (calls.append(1), orig())[1])
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    h = sc.ops.DistHist(frame=frame)
+    out = NamedStream(sc, "ckpt_out")
+    sc.run(sc.io.Output(h, [out]),
+           PerfParams.manual(4, 8, checkpoint_frequency=1),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    n_tasks = (N_FRAMES + 7) // 8
+    # one write per completed task plus the bulk-end write
+    assert len(calls) >= n_tasks, f"megafile written {len(calls)} times"
+
+
 def test_long_task_survives_stale_scan(cluster):
     """A single task running longer than WORKER_STALE_AFTER must not be
     revoked — the background heartbeat keeps the busy worker alive."""

@@ -20,6 +20,7 @@ from scanner_tpu import (CacheMode, Client, DeviceType, FrameType, Kernel,
                          register_op)
 import scanner_tpu.kernels  # registers Histogram/Resize/Blur/OpticalFlow
 from scanner_tpu import video as scv
+from scanner_tpu.storage import MemoryStorage, items
 
 N_FRAMES = 96
 W, H = 128, 96
@@ -155,6 +156,96 @@ def test_space(sc):
             assert v[0].shape[0] == 16
         else:
             assert isinstance(v, NullElement)
+
+
+# batch-capable (huge decl, no per-op override at construction), so the
+# engine's work_packet_size chunking decides the call granularity
+@register_op(batch=1 << 30)
+class TestBatchRecorder(Kernel):
+    """Records the batch sizes it is called with (work_packet_size probe)."""
+    seen: list = []
+
+    def execute(self, frame: Sequence[FrameType]) -> Sequence[bytes]:
+        TestBatchRecorder.seen.append(len(frame))
+        return [b"x"] * len(frame)
+
+
+def test_work_packet_size_sets_compute_batch(sc):
+    """PerfParams.work_packet_size is the XLA batch dimension for
+    batch-capable kernels without an explicit per-op batch override."""
+    for wps in (4, 8):
+        TestBatchRecorder.seen = []
+        frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+        sampled = sc.streams.Range(frame, [(0, 16)])
+        t = sc.ops.TestBatchRecorder(frame=sampled)
+        out = NamedStream(sc, f"wps_out_{wps}")
+        sc.run(sc.io.Output(t, [out]),
+               PerfParams.manual(wps, 16,
+                                 pipeline_instances_per_node=1),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+        assert list(out.load()) == [b"x"] * 16
+        assert TestBatchRecorder.seen and \
+            max(TestBatchRecorder.seen) == wps, \
+            f"wps={wps}: kernel saw batches {TestBatchRecorder.seen}"
+
+
+def test_queue_size_per_pipeline_plumbed(sc, monkeypatch):
+    """queue_size_per_pipeline reaches the pipeline's stage queues."""
+    captured = {}
+    orig = type(sc._executor).run_pipeline
+
+    def spy(self, info, source, **kw):
+        captured["queue_size"] = kw.get("queue_size")
+        return orig(self, info, source, **kw)
+
+    monkeypatch.setattr(type(sc._executor), "run_pipeline", spy)
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    h = sc.ops.Histogram(frame=frame)
+    out = NamedStream(sc, "qsize_out")
+    sc.run(sc.io.Output(h, [out]),
+           PerfParams.manual(8, 16, queue_size_per_pipeline=2),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    assert captured["queue_size"] == 2
+
+
+def test_load_sparsity_threshold_controls_read_mode(tmp_db):
+    """load_sparsity_threshold picks ranged reads vs whole-item reads."""
+
+    class CountingStorage(MemoryStorage):
+        def __init__(self):
+            super().__init__()
+            self.range_reads = 0
+            self.full_reads = 0
+            self._in_range = False
+
+        def read(self, path):
+            # MemoryStorage.read_range delegates to read(); only count
+            # direct whole-blob reads
+            if not self._in_range:
+                self.full_reads += 1
+            return super().read(path)
+
+        def read_range(self, path, offset, size):
+            self.range_reads += 1
+            self._in_range = True
+            try:
+                return super().read_range(path, offset, size)
+            finally:
+                self._in_range = False
+
+    s = CountingStorage()
+    rows = [b"r%03d" % i for i in range(100)]
+    items.write_item(s, "t", rows)
+    # sparse request, high threshold -> ranged reads only
+    s.range_reads = s.full_reads = 0
+    got = items.read_item_rows(s, "t", [3, 97], sparsity_threshold=8)
+    assert got == [b"r003", b"r097"]
+    assert s.full_reads == 0 and s.range_reads > 0
+    # high threshold -> dense crossover (whole-item read)
+    s.range_reads = s.full_reads = 0
+    got = items.read_item_rows(s, "t", [3, 97], sparsity_threshold=100)
+    assert got == [b"r003", b"r097"]
+    assert s.full_reads >= 1
 
 
 def test_null_rows_through_kernel(sc):
