@@ -476,7 +476,14 @@ class Worker:
                  storage_type: str = "posix",
                  num_load_workers: int = 2, num_save_workers: int = 2,
                  pipeline_instances: int = 1,
-                 decoder_threads: int = 1):
+                 decoder_threads: int = 1,
+                 coordinator=None):
+        if coordinator is not None:
+            # join the multi-process JAX runtime BEFORE any backend touch:
+            # meshes built by kernels then span all participating hosts
+            # (reference worker-per-node topology, worker.cpp:484)
+            from ..parallel.distributed import initialize
+            initialize(coordinator)
         self.db = Database(make_storage(storage_type, db_path=db_path))
         self.master = rpc.RpcClient(master_address, MASTER_SERVICE,
                                     timeout=10.0)

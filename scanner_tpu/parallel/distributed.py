@@ -1,0 +1,116 @@
+"""Multi-host device meshes (jax.distributed).
+
+The reference scales across nodes with one worker process per node and
+NCCL/MPI underneath (scanner/engine/worker.cpp:484 topology,
+master.cpp:1558-1607 task sharding).  The TPU equivalent is JAX's
+multi-process runtime: every host runs the same program, calls
+`jax.distributed.initialize`, and sees the GLOBAL device set; meshes built
+over `jax.devices()` then span hosts, and XLA routes collectives over
+ICI/DCN automatically.  Engine workers opt in via the `coordinator=`
+config (engine/service.py Worker), making a pod slice's hosts one logical
+accelerator for in-program dp/sp/tp sharding while the task engine keeps
+distributing (job, task) work units between programs.
+
+Order matters: `initialize()` must run before the first JAX backend touch
+in the process.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence
+
+from ..common import ScannerException
+
+
+@dataclass
+class CoordinatorConfig:
+    """Multi-process JAX runtime wiring for one engine worker/host.
+
+    address: "host:port" of process 0's coordinator service.
+    num_processes: total participating processes (hosts).
+    process_id: this process's rank in [0, num_processes).
+    local_device_ids: optional explicit local device ids (rarely needed;
+        TPU runtimes discover their local chips).
+    """
+
+    address: str
+    num_processes: int
+    process_id: int
+    local_device_ids: Optional[Sequence[int]] = None
+
+
+_init_config: Optional[CoordinatorConfig] = None
+
+
+def initialize(config: CoordinatorConfig,
+               init_timeout: Optional[float] = None) -> None:
+    """Join the multi-process JAX runtime (idempotent per process for the
+    SAME config; a different config after initialization is an error, not
+    a silent no-op).
+
+    Must be called before any jax.devices()/computation in this process;
+    afterwards `jax.devices()` is the global device list and
+    `jax.local_devices()` this host's slice.  Meshes built by
+    `make_mesh()` then span all hosts.
+    """
+    global _init_config
+    if _init_config is not None:
+        if _init_config != config:
+            raise ScannerException(
+                f"jax.distributed already initialized with {_init_config}; "
+                f"cannot re-initialize with {config}")
+        return
+    import jax
+
+    kwargs = {}
+    if config.local_device_ids is not None:
+        kwargs["local_device_ids"] = list(config.local_device_ids)
+    if init_timeout is not None:
+        kwargs["initialization_timeout"] = int(init_timeout)
+    try:
+        jax.distributed.initialize(
+            coordinator_address=config.address,
+            num_processes=config.num_processes,
+            process_id=config.process_id,
+            **kwargs)
+    except RuntimeError as e:
+        raise ScannerException(
+            f"jax.distributed.initialize failed for "
+            f"process {config.process_id}/{config.num_processes} at "
+            f"{config.address}: {e}") from e
+    _init_config = config
+
+
+def is_initialized() -> bool:
+    return _init_config is not None
+
+
+def host_local_array(mesh, spec, local_data):
+    """Assemble a global jax.Array from THIS process's shard of the data.
+
+    `local_data` is the numpy block this host contributes (its slice along
+    the sharded axes); the result is a global array laid out per `spec`
+    over `mesh`.  The per-host data-feeding primitive for input pipelines
+    (each engine worker decodes only its own rows).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if not isinstance(spec, PartitionSpec):
+        spec = PartitionSpec(*spec)
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), local_data)
+
+
+def replicate_to_global(mesh, spec, full_data):
+    """Place an identical host array (present on every process) as a global
+    sharded array — convenient for params/targets in tests and small
+    inputs.  Every process must pass the same `full_data`."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if not isinstance(spec, PartitionSpec):
+        spec = PartitionSpec(*spec)
+    return jax.device_put(full_data, NamedSharding(mesh, spec))

@@ -1,0 +1,75 @@
+"""Child process for the multi-host mesh test: joins a 2-process JAX
+runtime (4 virtual CPU devices each), builds a GLOBAL 8-device mesh, and
+runs one sharded train step whose collectives cross the process boundary.
+
+Usage: python multihost_child.py <coordinator_port> <process_id> [n_procs]
+"""
+
+import sys
+
+from scanner_tpu.parallel.distributed import CoordinatorConfig, initialize
+
+
+def spawn_multihost(n_processes: int = 2, devices_per_process: int = 4,
+                    timeout: float = 600.0):
+    """Launch n child processes running this script against one fresh
+    coordinator and collect their stdout.  Kills the whole set if any
+    child fails or times out (no orphans blocked on a dead coordinator).
+    Returns the list of child stdouts."""
+    import os
+    import socket
+    import subprocess
+
+    from scanner_tpu.util.jaxenv import cpu_only_env
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child = os.path.abspath(__file__)
+    env = cpu_only_env(n_devices=devices_per_process)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, child, str(port), str(pid), str(n_processes)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for pid in range(n_processes)]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            if p.returncode != 0:
+                raise RuntimeError(f"multihost child failed:\n{out}\n{err}")
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    return outs
+
+
+def main() -> None:
+    port, pid = int(sys.argv[1]), int(sys.argv[2])
+    n_procs = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+    initialize(CoordinatorConfig(
+        address=f"localhost:{port}", num_processes=n_procs, process_id=pid),
+        init_timeout=60)
+
+    import jax
+    assert jax.process_count() == n_procs, jax.process_count()
+
+    from scanner_tpu.models import make_sharded_train_step
+    from scanner_tpu.parallel import auto_axes, make_mesh
+
+    # e.g. dp=2 x sp=2 x tp=2 over 8 devices spanning both processes
+    mesh = make_mesh(auto_axes(jax.device_count()))
+    step, params, opt_state, (clip, target) = make_sharded_train_step(
+        mesh, clip_shape=(4, 8, 32, 32, 3), width=8)
+    params, opt_state, loss = step(params, opt_state, clip, target)
+    loss = float(loss)
+    assert loss == loss and abs(loss) != float("inf"), loss
+    print(f"MULTIHOST_LOSS {loss:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
