@@ -21,7 +21,6 @@ import queue
 import struct
 import threading
 import time
-import traceback
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -35,11 +34,14 @@ from ..storage import Database
 from ..storage import items as IT
 from ..storage import metadata as md
 from ..storage.streams import NamedVideoStream, StoredStream
+from ..util.log import get_logger
 from ..util.profiler import Profiler
 from .batch import ColumnBatch, concat_batches
 from .evaluate import TaskEvaluator
 
 _SENTINEL = object()
+
+_log = get_logger("engine")
 
 
 @dataclass
@@ -296,6 +298,8 @@ class LocalExecutor:
         work = [TaskItem(job, t, rng)
                 for job in jobs if not job.skipped
                 for t, rng in enumerate(job.tasks)]
+        _log.info("job set prepared: %d jobs (%d skipped), %d tasks",
+                  len(jobs), sum(1 for j in jobs if j.skipped), len(work))
         if work:
             self._run_pipeline(info, work, show_progress,
                                queue_size=int(perf.queue_size_per_pipeline))
@@ -367,6 +371,8 @@ class LocalExecutor:
             accepts it (cluster mode reports FailedWork and moves on)."""
             if on_task_error is not None and on_task_error(w, e):
                 return
+            _log.exception("task (%d,%d) failed; aborting pipeline",
+                           w.job.job_idx, w.task_idx, exc_info=e)
             record_err(e)
 
         # loader cache: (thread, job, node) -> DecoderAutomata

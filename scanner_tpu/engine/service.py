@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import threading
 import time
-import traceback
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -30,6 +29,7 @@ import cloudpickle
 
 from ..common import CacheMode, JobException, PerfParams, ScannerException
 from ..storage import Database, make_storage
+from ..util.log import get_logger
 from ..util.profiler import Profiler
 from . import rpc
 from .evaluate import TaskEvaluator
@@ -40,6 +40,9 @@ WORKER_STALE_AFTER = 6.0     # master: no heartbeat -> worker removed
 MAX_TASK_FAILURES = 3        # reference master.cpp:2131 blacklist threshold
 MASTER_SERVICE = "scanner.Master"
 WORKER_SERVICE = "scanner.Worker"
+
+_mlog = get_logger("master")
+_wlog = get_logger("worker")
 
 
 # ---------------------------------------------------------------------------
@@ -146,6 +149,7 @@ class Master:
             self._next_worker_id += 1
             self._workers[wid] = _WorkerInfo(
                 wid, req.get("address", ""), time.time())
+        _mlog.info("worker %d registered (%s)", wid, req.get("address", ""))
         return {"worker_id": wid}
 
     def _rpc_heartbeat(self, req: dict) -> dict:
@@ -205,6 +209,9 @@ class Master:
                 if bulk.total_tasks == 0:
                     bulk.finished = True
                 self._history[bulk.bulk_id] = bulk
+                _mlog.info(
+                    "bulk %d admitted: %d jobs, %d tasks",
+                    bulk.bulk_id, len(bulk.job_tasks), bulk.total_tasks)
                 return {"bulk_id": bulk.bulk_id}
 
     def _rpc_get_job(self, req: dict) -> dict:
@@ -246,6 +253,8 @@ class Master:
                 bulk.next_attempt += 1
                 bulk.outstanding[(j, t)] = (wid, time.time(), attempt,
                                             False)
+                _mlog.debug("task (%d,%d) assigned to worker %d "
+                            "(attempt %d)", j, t, wid, attempt)
                 return {"status": "task", "job_idx": j, "task_idx": t,
                         "attempt": attempt}
             if bulk.outstanding:
@@ -289,6 +298,10 @@ class Master:
             if key in bulk.done or key[0] in bulk.blacklisted_jobs:
                 return {"ok": True}
             bulk.done.add(key)
+            _mlog.debug("task (%d,%d) finished by worker %d "
+                        "(%d/%d done)", key[0], key[1],
+                        req.get("worker_id", -1), len(bulk.done),
+                        bulk.total_tasks)
             self._maybe_finish_job(bulk, key[0])
             need_ckpt = (bulk.checkpoint_frequency > 0 and not bulk.finished
                          and len(bulk.done) % bulk.checkpoint_frequency == 0)
@@ -319,6 +332,10 @@ class Master:
                 return {"ok": True}
             n = bulk.failures.get(key, 0) + 1
             bulk.failures[key] = n
+            _mlog.warning("task (%d,%d) failed on worker %d "
+                          "(failure %d/%d): %s", key[0], key[1],
+                          req.get("worker_id", -1), n, MAX_TASK_FAILURES,
+                          err)
             if n >= MAX_TASK_FAILURES:
                 # job blacklisting (reference master.cpp:2161-2191): one
                 # poison stream cannot sink the bulk job
@@ -367,6 +384,7 @@ class Master:
     # -- internals ----------------------------------------------------------
 
     def _blacklist_job(self, bulk: _BulkJob, j: int, err: str) -> None:
+        _mlog.error("job %d blacklisted after repeated failures: %s", j, err)
         bulk.blacklisted_jobs.add(j)
         bulk.queue = [k for k in bulk.queue if k[0] != j]
         for k in [k for k in bulk.outstanding if k[0] == j]:
@@ -393,6 +411,8 @@ class Master:
                   if s[0] not in bulk.blacklisted_jobs for k in s[1]}
         if active <= bulk.done and not bulk.outstanding:
             bulk.finished = True
+            _mlog.info("bulk %d finished: %d/%d tasks done",
+                       bulk.bulk_id, len(bulk.done), bulk.total_tasks)
             self.db.write_megafile()
 
     def _scan_loop(self) -> None:
@@ -406,6 +426,10 @@ class Master:
                 for w in self._workers.values():
                     if w.active and now - w.last_seen > WORKER_STALE_AFTER:
                         w.active = False
+                        _mlog.warning(
+                            "worker %d stale (%.1fs since heartbeat): "
+                            "deactivating and requeueing its tasks",
+                            w.worker_id, now - w.last_seen)
                         self._requeue_worker_tasks(w.worker_id)
                 bulk = self._bulk
                 if bulk is not None and not bulk.finished:
@@ -415,6 +439,10 @@ class Master:
                                 list(bulk.outstanding.items()):
                             if now - t0 > bulk.task_timeout:
                                 bulk.outstanding.pop(key)
+                                _mlog.warning(
+                                    "task (%d,%d) timed out on worker %d "
+                                    "after %.1fs (started=%s): revoking",
+                                    key[0], key[1], wid, now - t0, started)
                                 if not started:
                                     # never began executing: a queue-wait
                                     # artifact, not a task failure
@@ -503,6 +531,8 @@ class Worker:
         rpc.wait_for_server(master_address, MASTER_SERVICE)
         self.worker_id = self.master.call(
             "RegisterWorker", address=f"localhost:{self.port}")["worker_id"]
+        _wlog.info("worker %d registered with master %s (port %d)",
+                   self.worker_id, master_address, self.port)
         # cached per-bulk state
         self._bulk_id: Optional[int] = None
         self._info = None
@@ -556,7 +586,8 @@ class Worker:
                 # a pipeline-level failure (e.g. evaluator construction)
                 # must not kill this thread while the heartbeat keeps the
                 # worker looking alive — back off and retry
-                traceback.print_exc()
+                _wlog.exception("worker %d: pipeline failure in bulk %d",
+                                self.worker_id, bulk_id)
                 time.sleep(PING_INTERVAL)
                 continue
             self._post_profile(bulk_id)
@@ -601,6 +632,9 @@ class Worker:
             self._evaluators = {}
         self._info, self._jobs = info, jobs
         self._bulk_id = bulk_id
+        _wlog.info("worker %d joined bulk %d: %d jobs, pipeline=%d",
+                   self.worker_id, bulk_id, len(jobs),
+                   self.executor.pipeline_instances)
 
     def _pull_next(self, bulk_id: int):
         """Ask the master for one task; returns TaskItem, 'wait', None
@@ -638,7 +672,8 @@ class Worker:
             nxt = self._pull_next(bulk_id)
             if isinstance(nxt, tuple) and nxt[0] == "task_error":
                 _tag, j, t, attempt, exc = nxt
-                traceback.print_exception(exc)
+                _wlog.error("worker %d: task (%d,%d) unresolvable",
+                            self.worker_id, j, t, exc_info=exc)
                 self.master.try_call(
                     "FailedWork", bulk_id=bulk_id,
                     worker_id=self.worker_id, job_idx=j, task_idx=t,
@@ -667,7 +702,9 @@ class Worker:
                 attempt=w.attempt)
 
         def on_task_error(w, exc) -> bool:
-            traceback.print_exception(exc)
+            _wlog.exception("worker %d: task (%d,%d) failed",
+                            self.worker_id, w.job.job_idx, w.task_idx,
+                            exc_info=exc)
             self.master.try_call(
                 "FailedWork", bulk_id=bulk_id, worker_id=self.worker_id,
                 job_idx=w.job.job_idx, task_idx=w.task_idx,

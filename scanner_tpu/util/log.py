@@ -1,0 +1,56 @@
+"""Engine logging.
+
+The reference logs through glog with VLOG levels at every engine state
+transition (scanner/util/glog.h; master.cpp/worker.cpp throughout).  Here
+the stdlib `logging` hierarchy plays that role:
+
+    scanner_tpu.master    control-plane transitions (admission, assignment,
+                          revocation, blacklisting, worker liveness)
+    scanner_tpu.worker    worker lifecycle + task outcomes
+    scanner_tpu.engine    local executor pipeline
+
+Like glog, warnings and errors are visible on stderr by DEFAULT — a
+cluster worker retrying a failing pipeline must never be silent.
+SCANNER_TPU_LOG (debug|info|warning|error) changes the level — the
+operator-facing switch for debugging a wedged 16-host job.  Records also
+propagate normally, so applications can route them through their own
+logging configuration.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_ROOT = "scanner_tpu"
+_configured = False
+
+
+def _configure_once() -> None:
+    global _configured
+    if _configured:
+        return
+    _configured = True
+    root = logging.getLogger(_ROOT)
+    level = logging.WARNING
+    level_name = os.environ.get("SCANNER_TPU_LOG", "").strip()
+    if level_name:
+        parsed = getattr(logging, level_name.upper(), None)
+        if isinstance(parsed, int):
+            level = parsed
+        else:
+            print(f"scanner_tpu: SCANNER_TPU_LOG={level_name!r} is not a "
+                  f"valid level", file=sys.stderr)
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname).1s %(name)s %(message)s",
+        datefmt="%H:%M:%S"))
+    root.addHandler(handler)
+    root.setLevel(level)
+
+
+def get_logger(name: str) -> logging.Logger:
+    """Logger under the scanner_tpu tree (e.g. get_logger('master'))."""
+    _configure_once()
+    return logging.getLogger(f"{_ROOT}.{name}")

@@ -140,6 +140,55 @@ def test_pipelined_worker_speedup(tmp_path):
         master.stop()
 
 
+def test_engine_logging_transitions(cluster, caplog):
+    """Key engine state transitions are logged through the scanner_tpu
+    logging tree (reference glog/VLOG coverage, util/glog.h): worker
+    registration, bulk admission, task assignment/completion, bulk
+    finish, and failure paths."""
+    import logging
+    sc, master, workers, _dbp, _addr = cluster
+    with caplog.at_level(logging.DEBUG, logger="scanner_tpu"):
+        frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+        h = sc.ops.DistHist(frame=frame)
+        out = NamedStream(sc, "log_out")
+        sc.run(sc.io.Output(h, [out]), PerfParams.manual(4, 8),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+    text = caplog.text
+    assert "admitted" in text            # bulk admission
+    assert "assigned to worker" in text  # task assignment
+    assert "finished by worker" in text  # task completion
+    assert "bulk" in text and "finished:" in text  # bulk completion
+    # failure path logging
+    with caplog.at_level(logging.DEBUG, logger="scanner_tpu"):
+        frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+        f = sc.ops.DistFail(frame=frame)
+        out2 = NamedStream(sc, "log_fail_out")
+        with pytest.raises(ScannerException):
+            sc.run(sc.io.Output(f, [out2]), PerfParams.manual(8, 8),
+                   cache_mode=CacheMode.Overwrite, show_progress=False)
+    assert "failed on worker" in caplog.text
+    assert "blacklisted" in caplog.text
+
+
+def test_scanner_tpu_log_env(tmp_path):
+    """SCANNER_TPU_LOG attaches a stderr handler at the given level."""
+    import subprocess
+    import sys
+
+    from scanner_tpu.util.jaxenv import cpu_only_env
+    env = cpu_only_env()
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    env["SCANNER_TPU_LOG"] = "debug"
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "from scanner_tpu.util.log import get_logger; "
+         "get_logger('master').debug('probe-message-xyz')"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "probe-message-xyz" in r.stderr
+    assert "scanner_tpu.master" in r.stderr
+
+
 def test_checkpoint_frequency_periodic_megafile(cluster, monkeypatch):
     """checkpoint_frequency=1 makes the master write the metadata megafile
     as tasks complete, not only at bulk end (reference master.cpp:1100-1113
