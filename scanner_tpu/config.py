@@ -18,6 +18,8 @@ DEFAULT_PATH = os.path.expanduser("~/.scanner_tpu.toml")
 def default_config() -> Dict[str, Any]:
     return {
         "storage": {
+            # "posix" | "gcs" | "memory"; a gs://bucket/prefix db_path
+            # selects gcs automatically (reference config.py:56)
             "type": "posix",
             "db_path": os.path.expanduser("~/.scanner_tpu/db"),
         },

@@ -83,6 +83,7 @@ class Client:
                  pipeline_instances: int = 1,
                  decoder_threads: int = 1,
                  config_path: Optional[str] = None,
+                 storage_options: Optional[Dict[str, Any]] = None,
                  **kw):
         if config_path is not None:
             from ..config import Config
@@ -95,7 +96,8 @@ class Client:
         storage_type = storage_type or "posix"
         if db_path is None and storage_type == "posix":
             db_path = os.path.expanduser("~/.scanner_tpu/db")
-        self._db = Database(make_storage(storage_type, db_path=db_path))
+        self._db = Database(make_storage(storage_type, db_path=db_path,
+                                         **(storage_options or {})))
         self._db.load_megafile()
         self._profiler = Profiler(node="client")
         self._job_profiles: Dict[int, List[Profiler]] = {}

@@ -1,4 +1,5 @@
 from .backend import StorageBackend, PosixStorage, MemoryStorage, make_storage
+from .gcs import GcsStorage, parse_gs_url
 from .custom import CustomStorage, CustomStream, FilesStorage, FilesStream
 from .database import Database
 from .metadata import (ColumnDescriptor, ColumnType, DatabaseMetadata,
@@ -6,6 +7,7 @@ from .metadata import (ColumnDescriptor, ColumnType, DatabaseMetadata,
 
 __all__ = [
     "StorageBackend", "PosixStorage", "MemoryStorage", "make_storage",
+    "GcsStorage", "parse_gs_url",
     "Database", "CustomStorage", "CustomStream", "FilesStorage",
     "FilesStream", "ColumnDescriptor", "ColumnType", "DatabaseMetadata",
     "TableDescriptor", "VideoDescriptor",

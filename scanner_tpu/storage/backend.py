@@ -191,17 +191,38 @@ class MemoryStorage(StorageBackend):
         with self._lock:
             self._blobs.pop(path, None)
 
+    @staticmethod
+    def _under(name: str, prefix: str) -> bool:
+        # path-component boundary: "tables/5" must not cover "tables/52"
+        if not prefix:
+            return True
+        return name == prefix or name.startswith(prefix + "/")
+
     def delete_prefix(self, prefix: str) -> None:
         with self._lock:
-            for k in [k for k in self._blobs if k.startswith(prefix)]:
+            for k in [k for k in self._blobs if self._under(k, prefix)]:
                 del self._blobs[k]
 
     def list_prefix(self, prefix: str) -> List[str]:
         with self._lock:
-            return sorted(k for k in self._blobs if k.startswith(prefix))
+            return sorted(k for k in self._blobs if self._under(k, prefix))
 
 
 def make_storage(storage_type: str, **kw) -> StorageBackend:
+    db_path = kw.get("db_path")
+    # a gs:// db_path selects GCS regardless of the declared type, so
+    # `Client(db_path="gs://bucket/db")` just works
+    if storage_type == "gcs" or (
+            isinstance(db_path, str) and db_path.startswith("gs://")):
+        from .gcs import GcsStorage
+        if isinstance(db_path, str) and db_path.startswith("gs://"):
+            return GcsStorage.from_url(db_path, client=kw.get("client"))
+        if "bucket" not in kw:
+            raise StorageException(
+                "gcs storage requires a gs://bucket/prefix db_path or an "
+                "explicit bucket= option")
+        return GcsStorage(kw["bucket"], kw.get("prefix", ""),
+                          client=kw.get("client"))
     if storage_type == "posix":
         return PosixStorage(kw["db_path"])
     if storage_type == "memory":

@@ -33,6 +33,11 @@ def _configure_once() -> None:
         return
     _configured = True
     root = logging.getLogger(_ROOT)
+    if root.handlers or logging.getLogger().handlers:
+        # the application already configured logging (own handler on our
+        # tree, or a root handler records propagate to) — don't add a
+        # second stderr pipe that would double-print every record
+        return
     level = logging.WARNING
     level_name = os.environ.get("SCANNER_TPU_LOG", "").strip()
     if level_name:
