@@ -1,34 +1,68 @@
-"""Pose detection app: per-frame keypoints over a sampled stream.
-(Reference: examples/apps/pose_detection/main.py.)
+"""Pose detection app: per-frame keypoints over a sampled stream, using
+the shipped trained weights.  (Reference: examples/apps/pose_detection/
+main.py, which loads external OpenPose weights; these weights come from
+scanner_tpu.models.pose_train's synthetic localization task.)
 
-Usage: python examples/pose_detection.py path/to/video.mp4 [stride]
+Usage: python examples/pose_detection.py [path/to/video.mp4] [stride]
+With no video argument a synthetic blob clip is generated and the
+reported keypoint-0 positions are checked against the true blob centers.
 """
 
+import os
 import sys
+import tempfile
+
+import numpy as np
 
 from scanner_tpu import (CacheMode, Client, NamedStream, NamedVideoStream,
                          PerfParams)
 import scanner_tpu.models  # registers PoseDetect
+from scanner_tpu.models.pose_train import WIDTH, synth_blob_video
+
+WEIGHTS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                       "scanner_tpu", "models", "weights",
+                       "pose_blobnet_w8.npz")
 
 
 def main():
-    video_path = sys.argv[1]
+    video_path = sys.argv[1] if len(sys.argv) > 1 else None
     stride = int(sys.argv[2]) if len(sys.argv) > 2 else 5
-    sc = Client(db_path="/tmp/scanner_tpu_db")
-    movie = NamedVideoStream(sc, "pose_movie", path=video_path)
+    centers = None
+    if video_path is None:
+        video_path = os.path.join(tempfile.mkdtemp(prefix="pose_ex_"),
+                                  "blob.mp4")
+        centers = synth_blob_video(video_path, num_frames=24)
+        stride = 1
 
-    frames = sc.io.Input([movie])
-    sampled = sc.streams.Stride(frames, [{"stride": stride}])
-    poses = sc.ops.PoseDetect(frame=sampled)
-    out = NamedStream(sc, "poses")
-    sc.run(sc.io.Output(poses, [out]), PerfParams.estimate(),
-           cache_mode=CacheMode.Overwrite)
+    sc = Client(db_path=os.path.join(tempfile.mkdtemp(prefix="pose_db_"),
+                                     "db"))
+    try:
+        movie = NamedVideoStream(sc, "pose_movie", path=video_path)
 
-    for i, kp in enumerate(out.load()):
-        if i < 3:
-            print(f"sampled frame {i}: {kp.shape[0]} keypoints, "
-                  f"top score {kp[:, 2].max():.3f}")
-    print(f"... {out.len()} frames processed")
+        frames = sc.io.Input([movie])
+        sampled = sc.streams.Stride(frames, [{"stride": stride}])
+        poses = sc.ops.PoseDetect(frame=sampled, width=WIDTH,
+                                  checkpoint_dir=WEIGHTS)
+        out = NamedStream(sc, "poses")
+        sc.run(sc.io.Output(poses, [out]), PerfParams.estimate(),
+               cache_mode=CacheMode.Overwrite)
+
+        errs = []
+        for i, kp in enumerate(out.load()):
+            x, y = kp[0, 0] * 4, kp[0, 1] * 4  # heatmap -> frame coords
+            line = (f"sampled frame {i}: keypoint0 at ({x:.0f}, {y:.0f}) "
+                    f"score {kp[0, 2]:.3f}")
+            if centers is not None:
+                cx, cy = centers[i * stride]
+                errs.append(float(np.hypot(x - cx, y - cy)))
+                line += f"  true ({cx:.0f}, {cy:.0f})"
+            if i < 5:
+                print(line)
+        print(f"... {out.len()} frames processed")
+        if errs:
+            print(f"mean localization error: {np.mean(errs):.2f} px")
+    finally:
+        sc.stop()
 
 
 if __name__ == "__main__":

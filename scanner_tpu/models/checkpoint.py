@@ -43,5 +43,76 @@ class TrainCheckpointer:
             opt_state=ocp.args.StandardRestore(opt_state_template)))
         return restored["params"], restored["opt_state"], step
 
+    def restore_params(self, params_template: Any,
+                       step: Optional[int] = None) -> Any:
+        """Restore params only (inference-side: kernels don't carry
+        optimizer state)."""
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoints under {self.directory}")
+        restored = self._mgr.restore(step, args=ocp.args.Composite(
+            params=ocp.args.StandardRestore(params_template)))
+        return restored["params"]
+
     def close(self) -> None:
         self._mgr.close()
+
+
+def load_params(directory: str, params_template: Any,
+                step: Optional[int] = None) -> Any:
+    """One-shot param restore for inference kernels
+    (PoseDetect(checkpoint_dir=...) and friends).  Accepts either an
+    orbax checkpoint directory or an exported .npz weight file."""
+    if directory.endswith(".npz"):
+        return import_params_npz(directory, params_template)
+    if not os.path.isdir(directory):
+        # pure read path: never create an empty orbax tree at a typo'd
+        # location
+        raise FileNotFoundError(f"no checkpoint directory: {directory}")
+    ckpt = TrainCheckpointer(directory)
+    try:
+        return ckpt.restore_params(params_template, step=step)
+    finally:
+        ckpt.close()
+
+
+def _flat_key(keypath) -> str:
+    """Keypath -> the '/'-joined name used as the on-disk .npz key (the
+    exported weight-file contract; export and import must agree)."""
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                    for p in keypath)
+
+
+def export_params_npz(params: Any, path: str) -> None:
+    """Flatten a param tree into one portable .npz (the shippable weight
+    format — orbax trees are for resumable TRAINING state)."""
+    import numpy as np
+
+    flat = {}
+    for kp, x in jax.tree_util.tree_flatten_with_path(params)[0]:
+        flat[_flat_key(kp)] = np.asarray(x)
+    np.savez_compressed(path, **flat)
+
+
+def import_params_npz(path: str, params_template: Any) -> Any:
+    """Rebuild a param tree from an exported .npz using the template's
+    structure; shapes must match the template's configuration."""
+    import numpy as np
+
+    with np.load(path) as data:
+        flat = dict(data)
+    leaves_kp, treedef = jax.tree_util.tree_flatten_with_path(
+        params_template)
+    leaves = []
+    for kp, tmpl in leaves_kp:
+        key = _flat_key(kp)
+        if key not in flat:
+            raise KeyError(f"weight file {path} missing parameter {key}")
+        arr = flat[key]
+        if tuple(arr.shape) != tuple(tmpl.shape):
+            raise ValueError(
+                f"{key}: weight shape {arr.shape} != template "
+                f"{tuple(tmpl.shape)} (width mismatch?)")
+        leaves.append(arr.astype(tmpl.dtype))
+    return treedef.unflatten(leaves)

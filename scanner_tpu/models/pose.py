@@ -145,13 +145,29 @@ def heatmaps_to_keypoints(heat: np.ndarray) -> np.ndarray:
 
 @register_op(device=DeviceType.TPU, batch=8)
 class PoseDetect(Kernel):
-    """Per-frame pose keypoints (reference pose_detection app op)."""
+    """Per-frame pose keypoints (reference pose_detection app op).
 
-    def __init__(self, config, width: int = 32, seed: int = 0):
+    With `checkpoint_dir=` the kernel restores trained weights (the
+    reference app loads external OpenPose weights, main.py:50-56; here
+    the provenance is scanner_tpu.models.pose_train).  `width` must
+    match the trained configuration."""
+
+    def __init__(self, config, width: int = 32, seed: int = 0,
+                 checkpoint_dir: Optional[str] = None):
         super().__init__(config)
-        self.model, self.params = init_params(
-            jax.random.PRNGKey(seed), clip_shape=(1, 1, 128, 128, 3),
-            width=width)
+        if checkpoint_dir:
+            # abstract template (no init compute): restore fills the real
+            # values
+            from .checkpoint import load_params
+            self.model = VideoPoseNet(width=width)
+            template = jax.eval_shape(
+                self.model.init, jax.random.PRNGKey(seed),
+                jnp.zeros((1, 1, 128, 128, 3), jnp.uint8))
+            self.params = load_params(checkpoint_dir, template)
+        else:
+            self.model, self.params = init_params(
+                jax.random.PRNGKey(seed), clip_shape=(1, 1, 128, 128, 3),
+                width=width)
         self._apply = jax.jit(self.model.apply)
 
     def execute(self, frame: Sequence[FrameType]) -> Sequence[Any]:

@@ -281,15 +281,24 @@ def frame_pattern_id(frame: np.ndarray) -> int:
     return int(round(r / 16.0)) % 14
 
 
-def synthesize_video(path: str, num_frames: int = 90, width: int = 128,
-                     height: int = 96, fps: float = 24.0,
-                     keyint: int = 12) -> None:
-    """Encode a deterministic test clip to an .mp4 with libx264."""
-    enc = lib.Encoder(width, height, fps=fps, keyint=keyint, crf=18)
-    for i in range(num_frames):
-        enc.feed(frame_pattern(i, height, width))
+def encode_frames_mp4(path: str, frames, width: int, height: int,
+                      fps: float = 24.0, keyint: int = 12,
+                      crf: int = 18) -> None:
+    """Encode an iterable of (H, W, 3) uint8 frames to an .mp4."""
+    enc = lib.Encoder(width, height, fps=fps, keyint=keyint, crf=crf)
+    for frame in frames:
+        enc.feed(frame)
     enc.flush()
     data, sizes, keys, pts, dts = enc.take_packets()
     lib.write_mp4(path, width, height, fps, "h264", enc.extradata, data,
                   sizes, keys, pts, dts)
     enc.close()
+
+
+def synthesize_video(path: str, num_frames: int = 90, width: int = 128,
+                     height: int = 96, fps: float = 24.0,
+                     keyint: int = 12) -> None:
+    """Encode a deterministic test clip to an .mp4 with libx264."""
+    encode_frames_mp4(
+        path, (frame_pattern(i, height, width) for i in range(num_frames)),
+        width, height, fps=fps, keyint=keyint)

@@ -113,3 +113,62 @@ def test_train_checkpoint_roundtrip(tmp_path):
     p2, o2, loss2 = step(p2, o2, clip, target)
     assert s == 1 and float(loss2) <= float(loss1) * 1.5
     ck.close()
+
+
+def test_params_npz_roundtrip(tmp_path):
+    import jax
+    from scanner_tpu.models import init_params
+    from scanner_tpu.models.checkpoint import (export_params_npz,
+                                               import_params_npz)
+    _, params = init_params(jax.random.PRNGKey(3),
+                            clip_shape=(1, 2, 32, 32, 3), width=8)
+    p = str(tmp_path / "w.npz")
+    export_params_npz(params, p)
+    restored = import_params_npz(p, params)
+    flat1 = jax.tree_util.tree_leaves(params)
+    flat2 = jax.tree_util.tree_leaves(restored)
+    assert all(np.array_equal(np.asarray(a), np.asarray(b))
+               for a, b in zip(flat1, flat2))
+    # width mismatch fails loudly, not silently
+    _, wrong = init_params(jax.random.PRNGKey(3),
+                           clip_shape=(1, 2, 32, 32, 3), width=16)
+    with pytest.raises((ValueError, KeyError)):
+        import_params_npz(p, wrong)
+
+
+def test_pose_shipped_weights_localize(tmp_path):
+    """E2E: PoseDetect restoring the SHIPPED weights localizes the blob in
+    an encoded clip far better than chance (reference pose app semantics —
+    real trained weights, not random init)."""
+    import os
+    from scanner_tpu import (CacheMode, Client, NamedStream,
+                             NamedVideoStream, PerfParams)
+    from scanner_tpu.models.pose_train import (SIZE, WIDTH,
+                                               synth_blob_video)
+
+    weights = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scanner_tpu", "models", "weights", "pose_blobnet_w8.npz")
+    assert os.path.exists(weights), "shipped weights missing"
+
+    vid = str(tmp_path / "blob.mp4")
+    centers = synth_blob_video(vid, num_frames=16)
+    sc = Client(db_path=str(tmp_path / "db"))
+    try:
+        movie = NamedVideoStream(sc, "blob", path=vid)
+        poses = sc.ops.PoseDetect(frame=sc.io.Input([movie]), width=WIDTH,
+                                  checkpoint_dir=weights)
+        out = NamedStream(sc, "poses_out")
+        sc.run(sc.io.Output(poses, [out]), PerfParams.estimate(),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+        errs = []
+        for i, kp in enumerate(out.load()):
+            x, y = kp[0, 0] * 4, kp[0, 1] * 4
+            errs.append(float(np.hypot(x - centers[i, 0],
+                                       y - centers[i, 1])))
+        assert len(errs) == 16
+        # chance (uniform argmax over the heatmap) averages ~SIZE/2*0.76
+        # ~= 18px here; the trained weights must be several times better
+        assert np.mean(errs) < 5.0, f"mean error {np.mean(errs):.1f}px"
+    finally:
+        sc.stop()
