@@ -1,12 +1,25 @@
-"""Benchmark: end-to-end histogram pipeline, frames/sec/chip.
+"""Benchmarks: end-to-end pipeline throughput, frames/sec/chip.
 
-BASELINE.json's metric is "frames/sec/chip (pose-detect + histogram
-pipelines)".  The reference repo publishes no numbers (BASELINE.md); the
-SIGGRAPH 2018 paper's GPU histogram throughput is on the order of 1000
-frames/sec/GPU, used here as the nominal baseline for vs_baseline.
+BASELINE.json's north-star metric is "frames/sec/chip (pose-detect +
+histogram pipelines)"; the reference repo publishes no numbers
+(BASELINE.md), so the SIGGRAPH 2018 paper's ~1000 frames/sec/GPU
+histogram throughput anchors vs_baseline.
+
+Configs (BASELINE.md table):
+  1 histogram      Histogram over the decoded stream
+  2 shot           Histogram -> HistogramDelta temporal-diff chain
+  3 pose           PoseDetect with the shipped trained weights
+  4 objdet         ObjectDetect (SSD head + fixed-shape NMS)
+  5 face           FaceEmbedding
+
+Prints ONE JSON line for the north-star metric (configs 1+3 averaged);
+per-config detail goes to stderr and BENCH_DETAIL.json.  BENCH_CONFIGS
+selects configs ("1,3" default; "all" = 1-5); BENCH_FRAMES /
+BENCH_MODEL_FRAMES size the decode workloads.
 
 Runs on whatever JAX platform the environment provides (the real TPU chip
-under the driver).  Prints ONE JSON line.
+under the driver); a wedged accelerator tunnel is probed in a subprocess
+and falls back to CPU with a stderr note.
 """
 
 import json
@@ -19,14 +32,20 @@ import time
 
 BASELINE_FPS = 1000.0
 N_FRAMES = int(os.environ.get("BENCH_FRAMES", "600"))
+# model configs run conv nets per frame; smaller default keeps CPU
+# fallback runs bounded while still amortizing compile on TPU
+N_MODEL_FRAMES = int(os.environ.get("BENCH_MODEL_FRAMES", "128"))
 W, H = 640, 480
 TPU_PROBE_TIMEOUT = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "120"))
+POSE_WEIGHTS = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "scanner_tpu", "models",
+    "weights", "pose_blobnet_w8.npz")
 
 
 def _tpu_reachable() -> bool:
     """Probe TPU init in a subprocess so a wedged tunnel cannot hang the
-    bench; on failure the run falls back to CPU (the pipeline is
-    decode-bound, so the number stays meaningful) and says so on stderr."""
+    bench; on failure the run falls back to CPU (decode-bound configs stay
+    meaningful) and says so on stderr."""
     try:
         subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],
@@ -37,6 +56,18 @@ def _tpu_reachable() -> bool:
         return False
 
 
+def _configs():
+    sel = os.environ.get("BENCH_CONFIGS", "1,3").strip().lower()
+    if sel == "all":
+        return [1, 2, 3, 4, 5]
+    picked = sorted({int(x) for x in sel.split(",") if x})
+    if not picked:
+        print(f"bench: empty BENCH_CONFIGS={sel!r}; using default 1,3",
+              file=sys.stderr)
+        return [1, 3]
+    return picked
+
+
 def main():
     if not _tpu_reachable():
         print("bench: TPU backend unreachable, falling back to CPU",
@@ -44,37 +75,97 @@ def main():
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
         jax.config.update("jax_platforms", "cpu")
+    import jax
+    platform = None
     root = tempfile.mkdtemp(prefix="scbench_")
     try:
         from scanner_tpu import (CacheMode, Client, NamedStream,
                                  NamedVideoStream, PerfParams)
-        import scanner_tpu.kernels  # registers Histogram
-
-        vid = os.path.join(root, "bench.mp4")
+        import scanner_tpu.kernels   # Histogram/HistogramDelta/...
+        import scanner_tpu.models    # PoseDetect/ObjectDetect/FaceEmbedding
         from scanner_tpu import video as scv
+
+        platform = jax.devices()[0].platform
+        vid = os.path.join(root, "bench.mp4")
         scv.synthesize_video(vid, num_frames=N_FRAMES, width=W, height=H,
                              fps=30, keyint=30)
         sc = Client(db_path=os.path.join(root, "db"),
                     num_load_workers=3, num_save_workers=1)
         sc.ingest_videos([("bench", vid)])
 
-        def run_once(name):
-            frame = sc.io.Input([NamedVideoStream(sc, "bench")])
-            hist = sc.ops.Histogram(frame=frame)
-            out = NamedStream(sc, name)
-            t0 = time.time()
-            sc.run(sc.io.Output(hist, [out]), PerfParams.manual(32, 96),
-                   cache_mode=CacheMode.Overwrite, show_progress=False)
-            return time.time() - t0
+        def pipeline(config: int, frames_col):
+            if config == 1:
+                return sc.ops.Histogram(frame=frames_col)
+            if config == 2:
+                hist = sc.ops.Histogram(frame=frames_col)
+                return sc.ops.HistogramDelta(hist=hist)
+            if config == 3:
+                if not os.path.exists(POSE_WEIGHTS):
+                    # still measurable perf-wise, but flag it loudly: a
+                    # random-weight pose number is not the trained model
+                    print(f"bench: WARNING shipped pose weights missing "
+                          f"({POSE_WEIGHTS}); using random init",
+                          file=sys.stderr)
+                return sc.ops.PoseDetect(
+                    frame=frames_col, width=8,
+                    checkpoint_dir=POSE_WEIGHTS
+                    if os.path.exists(POSE_WEIGHTS) else None)
+            if config == 4:
+                return sc.ops.ObjectDetect(frame=frames_col, width=16)
+            if config == 5:
+                return sc.ops.FaceEmbedding(frame=frames_col, width=16)
+            raise ValueError(config)
 
-        run_once("warmup")        # compile + cache warm
-        dt = run_once("bench_out")
-        fps = N_FRAMES / dt
+        def run_config(config: int) -> dict:
+            n = N_FRAMES if config in (1, 2) else min(N_FRAMES,
+                                                      N_MODEL_FRAMES)
+
+            def run_once(name: str, rows: int) -> float:
+                frames = sc.io.Input([NamedVideoStream(sc, "bench")])
+                ranged = sc.streams.Range(frames, [(0, rows)])
+                out = NamedStream(sc, name)
+                t0 = time.time()
+                sc.run(sc.io.Output(pipeline(config, ranged), [out]),
+                       PerfParams.manual(32, 96),
+                       cache_mode=CacheMode.Overwrite, show_progress=False)
+                return time.time() - t0
+
+            # Warmup pays the jit compile and (for the decode-bound
+            # configs, where a full pass is cheap) warms the page cache so
+            # runs compare warm-vs-warm across rounds.  Model configs only
+            # need the compile: one full work packet (32 rows) plus the
+            # measured run's tail-chunk shape (n % 32), so the timed run
+            # never compiles.
+            warm = n if config in (1, 2) or n <= 32 else 32 + (n % 32)
+            run_once(f"warmup_{config}", warm)
+            dt = run_once(f"bench_{config}", n)
+            d = {"config": config, "frames": n,
+                 "fps": round(n / dt, 2), "platform": platform}
+            if config == 3 and not os.path.exists(POSE_WEIGHTS):
+                d["weights"] = "random"
+            return d
+
+        detail = [run_config(c) for c in _configs()]
+        for d in detail:
+            print(f"bench: config {d['config']}: {d['fps']} fps "
+                  f"({d['frames']} frames, {d['platform']})",
+                  file=sys.stderr)
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_DETAIL.json"), "w") as f:
+            json.dump(detail, f, indent=1)
+
+        by_cfg = {d["config"]: d["fps"] for d in detail}
+        if 1 in by_cfg and 3 in by_cfg:
+            value = round((by_cfg[1] + by_cfg[3]) / 2.0, 2)
+            metric = "histogram+pose_pipeline_throughput"
+        else:
+            value = detail[0]["fps"]
+            metric = f"config{detail[0]['config']}_pipeline_throughput"
         print(json.dumps({
-            "metric": "histogram_pipeline_throughput",
-            "value": round(fps, 2),
+            "metric": metric,
+            "value": value,
             "unit": "frames/sec/chip",
-            "vs_baseline": round(fps / BASELINE_FPS, 4),
+            "vs_baseline": round(value / BASELINE_FPS, 4),
         }))
     finally:
         shutil.rmtree(root, ignore_errors=True)
