@@ -36,7 +36,8 @@ class TemporalMedian(Kernel):
 
 
 def main():
-    sc = Client(db_path="/tmp/scanner_tpu_db")
+    db_path = sys.argv[2] if len(sys.argv) > 2 else "/tmp/scanner_tpu_db"
+    sc = Client(db_path=db_path)
     movie = NamedVideoStream(sc, "t01", path=sys.argv[1])
     frames = sc.io.Input([movie])
     bright = sc.ops.Brightness(frame=frames)

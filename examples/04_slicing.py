@@ -12,7 +12,8 @@ import scanner_tpu.kernels
 
 
 def main():
-    sc = Client(db_path="/tmp/scanner_tpu_db")
+    db_path = sys.argv[2] if len(sys.argv) > 2 else "/tmp/scanner_tpu_db"
+    sc = Client(db_path=db_path)
     movie = NamedVideoStream(sc, "t04", path=sys.argv[1])
     frames = sc.io.Input([movie])
     sliced = sc.streams.Slice(frames, partitions=[sc.partitioner.all(50)])

@@ -11,7 +11,8 @@ import scanner_tpu.kernels
 
 
 def main():
-    sc = Client(db_path="/tmp/scanner_tpu_db")
+    db_path = sys.argv[2] if len(sys.argv) > 2 else "/tmp/scanner_tpu_db"
+    sc = Client(db_path=db_path)
     movie = NamedVideoStream(sc, "t06", path=sys.argv[1])
     frames = sc.io.Input([movie])
     small = sc.ops.Resize(frame=frames, width=[320], height=[240])

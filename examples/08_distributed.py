@@ -14,7 +14,7 @@ import scanner_tpu.kernels
 
 
 def main():
-    db = "/tmp/scanner_tpu_db"
+    db = sys.argv[2] if len(sys.argv) > 2 else "/tmp/scanner_tpu_db"
     master = Master(db_path=db)
     addr = f"localhost:{master.port}"
     workers = [Worker(addr, db_path=db) for _ in range(2)]

@@ -17,7 +17,8 @@ from scanner_tpu import video as scv
 
 def main():
     video_path = sys.argv[1]
-    sc = Client(db_path="/tmp/scanner_tpu_db")
+    db_path = sys.argv[2] if len(sys.argv) > 2 else "/tmp/scanner_tpu_db"
+    sc = Client(db_path=db_path)
     movie = NamedVideoStream(sc, "shots_movie", path=video_path)
 
     frames = sc.io.Input([movie])

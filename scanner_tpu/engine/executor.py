@@ -123,6 +123,14 @@ class LocalExecutor:
                                           create_tables=False))
         return info, jobs
 
+    def _bind_if_unbound(self, stream) -> None:
+        """Re-bind a stream that traveled over RPC: __getstate__ nulls its
+        client (streams.py), so `_sc is None` — distinct from a missing
+        attribute (non-stream objects) — means 'needs this executor's
+        db'."""
+        if getattr(stream, "_sc", False) is None:
+            stream.bind(self.db)
+
     def _estimate_perf(self, info: A.GraphInfo, perf: PerfParams
                        ) -> PerfParams:
         if not getattr(perf, "_estimate", False):
@@ -136,6 +144,7 @@ class LocalExecutor:
         frame_bytes = 0
         for n in info.sources:
             for s in n.extra["streams"]:
+                self._bind_if_unbound(s)
                 if getattr(s, "is_video", False) \
                         and hasattr(s, "estimate_size"):
                     # real errors (bad path, storage failure) propagate:
@@ -167,8 +176,7 @@ class LocalExecutor:
         fps = 30.0
         for n in info.sources:
             stream: StoredStream = n.extra["streams"][j]
-            if getattr(stream, "_sc", False) is None:
-                stream.bind(self.db)  # arrived via RPC unbound
+            self._bind_if_unbound(stream)
             if getattr(stream, "is_custom", False):
                 # pluggable source (reference Source::read extension point)
                 source_info[n.id] = {"custom": stream, "is_video": False}
@@ -211,8 +219,7 @@ class LocalExecutor:
         table_sinks = []
         for sink in info.sinks:
             out_stream = sink.extra["streams"][j]
-            if getattr(out_stream, "_sc", False) is None:
-                out_stream.bind(self.db)
+            self._bind_if_unbound(out_stream)
             if getattr(out_stream, "is_custom", False):
                 # CacheMode applies to custom sinks too: stale rows from a
                 # previous (longer) run must not survive an Overwrite

@@ -11,10 +11,22 @@ from scanner_tpu import video as scv
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# representative self-contained examples; the rest of the tutorial flows
-# are covered in-process by the engine/model/distributed suites (each
-# subprocess pays a full jax import + jit compile, so keep this short)
-EXAMPLES = ["00_basic.py", "04_slicing.py"]
+# every example runs (reference py_test.py test_tutorial covers the full
+# tutorial set); each subprocess pays a full jax import + jit compile, so
+# the clips are small
+EXAMPLES = [
+    "00_basic.py",
+    "01_custom_ops.py",
+    "02_op_attributes.py",
+    "03_sampling.py",
+    "04_slicing.py",
+    "05_files_source_sink.py",
+    "06_compression.py",
+    "07_profiling.py",
+    "08_distributed.py",
+    "pose_detection.py",
+    "shot_detection.py",
+]
 
 
 @pytest.fixture(scope="module")
@@ -30,10 +42,11 @@ def test_example_runs(example, clip, tmp_path):
     from scanner_tpu.util.jaxenv import cpu_only_env
     env = cpu_only_env()
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    # examples default to /tmp/scanner_tpu_db; isolate via HOME-less args
     args = [sys.executable, os.path.join(REPO, "examples", example), clip]
-    if example == "00_basic.py":
-        args.append(str(tmp_path / "db"))
+    if example == "pose_detection.py":
+        args.append("5")  # stride (it makes its own temp db)
+    else:
+        args.append(str(tmp_path / "db"))  # hermetic per-test database
     r = subprocess.run(args, env=env, capture_output=True, text=True,
                        timeout=240)
     assert r.returncode == 0, f"{example} failed:\n{r.stdout}\n{r.stderr}"
