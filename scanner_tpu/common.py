@@ -89,6 +89,10 @@ class PerfParams:
     queue_size_per_pipeline: int = 4
     task_timeout: float = 0.0  # seconds; 0 = no timeout
     checkpoint_frequency: int = 10
+    # profiling detail recorded during the job: 0 = coarse stage spans
+    # only, 1 = per-task detail (default), 2 = verbose (reference
+    # rpc.proto:270-275 profiler_level)
+    profiler_level: int = 1
 
     # reference-compat kwargs that are meaningless on TPU and accepted but
     # ignored (XLA owns device/host memory pooling; there is no CUDA pool

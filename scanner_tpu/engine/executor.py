@@ -302,6 +302,7 @@ class LocalExecutor:
             cache_mode: CacheMode = CacheMode.Error,
             show_progress: bool = False) -> List[JobContext]:
         info, jobs = self.prepare(outputs, perf, cache_mode)
+        self.profiler.level = int(getattr(perf, "profiler_level", 1))
         work = [TaskItem(job, t, rng)
                 for job in jobs if not job.skipped
                 for t, rng in enumerate(job.tasks)]
@@ -443,7 +444,7 @@ class LocalExecutor:
                     try:
                         if on_start is not None and on_start(w) is False:
                             continue  # revoked attempt: drop silently
-                        with self.profiler.span("evaluate",
+                        with self.profiler.span("evaluate", level=0,
                                                 task=w.task_idx,
                                                 job=w.job.job_idx):
                             w.results = te.execute_task(
@@ -478,7 +479,7 @@ class LocalExecutor:
                             break
                         continue
                     try:
-                        with self.profiler.span("save", task=w.task_idx,
+                        with self.profiler.span("save", level=0, task=w.task_idx,
                                                 job=w.job.job_idx):
                             self._save_task(info, w)
                         if on_done is not None:
@@ -527,7 +528,7 @@ class LocalExecutor:
         """The load stage: derive the task's row plan and read/decode its
         source elements (shared by the local pipeline and cluster
         workers)."""
-        with self.profiler.span("load", task=w.task_idx,
+        with self.profiler.span("load", level=0, task=w.task_idx,
                                 job=w.job.job_idx):
             w.plan = A.derive_task_streams(
                 info, w.job.jr, w.output_range,

@@ -615,7 +615,9 @@ class Worker:
         outputs = spec["outputs"]
         perf = spec["perf"]
         # fresh profiler per bulk so PostProfile ships only this job's spans
-        self.profiler = Profiler(node=f"worker{self.worker_id}")
+        self.profiler = Profiler(
+            node=f"worker{self.worker_id}",
+            level=int(getattr(perf, "profiler_level", 1)))
         self.executor.profiler = self.profiler
         # the job's PerfParams drive this node's pipeline shape (reference
         # worker.cpp:1467 pipeline instance spin-up from job params); an

@@ -26,11 +26,23 @@ class Interval:
 
 class Profiler:
     """Low-overhead interval/counter recorder; one instance per process,
-    safe for concurrent threads (append-only per-thread lists)."""
+    safe for concurrent threads (append-only per-thread lists).
 
-    def __init__(self, node: str = "0", base_time: Optional[float] = None):
+    `level` filters recording like the reference's profiler_level
+    (rpc.proto:270-275): spans declare a detail level (0 = coarse stage
+    spans, 1 = per-task detail, 2 = verbose) and only spans at or below
+    the active level are kept.  `max_intervals` bounds memory for
+    long-running jobs — overflow increments the `profiler_dropped`
+    counter instead of growing without limit (the reference streams to
+    per-thread binary files; here the master ships profiles over RPC, so
+    a hard cap is the honest contract)."""
+
+    def __init__(self, node: str = "0", base_time: Optional[float] = None,
+                 level: int = 1, max_intervals: int = 200_000):
         self.node = node
         self.base_time = base_time if base_time is not None else time.time()
+        self.level = level
+        self.max_intervals = max_intervals
         self._local = threading.local()
         self._all_lists: List[List[Interval]] = []
         self._counters: Dict[str, int] = defaultdict(int)
@@ -45,11 +57,22 @@ class Profiler:
                 self._all_lists.append(lst)
         return lst
 
-    def span(self, name: str, **args):
+    def _room(self) -> bool:
+        # approximate (per-thread lists are append-only; len is O(1))
+        if sum(len(lst) for lst in self._all_lists) < self.max_intervals:
+            return True
+        self.count("profiler_dropped")
+        return False
+
+    def span(self, name: str, level: int = 1, **args):
+        if level > self.level:
+            return _NULL_SPAN
         return _Span(self, name, args or None)
 
     def add_interval(self, name: str, start: float, end: float,
-                     **args) -> None:
+                     level: int = 1, **args) -> None:
+        if level > self.level or not self._room():
+            return
         self._list().append(Interval(
             name, start, end, threading.current_thread().name, args or None))
 
@@ -94,6 +117,21 @@ class Profiler:
         return p
 
 
+class _NullSpan:
+    """Span filtered out by the active profiler level."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
 class _Span:
     __slots__ = ("prof", "name", "args", "start")
 
@@ -107,9 +145,10 @@ class _Span:
         return self
 
     def __exit__(self, *exc):
-        self.prof._list().append(Interval(
-            self.name, self.start, time.time(),
-            threading.current_thread().name, self.args))
+        if self.prof._room():
+            self.prof._list().append(Interval(
+                self.name, self.start, time.time(),
+                threading.current_thread().name, self.args))
         return False
 
 

@@ -1,0 +1,55 @@
+"""Profiler levels + bounded buffering (reference profiler.h:40-86 levels,
+rpc.proto:270-275 profiler_level)."""
+
+import numpy as np
+
+from scanner_tpu.util.profiler import Profiler
+
+
+def test_level_filtering():
+    p = Profiler(level=0)
+    with p.span("coarse", level=0):
+        pass
+    with p.span("detail", level=1):
+        pass
+    p.add_interval("verbose", 0.0, 1.0, level=2)
+    names = [iv.name for iv in p.intervals()]
+    assert names == ["coarse"]
+
+
+def test_interval_cap_counts_drops():
+    p = Profiler(max_intervals=5)
+    for i in range(9):
+        with p.span(f"s{i}"):
+            pass
+    assert len(p.intervals()) == 5
+    assert p.counters["profiler_dropped"] == 4
+
+
+def test_profiler_level_knob(sc=None):
+    from scanner_tpu import (CacheMode, Client, NamedStream,
+                             NamedVideoStream, PerfParams)
+    import scanner_tpu.kernels
+    from scanner_tpu import video as scv
+    import tempfile, os
+    root = tempfile.mkdtemp(prefix="prof_")
+    vid = os.path.join(root, "v.mp4")
+    scv.synthesize_video(vid, num_frames=16, width=64, height=48, fps=24)
+    c = Client(db_path=os.path.join(root, "db"))
+    try:
+        def run(level, name):
+            frame = c.io.Input([NamedVideoStream(c, "t", path=vid)])
+            out = NamedStream(c, name)
+            jid = c.run(c.io.Output(c.ops.Histogram(frame=frame), [out]),
+                        PerfParams.manual(8, 16, profiler_level=level),
+                        cache_mode=CacheMode.Overwrite, show_progress=False)
+            return c.get_profile(jid).statistics()
+
+        st0 = run(0, "p0")
+        st1 = run(1, "p1")
+        # level 0: coarse stage spans only; level 1 adds per-op detail
+        assert "load" in st0 and "evaluate" in st0 and "save" in st0
+        assert "evaluate:Histogram" not in st0
+        assert "evaluate:Histogram" in st1
+    finally:
+        c.stop()
