@@ -129,6 +129,15 @@ class TaskEvaluator:
         Returns sink node id -> ColumnBatch of output rows."""
         store: Dict[ColKey, ColumnBatch] = {}
         results: Dict[int, ColumnBatch] = {}
+        # remaining column-reads per producer: a column is dropped from the
+        # store the moment its last consumer has run, so peak host/device
+        # memory is the live frontier, not every intermediate of the task
+        # (the reference streams work packets through stages instead,
+        # worker.cpp stage drivers; with batched columns, freeing eagerly
+        # achieves the same bound per io-packet)
+        remaining = {nid: len(lst)
+                     for nid, lst in self.info.consumers.items()}
+        self.last_peak_columns = 0
 
         for n in self.info.ops:
             ts = plan.streams[n.id]
@@ -148,6 +157,13 @@ class TaskEvaluator:
                 outs = self._run_kernel(n, jr, plan, store)
                 for col, b in outs.items():
                     store[(n.id, col)] = b
+            self.last_peak_columns = max(self.last_peak_columns, len(store))
+            for c in n.input_columns():
+                pid = c.op.id
+                remaining[pid] -= 1
+                if remaining[pid] == 0:
+                    for key in [k for k in store if k[0] == pid]:
+                        del store[key]
         return results
 
     # -- builtins (vectorized gathers on the batch) ---------------------

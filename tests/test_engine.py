@@ -248,6 +248,37 @@ def test_load_sparsity_threshold_controls_read_mode(tmp_db):
     assert s.full_reads >= 1
 
 
+def test_intermediate_columns_freed(sc):
+    """The evaluator drops a column once its last consumer ran: a 4-op
+    chain never holds more than the live frontier (bounding per-task
+    memory; reference streams work packets through stages instead)."""
+    from scanner_tpu.engine.evaluate import TaskEvaluator
+    peaks = []
+    orig = TaskEvaluator.execute_task
+
+    def spy(self, jr, plan, batches):
+        r = orig(self, jr, plan, batches)
+        peaks.append(self.last_peak_columns)
+        return r
+
+    TaskEvaluator.execute_task = spy
+    try:
+        frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+        ranged = sc.streams.Range(frame, [(0, 16)])
+        a = sc.ops.Blur(frame=ranged, kernel_size=3, sigma=0.5)
+        b = sc.ops.Blur(frame=a, kernel_size=3, sigma=0.5)
+        h = sc.ops.Histogram(frame=b)
+        out = NamedStream(sc, "freed_out")
+        sc.run(sc.io.Output(h, [out]), PerfParams.estimate(),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+        assert len(list(out.load())) == 16
+    finally:
+        TaskEvaluator.execute_task = orig
+    # graph columns: input, range, blur, blur, hist = 5 producers; the
+    # frontier never needs more than 2 live columns at once
+    assert peaks and max(peaks) <= 2, peaks
+
+
 def test_null_rows_through_kernel(sc):
     """Regression: interleaved null/live rows inside one batch chunk must
     survive kernel output assembly (null propagation through a batched
