@@ -3,8 +3,20 @@
 Capability parity: reference scannerpy/kube.py (CloudConfig, MachineType,
 ClusterConfig with price estimation, Cluster create/scale/delete managing
 master + worker deployments, kube.py:38-779) — retargeted from GPU node
-pools to TPU node pools.  Manifest generation is pure (testable offline);
-the Cluster methods shell out to gcloud/kubectl when present.
+pools to TPU node pools, with the pieces a TPU deployment actually needs:
+
+  * gcloud lifecycle COMMANDS are generated as pure argv lists
+    (`cluster_create_commands` etc.) and only executed when gcloud is
+    present — the reference shells out inline; generating first keeps
+    every path unit-testable offline and lets operators audit/copy the
+    exact commands.
+  * workers are a StatefulSet behind a headless Service: multi-host TPU
+    slices need stable pod identities so every host derives its
+    jax.distributed rank from its pod ordinal and dials pod 0 as the
+    coordinator (scanner_tpu/parallel/distributed.py).
+  * the worker env wires SCANNER_TPU_LOG, the db path (gs:// selects the
+    native GCS backend), and the coordinator address; a ConfigMap carries
+    ~/.scanner_tpu.toml.
 """
 
 from __future__ import annotations
@@ -16,22 +28,45 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from .common import ScannerException
+from .config import dump_toml
 
 # us-central1 on-demand ballpark $/hr (documented estimates, like the
-# reference's price table)
+# reference's price table); spot ~= 60% off
 TPU_PRICES = {
     "v5litepod-1": 1.2,
     "v5litepod-4": 4.8,
     "v5litepod-8": 9.6,
     "v5p-8": 16.6,
 }
+SPOT_DISCOUNT = 0.4
 CPU_PRICE_PER_CORE = 0.033
 
-# GKE node-pool accelerator labels per slice family
+# GKE node-pool accelerator labels + machine types per slice family
 TPU_ACCELERATOR_LABELS = {
     "v5litepod": "tpu-v5-lite-podslice",
     "v5p": "tpu-v5p-slice",
 }
+TPU_MACHINE_TYPES = {
+    "v5litepod": "ct5lp-hightpu-{chips}t",
+    "v5p": "ct5p-hightpu-{chips}t",
+}
+# chips per host for multi-host topology math (v5e: 4 chips/host)
+TPU_CHIPS_PER_HOST = {"v5litepod": 4, "v5p": 4}
+# physical slice topologies GKE requires for TPU node pools
+TPU_TOPOLOGIES = {
+    "v5litepod": {1: "1x1", 4: "2x2", 8: "2x4", 16: "4x4", 32: "4x8"},
+    "v5p": {8: "2x2x1", 16: "2x2x2", 32: "2x4x2"},
+}
+
+
+def tpu_topology(tpu_type: str) -> str:
+    family, chips = tpu_family(tpu_type), tpu_chips(tpu_type)
+    try:
+        return TPU_TOPOLOGIES[family][chips]
+    except KeyError:
+        raise ScannerException(
+            f"no known GKE topology for {tpu_type}; add it to "
+            f"TPU_TOPOLOGIES")
 
 
 def tpu_chips(tpu_type: str) -> int:
@@ -42,11 +77,30 @@ def tpu_chips(tpu_type: str) -> int:
         raise ScannerException(f"cannot parse TPU type: {tpu_type}")
 
 
-def tpu_accelerator_label(tpu_type: str) -> str:
+def tpu_family(tpu_type: str) -> str:
     family = tpu_type.rsplit("-", 1)[0]
     if family not in TPU_ACCELERATOR_LABELS:
         raise ScannerException(f"unknown TPU family: {family}")
-    return TPU_ACCELERATOR_LABELS[family]
+    return family
+
+
+def tpu_accelerator_label(tpu_type: str) -> str:
+    return TPU_ACCELERATOR_LABELS[tpu_family(tpu_type)]
+
+
+def tpu_chips_per_host(tpu_type: str) -> int:
+    """Chips on one host of this slice type (the pod's google.com/tpu
+    limit and the gcloud machine type must agree on this)."""
+    return min(tpu_chips(tpu_type), TPU_CHIPS_PER_HOST[tpu_family(tpu_type)])
+
+
+def tpu_hosts(tpu_type: str) -> int:
+    """Hosts in one slice (multi-host slices get one engine worker per
+    host, all joined into one jax.distributed runtime)."""
+    family = tpu_family(tpu_type)
+    per = TPU_CHIPS_PER_HOST[family]
+    chips = tpu_chips(tpu_type)
+    return max(1, chips // per)
 
 
 @dataclass
@@ -63,10 +117,16 @@ class MachineType:
     tpu_type: str = "v5litepod-4"
     cpus: int = 24
     memory_gb: int = 96
+    spot: bool = False
 
     def price_per_hour(self) -> float:
-        return TPU_PRICES.get(self.tpu_type, 0.0) \
+        price = TPU_PRICES.get(self.tpu_type, 0.0) \
             + self.cpus * CPU_PRICE_PER_CORE
+        return price * SPOT_DISCOUNT if self.spot else price
+
+    def machine_type(self) -> str:
+        return TPU_MACHINE_TYPES[tpu_family(self.tpu_type)].format(
+            chips=tpu_chips_per_host(self.tpu_type))
 
 
 @dataclass
@@ -76,12 +136,87 @@ class ClusterConfig:
     master_cpus: int = 8
     worker: MachineType = field(default_factory=MachineType)
     image: str = "scanner-tpu:latest"
-    db_path: str = "/data/db"
+    db_path: str = "/data/db"      # or gs://bucket/db for the GCS backend
     master_port: int = 5000
+    pipeline_instances: int = 1
+    log_level: str = "info"
+    autoscale: bool = False
+    max_workers: Optional[int] = None
 
     def price_per_hour(self) -> float:
         return (self.master_cpus * CPU_PRICE_PER_CORE
                 + self.num_workers * self.worker.price_per_hour())
+
+
+# ---------------------------------------------------------------------------
+# gcloud lifecycle commands (pure; execution is optional)
+# ---------------------------------------------------------------------------
+
+def cluster_create_commands(cloud: CloudConfig,
+                            cfg: ClusterConfig) -> List[List[str]]:
+    """argv lists that bring up the GKE cluster + TPU node pool
+    (reference kube.py get_or_create_cluster; gcloud only runs when the
+    operator executes these)."""
+    base = ["gcloud", "container", "--project", cloud.project]
+    cmds = [
+        base + ["clusters", "create", cfg.id,
+                "--zone", cloud.zone,
+                "--num-nodes", "1",
+                "--machine-type", f"n2-standard-{cfg.master_cpus}"],
+        base + ["node-pools", "create", f"{cfg.id}-tpu",
+                "--cluster", cfg.id,
+                "--zone", cloud.zone,
+                "--machine-type", cfg.worker.machine_type(),
+                "--tpu-topology", tpu_topology(cfg.worker.tpu_type),
+                "--num-nodes", str(cfg.num_workers
+                                   * tpu_hosts(cfg.worker.tpu_type))],
+    ]
+    if cfg.worker.spot:
+        cmds[1].append("--spot")
+    if cfg.autoscale:
+        max_slices = cfg.max_workers or cfg.num_workers * 2
+        cmds[1] += ["--enable-autoscaling", "--min-nodes", "0",
+                    "--max-nodes",
+                    str(max_slices * tpu_hosts(cfg.worker.tpu_type))]
+    return cmds
+
+
+def cluster_delete_commands(cloud: CloudConfig,
+                            cfg: ClusterConfig) -> List[List[str]]:
+    return [["gcloud", "container", "--project", cloud.project,
+             "clusters", "delete", cfg.id, "--zone", cloud.zone,
+             "--quiet"]]
+
+
+def cluster_resize_commands(cloud: CloudConfig, cfg: ClusterConfig,
+                            num_workers: int) -> List[List[str]]:
+    return [["gcloud", "container", "--project", cloud.project,
+             "clusters", "resize", cfg.id,
+             "--node-pool", f"{cfg.id}-tpu",
+             "--num-nodes", str(num_workers
+                                * tpu_hosts(cfg.worker.tpu_type)),
+             "--zone", cloud.zone, "--quiet"]]
+
+
+# ---------------------------------------------------------------------------
+# kubernetes manifests (pure)
+# ---------------------------------------------------------------------------
+
+def config_manifest(cfg: ClusterConfig) -> Dict:
+    """ConfigMap carrying ~/.scanner_tpu.toml for every pod."""
+    toml = dump_toml({
+        "storage": {"type": "gcs" if cfg.db_path.startswith("gs://")
+                    else "posix",
+                    "db_path": cfg.db_path},
+        "network": {"master": f"{cfg.id}-master",
+                    "master_port": cfg.master_port,
+                    "worker_port": 5001},
+    })
+    return {
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": f"{cfg.id}-config"},
+        "data": {"scanner_tpu.toml": toml},
+    }
 
 
 def master_manifest(cfg: ClusterConfig) -> Dict:
@@ -100,6 +235,8 @@ def master_manifest(cfg: ClusterConfig) -> Dict:
                                  "start_master; start_master("
                                  f"'{cfg.db_path}', port={cfg.master_port},"
                                  " block=True)")],
+                    "env": [{"name": "SCANNER_TPU_LOG",
+                             "value": cfg.log_level}],
                     "ports": [{"containerPort": cfg.master_port}],
                     "resources": {"requests": {"cpu": str(cfg.master_cpus)}},
                 }]},
@@ -108,12 +245,46 @@ def master_manifest(cfg: ClusterConfig) -> Dict:
     }
 
 
+def _worker_command(cfg: ClusterConfig, hosts: int) -> List[str]:
+    """Worker entry: single-host slices start a plain worker; multi-host
+    slices derive rank from the pod ordinal and join pod 0's
+    jax.distributed coordinator before serving."""
+    if hosts <= 1:
+        return ["python", "-c",
+                ("from scanner_tpu.engine.service import start_worker; "
+                 f"start_worker('{cfg.id}-master:{cfg.master_port}', "
+                 f"'{cfg.db_path}', "
+                 f"pipeline_instances={cfg.pipeline_instances}, "
+                 "block=True)")]
+    # pods ordinal o: slice index o // hosts, in-slice rank o % hosts;
+    # each slice's rank-0 pod is its jax.distributed coordinator
+    return ["python", "-c", (
+        "import os; "
+        "from scanner_tpu.engine.service import start_worker; "
+        "from scanner_tpu.parallel.distributed import CoordinatorConfig; "
+        "ordinal = int(os.environ['POD_NAME'].rsplit('-', 1)[1]); "
+        f"pid = ordinal % {hosts}; base = ordinal - pid; "
+        f"coord = CoordinatorConfig("
+        f"address=f\"{cfg.id}-worker-{{base}}.{cfg.id}-workers:8476\", "
+        f"num_processes={hosts}, process_id=pid); "
+        f"start_worker('{cfg.id}-master:{cfg.master_port}', "
+        f"'{cfg.db_path}', "
+        f"pipeline_instances={cfg.pipeline_instances}, "
+        "coordinator=coord, block=True)")]
+
+
 def worker_manifest(cfg: ClusterConfig) -> Dict:
+    """Workers are a StatefulSet behind a headless Service: multi-host
+    slices need stable per-pod identities for jax.distributed ranks."""
+    hosts = tpu_hosts(cfg.worker.tpu_type)
+    per_host_chips = tpu_chips_per_host(cfg.worker.tpu_type)
     return {
-        "apiVersion": "apps/v1", "kind": "Deployment",
+        "apiVersion": "apps/v1", "kind": "StatefulSet",
         "metadata": {"name": f"{cfg.id}-worker"},
         "spec": {
-            "replicas": cfg.num_workers,
+            "serviceName": f"{cfg.id}-workers",
+            "replicas": cfg.num_workers * hosts,
+            "podManagementPolicy": "Parallel",
             "selector": {"matchLabels": {"app": f"{cfg.id}-worker"}},
             "template": {
                 "metadata": {"labels": {"app": f"{cfg.id}-worker"}},
@@ -124,17 +295,27 @@ def worker_manifest(cfg: ClusterConfig) -> Dict:
                     },
                     "containers": [{
                         "name": "worker", "image": cfg.image,
-                        "command": ["python", "-c",
-                                    ("from scanner_tpu.engine.service import"
-                                     " start_worker; start_worker("
-                                     f"'{cfg.id}-master:{cfg.master_port}',"
-                                     f" '{cfg.db_path}', block=True)")],
+                        "command": _worker_command(cfg, hosts),
+                        "env": [
+                            {"name": "SCANNER_TPU_LOG",
+                             "value": cfg.log_level},
+                            {"name": "POD_NAME",
+                             "valueFrom": {"fieldRef": {
+                                 "fieldPath": "metadata.name"}}},
+                        ],
                         "resources": {
                             "requests": {"cpu": str(cfg.worker.cpus)},
                             "limits": {"google.com/tpu":
-                                       str(tpu_chips(cfg.worker.tpu_type))},
+                                       str(per_host_chips)},
                         },
+                        "volumeMounts": [{
+                            "name": "config",
+                            "mountPath": "/root/.scanner_tpu.toml",
+                            "subPath": "scanner_tpu.toml"}],
                     }],
+                    "volumes": [{"name": "config",
+                                 "configMap": {
+                                     "name": f"{cfg.id}-config"}}],
                 },
             },
         },
@@ -153,40 +334,93 @@ def service_manifest(cfg: ClusterConfig) -> Dict:
     }
 
 
+def workers_service_manifest(cfg: ClusterConfig) -> Dict:
+    """Headless service giving StatefulSet pods stable DNS names
+    (<pod>.<cfg.id>-workers) — the coordinator address for multi-host."""
+    return {
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": f"{cfg.id}-workers"},
+        "spec": {
+            "clusterIP": "None",
+            "selector": {"app": f"{cfg.id}-worker"},
+            "ports": [{"port": 8476, "name": "coordinator"}],
+        },
+    }
+
+
 class Cluster:
     """Lifecycle wrapper (reference kube.py Cluster): create/scale/delete
-    via gcloud/kubectl; manifests() works without either installed."""
+    via gcloud/kubectl; manifests() and *_commands() work without
+    either installed."""
 
     def __init__(self, cloud: CloudConfig, cfg: ClusterConfig):
         self.cloud = cloud
         self.cfg = cfg
 
+    # -- pure outputs ---------------------------------------------------
+
     def manifests(self) -> List[Dict]:
-        return [master_manifest(self.cfg), service_manifest(self.cfg),
+        return [config_manifest(self.cfg), master_manifest(self.cfg),
+                service_manifest(self.cfg),
+                workers_service_manifest(self.cfg),
                 worker_manifest(self.cfg)]
 
     def manifests_json(self) -> str:
         return "\n---\n".join(json.dumps(m, indent=2)
                               for m in self.manifests())
 
-    def _kubectl(self, *args, input_data: Optional[str] = None):
-        if shutil.which("kubectl") is None:
+    def create_commands(self) -> List[List[str]]:
+        return cluster_create_commands(self.cloud, self.cfg)
+
+    def delete_commands(self) -> List[List[str]]:
+        return cluster_delete_commands(self.cloud, self.cfg)
+
+    # -- execution (requires gcloud/kubectl on PATH) --------------------
+
+    def _run(self, argv: List[str],
+             input_data: Optional[str] = None):
+        if shutil.which(argv[0]) is None:
             raise ScannerException(
-                "kubectl not available; use manifests_json() and apply "
-                "manually")
-        return subprocess.run(["kubectl", *args], input=input_data,
-                              text=True, check=True, capture_output=True)
+                f"{argv[0]} not available; use manifests_json() / "
+                f"*_commands() and run manually")
+        return subprocess.run(argv, input=input_data, text=True,
+                              check=True, capture_output=True)
+
+    def create_cluster(self) -> None:
+        for cmd in self.create_commands():
+            self._run(cmd)
+
+    def delete_cluster(self) -> None:
+        for cmd in self.delete_commands():
+            self._run(cmd)
 
     def create(self) -> None:
-        self._kubectl("apply", "-f", "-", input_data=self.manifests_json())
+        self._run(["kubectl", "apply", "-f", "-"],
+                  input_data=self.manifests_json())
 
     def scale(self, num_workers: int) -> None:
+        if shutil.which("kubectl") is None:
+            raise ScannerException(
+                "kubectl not available; use manifests_json() / "
+                "*_commands() and run manually")
+        hosts = tpu_hosts(self.cfg.worker.tpu_type)
+        self._run(["kubectl", "scale",
+                   f"statefulset/{self.cfg.id}-worker",
+                   f"--replicas={num_workers * hosts}"])
         self.cfg.num_workers = num_workers
-        self._kubectl("scale", f"deployment/{self.cfg.id}-worker",
-                      f"--replicas={num_workers}")
+        resize = cluster_resize_commands(self.cloud, self.cfg, num_workers)
+        if shutil.which("gcloud") is None:
+            # autoscaling pools grow on their own; otherwise the operator
+            # resizes the pool with the printed command
+            print("deploy: gcloud not available; resize the node pool "
+                  "manually:", " ".join(resize[0]))
+            return
+        for cmd in resize:
+            self._run(cmd)
 
     def delete(self) -> None:
-        self._kubectl("delete", "-f", "-", input_data=self.manifests_json())
+        self._run(["kubectl", "delete", "-f", "-"],
+                  input_data=self.manifests_json())
 
     def master_address(self) -> str:
         return f"{self.cfg.id}-master:{self.cfg.master_port}"

@@ -145,10 +145,83 @@ def test_deploy_manifests():
     cfg = ClusterConfig(id="sc", num_workers=4,
                         worker=MachineType(tpu_type="v5litepod-4"))
     cluster = Cluster(CloudConfig(project="p"), cfg)
-    ms = cluster.manifests()
-    assert ms[0]["metadata"]["name"] == "sc-master"
-    assert ms[2]["spec"]["replicas"] == 4
-    assert "google.com/tpu" in \
-        ms[2]["spec"]["template"]["spec"]["containers"][0]["resources"]["limits"]
+    by_kind = {(m["kind"], m["metadata"]["name"]): m
+               for m in cluster.manifests()}
+    assert ("Deployment", "sc-master") in by_kind
+    assert ("ConfigMap", "sc-config") in by_kind
+    workers = by_kind[("StatefulSet", "sc-worker")]
+    assert workers["spec"]["replicas"] == 4  # single-host slice: 1 pod each
+    limits = workers["spec"]["template"]["spec"]["containers"][0][
+        "resources"]["limits"]
+    assert limits["google.com/tpu"] == "4"
     assert cfg.price_per_hour() > 0
     assert "sc-master" in cluster.manifests_json()
+    toml = by_kind[("ConfigMap", "sc-config")]["data"]["scanner_tpu.toml"]
+    assert 'type = "posix"' in toml
+
+
+def test_deploy_multihost_slice():
+    """A v5litepod-8 slice spans 2 hosts: one worker pod per host with
+    stable StatefulSet identities, rank from the pod ordinal, and the
+    jax.distributed coordinator at pod 0's headless-service DNS name."""
+    from scanner_tpu.deploy import (CloudConfig, Cluster, ClusterConfig,
+                                    MachineType, tpu_hosts)
+    assert tpu_hosts("v5litepod-8") == 2
+    cfg = ClusterConfig(id="sc", num_workers=3,
+                        worker=MachineType(tpu_type="v5litepod-8"),
+                        db_path="gs://bkt/db")
+    cluster = Cluster(CloudConfig(project="p"), cfg)
+    by_kind = {(m["kind"], m["metadata"]["name"]): m
+               for m in cluster.manifests()}
+    workers = by_kind[("StatefulSet", "sc-worker")]
+    assert workers["spec"]["replicas"] == 6       # 3 slices x 2 hosts
+    payload = workers["spec"]["template"]["spec"]["containers"][0][
+        "command"][2]
+    assert "CoordinatorConfig" in payload and "num_processes=2" in payload
+    # rank math: pod ordinal 5 -> slice 2, in-slice rank 1, coordinator
+    # at pod 4 of the headless service
+    import ast
+    ast.parse(payload)  # generated -c program must be valid python
+    rank_math = payload.split("coord = CoordinatorConfig")[0]
+    rank_math = rank_math.replace(
+        "from scanner_tpu.engine.service import start_worker; ", "")
+    rank_math = rank_math.replace(
+        "from scanner_tpu.parallel.distributed import "
+        "CoordinatorConfig; ", "")
+    ns = {"os": __import__("os")}
+    ns["os"].environ["POD_NAME"] = "sc-worker-5"
+    exec(rank_math + "addr = f\"sc-worker-{base}.sc-workers:8476\"", ns)
+    assert ns["pid"] == 1 and ns["base"] == 4
+    assert ns["addr"] == "sc-worker-4.sc-workers:8476"
+    # headless service for stable pod DNS
+    svc = by_kind[("Service", "sc-workers")]
+    assert svc["spec"]["clusterIP"] == "None"
+    # gs:// db selects the gcs backend in the ConfigMap
+    toml = by_kind[("ConfigMap", "sc-config")]["data"]["scanner_tpu.toml"]
+    assert 'type = "gcs"' in toml
+
+
+def test_deploy_gcloud_commands():
+    from scanner_tpu.deploy import (CloudConfig, Cluster, ClusterConfig,
+                                    MachineType)
+    cfg = ClusterConfig(id="sc", num_workers=2,
+                        worker=MachineType(tpu_type="v5litepod-8",
+                                           spot=True),
+                        autoscale=True)
+    cluster = Cluster(CloudConfig(project="proj", zone="us-east5-a"), cfg)
+    cmds = cluster.create_commands()
+    assert cmds[0][:3] == ["gcloud", "container", "--project"]
+    pool = cmds[1]
+    assert "node-pools" in pool and "--spot" in pool
+    assert "--enable-autoscaling" in pool
+    # 2 slices x 2 hosts = 4 nodes
+    assert pool[pool.index("--num-nodes") + 1] == "4"
+    assert "ct5lp-hightpu-4t" in pool
+    # GKE needs the physical slice topology, and autoscale caps in NODES
+    assert pool[pool.index("--tpu-topology") + 1] == "2x4"
+    assert pool[pool.index("--max-nodes") + 1] == "8"  # 4 slices x 2 hosts
+    dele = cluster.delete_commands()[0]
+    assert "delete" in dele and "sc" in dele
+    # spot pricing discounts
+    assert MachineType(tpu_type="v5litepod-8", spot=True).price_per_hour() \
+        < MachineType(tpu_type="v5litepod-8").price_per_hour()
