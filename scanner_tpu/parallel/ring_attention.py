@@ -23,15 +23,34 @@ from jax import shard_map
 NEG_INF = -1e30
 
 
+def _flash_block_k(tl: int, block_k: Optional[int]) -> int:
+    """Largest divisor of the local block length ≤ the requested tile."""
+    if block_k is not None and block_k < 1:
+        raise ValueError(f"block_k must be >= 1, got {block_k}")
+    want = min(tl, block_k or 512)
+    while tl % want:
+        want -= 1
+    return want
+
+
 def _ring_attention_block(q, k, v, axis_name: str, causal: bool,
-                          scale: Optional[float]):
+                          scale: Optional[float],
+                          block_k: Optional[int] = None):
     """Local computation: q,k,v are (B, Tl, H, D) blocks of a sequence
-    sharded over axis_name."""
+    sharded over axis_name.
+
+    Flash-style tiling inside the ring rotation: each arriving K/V block
+    is consumed in `block_k`-wide tiles, so the logits intermediate is
+    (B, H, Tl, block_k) instead of (B, Tl, Tl) per step — the long-T
+    memory bound that makes ring attention worthwhile in the first
+    place."""
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, Tl, H, D = q.shape
     s = scale if scale is not None else (D ** -0.5)
     qf = q.astype(jnp.float32) * s
+    bk = _flash_block_k(Tl, block_k)
+    n_tiles = Tl // bk
 
     # accumulators: running max m, normalizer l, weighted value sum acc.
     # pcast marks them device-varying over the ring axis so the fori_loop
@@ -43,13 +62,10 @@ def _ring_attention_block(q, k, v, axis_name: str, causal: bool,
 
     q_pos = idx * Tl + jnp.arange(Tl)
 
-    def step(i, carry):
-        m, l, acc, kb, vb = carry
-        # the block arriving at step i originated on device (idx + i) % n
-        src = (idx + i) % n
-        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32))
+    def tile_update(m, l, acc, ks, vs, k_pos):
+        """Online-softmax update for one (B, bk, H, D) K/V tile."""
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, ks.astype(jnp.float32))
         if causal:
-            k_pos = src * Tl + jnp.arange(Tl)
             mask = q_pos[:, None] >= k_pos[None, :]
             logits = jnp.where(mask[None, None], logits, NEG_INF)
         m_new = jnp.maximum(m, logits.max(axis=-1))
@@ -61,12 +77,29 @@ def _ring_attention_block(q, k, v, axis_name: str, causal: bool,
                                jnp.exp(m - m_safe))
         l_new = l * correction + p.sum(axis=-1)
         acc_new = acc * correction[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, vb.astype(jnp.float32))
-        # rotate K/V to the next device over ICI
+            "bhqk,bkhd->bhqd", p, vs.astype(jnp.float32))
+        return m_new, l_new, acc_new
+
+    def step(i, carry):
+        m, l, acc, kb, vb = carry
+        # the block arriving at step i originated on device (idx + i) % n
+        src = (idx + i) % n
+        # double-buffer: issue the rotation FIRST — the tile loop only
+        # reads the current buffers, so XLA can run the ICI transfer
+        # concurrently with this step's compute
         perm = [(j, (j - 1) % n) for j in range(n)]
-        kb = jax.lax.ppermute(kb, axis_name, perm)
-        vb = jax.lax.ppermute(vb, axis_name, perm)
-        return m_new, l_new, acc_new, kb, vb
+        kb_next = jax.lax.ppermute(kb, axis_name, perm)
+        vb_next = jax.lax.ppermute(vb, axis_name, perm)
+
+        def tile(j, inner):
+            m, l, acc = inner
+            ks = jax.lax.dynamic_slice_in_dim(kb, j * bk, bk, axis=1)
+            vs = jax.lax.dynamic_slice_in_dim(vb, j * bk, bk, axis=1)
+            k_pos = src * Tl + j * bk + jnp.arange(bk)
+            return tile_update(m, l, acc, ks, vs, k_pos)
+
+        m, l, acc = jax.lax.fori_loop(0, n_tiles, tile, (m, l, acc))
+        return m, l, acc, kb_next, vb_next
 
     m, l, acc, _, _ = jax.lax.fori_loop(0, n, step, (m0, l0, acc0, k, v))
     out = acc / jnp.maximum(l[..., None], 1e-30)
@@ -74,11 +107,14 @@ def _ring_attention_block(q, k, v, axis_name: str, causal: bool,
 
 
 def make_ring_attention(mesh: Mesh, axis: str = "sp", causal: bool = False,
-                        scale: Optional[float] = None):
+                        scale: Optional[float] = None,
+                        block_k: Optional[int] = None):
     """Returns attn(q, k, v) over arrays (B, T, H, D) with T sharded on
-    `axis` (batch replicated or dp-sharded orthogonally)."""
+    `axis` (batch replicated or dp-sharded orthogonally).  `block_k`
+    bounds the flash tile width (default 512, clipped to the local
+    block)."""
     fn = functools.partial(_ring_attention_block, axis_name=axis,
-                           causal=causal, scale=scale)
+                           causal=causal, scale=scale, block_k=block_k)
     return shard_map(fn, mesh=mesh,
                      in_specs=(P(None, axis), P(None, axis), P(None, axis)),
                      out_specs=P(None, axis))
