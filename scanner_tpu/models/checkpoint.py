@@ -84,6 +84,17 @@ def _flat_key(keypath) -> str:
                     for p in keypath)
 
 
+def init_or_restore(model, rng, dummy_input, checkpoint_dir: Optional[str]):
+    """The inference-kernel weight path: with a checkpoint, build the
+    restore template abstractly (jax.eval_shape — no init compute) and
+    device_put the restored tree so execute() never re-uploads weights;
+    without one, plain random init."""
+    if checkpoint_dir:
+        template = jax.eval_shape(model.init, rng, dummy_input)
+        return jax.device_put(load_params(checkpoint_dir, template))
+    return model.init(rng, dummy_input)
+
+
 def export_params_npz(params: Any, path: str) -> None:
     """Flatten a param tree into one portable .npz (the shippable weight
     format — orbax trees are for resumable TRAINING state)."""

@@ -9,7 +9,7 @@ runs as a fixed-iteration lax loop (no data-dependent shapes on device).
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, List, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax
@@ -118,11 +118,14 @@ class ObjectDetect(Kernel):
     in unit coordinates (reference TF SSD app equivalent)."""
 
     def __init__(self, config, width: int = 32, num_classes: int = 2,
-                 score_thresh: float = 0.05, seed: int = 0):
+                 score_thresh: float = 0.05, seed: int = 0,
+                 checkpoint_dir: Optional[str] = None):
         super().__init__(config)
         self.model = SSDDetector(num_classes=num_classes, width=width)
-        self.params = self.model.init(
-            jax.random.PRNGKey(seed), jnp.zeros((1, 128, 128, 3), jnp.uint8))
+        from .checkpoint import init_or_restore
+        self.params = init_or_restore(
+            self.model, jax.random.PRNGKey(seed),
+            jnp.zeros((1, 128, 128, 3), jnp.uint8), checkpoint_dir)
         self.score_thresh = float(score_thresh)
         self._anchors = {}  # (fh, fw) -> anchor tensor, per resolution
 

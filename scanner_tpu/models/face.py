@@ -9,7 +9,7 @@ head with L2-normalized output.
 
 from __future__ import annotations
 
-from typing import Any, Sequence
+from typing import Any, Optional, Sequence
 
 import flax.linen as nn
 import jax
@@ -28,9 +28,10 @@ class FaceDetect(ObjectDetect):
     app)."""
 
     def __init__(self, config, width: int = 32, score_thresh: float = 0.1,
-                 seed: int = 1):
+                 seed: int = 1, checkpoint_dir: Optional[str] = None):
         super().__init__(config, width=width, num_classes=2,
-                         score_thresh=score_thresh, seed=seed)
+                         score_thresh=score_thresh, seed=seed,
+                         checkpoint_dir=checkpoint_dir)
 
 
 class EmbeddingNet(nn.Module):
@@ -52,11 +53,13 @@ class FaceEmbedding(Kernel):
     pipeline, BASELINE config 5)."""
 
     def __init__(self, config, dim: int = 128, width: int = 32,
-                 seed: int = 2):
+                 seed: int = 2, checkpoint_dir: Optional[str] = None):
         super().__init__(config)
         self.model = EmbeddingNet(dim=dim, width=width)
-        self.params = self.model.init(
-            jax.random.PRNGKey(seed), jnp.zeros((1, 128, 128, 3), jnp.uint8))
+        from .checkpoint import init_or_restore
+        self.params = init_or_restore(
+            self.model, jax.random.PRNGKey(seed),
+            jnp.zeros((1, 128, 128, 3), jnp.uint8), checkpoint_dir)
         self._apply = jax.jit(self.model.apply)
 
     def execute(self, frame: Sequence[FrameType]) -> Sequence[Any]:

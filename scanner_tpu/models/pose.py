@@ -155,19 +155,11 @@ class PoseDetect(Kernel):
     def __init__(self, config, width: int = 32, seed: int = 0,
                  checkpoint_dir: Optional[str] = None):
         super().__init__(config)
-        if checkpoint_dir:
-            # abstract template (no init compute): restore fills the real
-            # values
-            from .checkpoint import load_params
-            self.model = VideoPoseNet(width=width)
-            template = jax.eval_shape(
-                self.model.init, jax.random.PRNGKey(seed),
-                jnp.zeros((1, 1, 128, 128, 3), jnp.uint8))
-            self.params = load_params(checkpoint_dir, template)
-        else:
-            self.model, self.params = init_params(
-                jax.random.PRNGKey(seed), clip_shape=(1, 1, 128, 128, 3),
-                width=width)
+        from .checkpoint import init_or_restore
+        self.model = VideoPoseNet(width=width)
+        self.params = init_or_restore(
+            self.model, jax.random.PRNGKey(seed),
+            jnp.zeros((1, 1, 128, 128, 3), jnp.uint8), checkpoint_dir)
         self._apply = jax.jit(self.model.apply)
 
     def execute(self, frame: Sequence[FrameType]) -> Sequence[Any]:

@@ -172,3 +172,31 @@ def test_pose_shipped_weights_localize(tmp_path):
         assert np.mean(errs) < 5.0, f"mean error {np.mean(errs):.1f}px"
     finally:
         sc.stop()
+
+
+def test_model_ops_checkpoint_restore(tmp_path):
+    """Every model op restores exported weights (uniform weight path)."""
+    import jax
+    import jax.numpy as jnp
+    from scanner_tpu.graph.ops import KernelConfig, registry
+    from scanner_tpu.common import DeviceType
+    from scanner_tpu.models.checkpoint import export_params_npz
+
+    cfg = KernelConfig(device=DeviceType.TPU)
+    for op_name, kw in [("ObjectDetect", dict(width=8)),
+                        ("FaceDetect", dict(width=8)),
+                        ("FaceEmbedding", dict(width=8, dim=16))]:
+        spec = registry.get(op_name)
+        k1 = spec.kernel_factory(cfg, **kw)
+        p = str(tmp_path / f"{op_name}.npz")
+        export_params_npz(k1.params, p)
+        k2 = spec.kernel_factory(cfg, checkpoint_dir=p, **kw)
+        leaves1 = jax.tree_util.tree_leaves(k1.params)
+        leaves2 = jax.tree_util.tree_leaves(k2.params)
+        assert all(np.array_equal(np.asarray(a), np.asarray(b))
+                   for a, b in zip(leaves1, leaves2)), op_name
+        # restored kernel runs
+        frames = np.random.RandomState(0).randint(
+            0, 255, (2, 64, 64, 3), np.uint8)
+        out = k2.execute(frames)
+        assert len(out) == 2
