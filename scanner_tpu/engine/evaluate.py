@@ -108,10 +108,20 @@ class TaskEvaluator:
                  skip_fetch_resources: bool = False):
         self.info = info
         self.profiler = profiler
+        if devices is None and _accel_backend():
+            # hand every kernel this host's chips: model kernels dp-shard
+            # their batches across them (models/infer.py), the TPU
+            # equivalent of the reference pinning one GPU per instance
+            import jax
+            devices = list(jax.local_devices())
         self.kernels: Dict[int, KernelInstance] = {}
         for n in info.ops:
             if not n.is_builtin:
-                ki = KernelInstance(n, profiler, devices)
+                # only device-placed kernels get the chip list: a kernel
+                # explicitly pinned to CPU must not dp-shard onto TPU
+                n_devs = devices \
+                    if n.effective_device() == DeviceType.TPU else None
+                ki = KernelInstance(n, profiler, n_devs)
                 self.kernels[n.id] = ki
         for ki in self.kernels.values():
             ki.setup(fetch=not skip_fetch_resources)

@@ -57,12 +57,15 @@ class FaceEmbedding(Kernel):
         super().__init__(config)
         self.model = EmbeddingNet(dim=dim, width=width)
         from .checkpoint import init_or_restore
-        self.params = init_or_restore(
+        from .infer import DataParallelApply
+        params = init_or_restore(
             self.model, jax.random.PRNGKey(seed),
             jnp.zeros((1, 128, 128, 3), jnp.uint8), checkpoint_dir)
-        self._apply = jax.jit(self.model.apply)
+        # dp-shard batches over every chip the engine handed this kernel
+        self._dp = DataParallelApply(jax.jit(self.model.apply), params,
+                                     config.devices)
+        self.params = self._dp.params
 
     def execute(self, frame: Sequence[FrameType]) -> Sequence[Any]:
-        images = jnp.asarray(frame)
-        emb = np.asarray(self._apply(self.params, images))
+        emb = np.asarray(self._dp(jnp.asarray(frame)))
         return list(emb)

@@ -156,13 +156,17 @@ class PoseDetect(Kernel):
                  checkpoint_dir: Optional[str] = None):
         super().__init__(config)
         from .checkpoint import init_or_restore
+        from .infer import DataParallelApply
         self.model = VideoPoseNet(width=width)
-        self.params = init_or_restore(
+        params = init_or_restore(
             self.model, jax.random.PRNGKey(seed),
             jnp.zeros((1, 1, 128, 128, 3), jnp.uint8), checkpoint_dir)
-        self._apply = jax.jit(self.model.apply)
+        # dp-shard batches over every chip the engine handed this kernel
+        self._dp = DataParallelApply(jax.jit(self.model.apply), params,
+                                     config.devices)
+        self.params = self._dp.params
 
     def execute(self, frame: Sequence[FrameType]) -> Sequence[Any]:
         clip = jnp.asarray(frame)[:, None]  # (B, 1, H, W, 3)
-        heat = np.asarray(self._apply(self.params, clip))[:, 0]
+        heat = np.asarray(self._dp(clip))[:, 0]
         return [heatmaps_to_keypoints(h) for h in heat]
