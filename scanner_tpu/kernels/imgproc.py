@@ -113,6 +113,53 @@ class Resize(Kernel):
         return _resize_impl(jnp.asarray(frame), self.height, self.width)
 
 
+@functools.partial(jax.jit, static_argnames=("oh", "ow"))
+def _crop_resize_impl(frames: jnp.ndarray, boxes: jnp.ndarray, oh: int,
+                      ow: int):
+    """Crop unit-coordinate boxes [y1,x1,y2,x2] out of (b,H,W,C) frames
+    and resample each to (oh, ow).  scale_and_translate keeps the output
+    shape static whatever the box is — no dynamic shapes on device."""
+    H, W = frames.shape[1], frames.shape[2]
+
+    def one(frame, box):
+        y1, x1, y2, x2 = box[0] * H, box[1] * W, box[2] * H, box[3] * W
+        h = jnp.maximum(y2 - y1, 1.0)
+        w = jnp.maximum(x2 - x1, 1.0)
+        scale = jnp.asarray([oh / h, ow / w], jnp.float32)
+        # output pixel o maps to input o/scale + translate/..; translate
+        # is in OUTPUT units: shift so input y1 lands on output 0
+        translate = jnp.asarray([-y1 * oh / h, -x1 * ow / w], jnp.float32)
+        out = jax.image.scale_and_translate(
+            frame.astype(jnp.float32), (oh, ow, frame.shape[-1]),
+            (0, 1), scale, translate, method="linear")
+        return jnp.clip(jnp.round(out), 0, 255).astype(jnp.uint8)
+
+    return jax.vmap(one)(frames, boxes)
+
+
+@register_op(device=DeviceType.TPU, batch=16)
+class CropResize(Kernel):
+    """Crop a per-row box (unit coords [y1, x1, y2, x2]) out of each frame
+    and resize to (height, width) — the region-extraction step of the
+    reference's re-id/feature apps (open-reid extract_features.py resamples
+    person crops to 256x128), with static output shapes so the whole op
+    stays on device.  `size` sets a square output; height/width override
+    per axis."""
+
+    def __init__(self, config, size: int = 64, height: int = 0,
+                 width: int = 0):
+        super().__init__(config)
+        self.height = int(height) or int(size)
+        self.width = int(width) or int(size)
+
+    def execute(self, frame: Sequence[FrameType],
+                box: Sequence[Any]) -> Sequence[FrameType]:
+        boxes = jnp.asarray(np.stack([np.asarray(b, np.float32)
+                                      for b in box]))
+        return _crop_resize_impl(jnp.asarray(frame), boxes, self.height,
+                                 self.width)
+
+
 def _gaussian_kernel1d(ksize: int, sigma: float) -> np.ndarray:
     r = (ksize - 1) / 2.0
     x = np.arange(ksize, dtype=np.float32) - r

@@ -65,13 +65,15 @@ class SSDDetector(nn.Module):
 
 
 def decode_boxes(anchors: jnp.ndarray, deltas: jnp.ndarray) -> jnp.ndarray:
-    """Standard SSD box decode -> [y1, x1, y2, x2] unit coords."""
+    """Standard SSD box decode -> [y1, x1, y2, x2] unit coords, clipped to
+    the image (downstream crops must never sample fully out of frame)."""
     cy = anchors[:, 0] + deltas[..., 0] * anchors[:, 2]
     cx = anchors[:, 1] + deltas[..., 1] * anchors[:, 3]
     h = anchors[:, 2] * jnp.exp(jnp.clip(deltas[..., 2], -4, 4))
     w = anchors[:, 3] * jnp.exp(jnp.clip(deltas[..., 3], -4, 4))
-    return jnp.stack([cy - h / 2, cx - w / 2, cy + h / 2, cx + w / 2],
-                     axis=-1)
+    boxes = jnp.stack([cy - h / 2, cx - w / 2, cy + h / 2, cx + w / 2],
+                      axis=-1)
+    return jnp.clip(boxes, 0.0, 1.0)
 
 
 @functools.partial(jax.jit, static_argnames=("top_k",))

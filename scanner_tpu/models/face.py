@@ -44,7 +44,10 @@ class EmbeddingNet(nn.Module):
         feat = Backbone(width=self.width, dtype=self.dtype)(images)
         pooled = feat.mean(axis=(1, 2))
         emb = nn.Dense(self.dim, dtype=jnp.float32)(pooled)
-        return emb / jnp.linalg.norm(emb, axis=-1, keepdims=True)
+        # zero inputs (e.g. a crop that fell outside the frame) must yield
+        # a zero vector, not 0/0 = NaN
+        norm = jnp.linalg.norm(emb, axis=-1, keepdims=True)
+        return emb / jnp.maximum(norm, 1e-12)
 
 
 @register_op(device=DeviceType.TPU, batch=16)

@@ -712,3 +712,32 @@ def test_auto_ingest(sc, tmp_path):
     sc.run(sc.io.Output(hist, [out]), PerfParams.estimate(),
            cache_mode=CacheMode.Overwrite, show_progress=False)
     assert out.len() == 24
+
+
+def test_crop_resize_two_input_op(sc):
+    """CropResize consumes a frame column AND a per-row box column
+    (multi-input op through the batched data path); crops land where the
+    boxes say."""
+    from typing import Any
+
+    @register_op(name="TestQuadBox")
+    def TestQuadBox(config, ignore: FrameType) -> Any:
+        return np.asarray([0.0, 0.0, 0.5, 0.5], np.float32)  # TL quadrant
+
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    ranged = sc.streams.Range(frame, [(0, 6)])
+    box = sc.ops.TestQuadBox(ignore=ranged)
+    crops = sc.ops.CropResize(frame=ranged, box=box, size=32)
+    out = NamedStream(sc, "crop_out")
+    sc.run(sc.io.Output(crops, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    rows = list(out.load())
+    assert len(rows) == 6 and rows[0].shape == (32, 32, 3)
+    # the crop equals a resize of the frame's top-left quadrant
+    src = next(iter(NamedVideoStream(sc, "test1").load(rows=[0])))
+    tl = src[:src.shape[0] // 2, :src.shape[1] // 2]
+    import jax.numpy as jnp
+    from scanner_tpu.kernels.imgproc import _resize_impl
+    expect = np.asarray(_resize_impl(jnp.asarray(tl[None]), 32, 32))[0]
+    err = np.abs(rows[0].astype(int) - expect.astype(int)).mean()
+    assert err < 3.0, f"crop mismatch, mean abs err {err}"

@@ -25,6 +25,7 @@ EXAMPLES = [
     "07_profiling.py",
     "08_distributed.py",
     "pose_detection.py",
+    "reid_features.py",
     "shot_detection.py",
 ]
 
