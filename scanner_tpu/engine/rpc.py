@@ -19,6 +19,7 @@ import grpc
 
 from ..common import ScannerException
 from ..storage.metadata import pack, unpack
+from ..util.retry import call_with_backoff
 
 GRPC_OPTIONS = [
     ("grpc.max_send_message_length", 1 << 30),
@@ -78,23 +79,45 @@ class RpcServer:
 
 
 class RpcClient:
-    """Stub for a remote service; call(method, **payload) -> dict."""
+    """Stub for a remote service; call(method, **payload) -> dict.
+
+    Transient transport failures (UNAVAILABLE — connection refused/reset,
+    the server not yet listening) are retried with full-jitter exponential
+    backoff, the analog of the reference's GRPC_BACKOFF wrapper
+    (scanner/util/grpc.h, worker.cpp:886).  Only UNAVAILABLE is retried by
+    default: the request provably never reached the server, so retrying
+    cannot double-execute a non-idempotent method like NextWork.
+    """
 
     def __init__(self, address: str, service_name: str,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, retries: int = 3,
+                 backoff_base: float = 0.05, backoff_cap: float = 2.0):
         self.address = address
         self._service = service_name
         self._timeout = timeout
+        self._retries = retries
+        self._backoff_base = backoff_base
+        self._backoff_cap = backoff_cap
         self._channel = grpc.insecure_channel(address, options=GRPC_OPTIONS)
 
+    @staticmethod
+    def _transient(e: Exception) -> bool:
+        return isinstance(e, grpc.RpcError) \
+            and e.code() == grpc.StatusCode.UNAVAILABLE
+
     def call(self, method: str, timeout: Optional[float] = None,
-             **payload) -> dict:
+             retries: Optional[int] = None, **payload) -> dict:
         fn = self._channel.unary_unary(
             f"/{self._service}/{method}",
             request_serializer=lambda x: x,
             response_deserializer=lambda x: x)
+        req = pack(payload)
         try:
-            raw = fn(pack(payload), timeout=timeout or self._timeout)
+            raw = call_with_backoff(
+                lambda: fn(req, timeout=timeout or self._timeout),
+                is_transient=self._transient,
+                retries=self._retries if retries is None else retries,
+                base=self._backoff_base, cap=self._backoff_cap)
         except grpc.RpcError as e:
             raise RpcError(
                 f"{self._service}.{method} @ {self.address}: "
@@ -102,10 +125,11 @@ class RpcClient:
         return unpack(raw)
 
     def try_call(self, method: str, timeout: Optional[float] = None,
-                 **payload) -> Optional[dict]:
+                 retries: Optional[int] = None, **payload) -> Optional[dict]:
         """call() that returns None on transport errors (for pings)."""
         try:
-            return self.call(method, timeout=timeout, **payload)
+            return self.call(method, timeout=timeout, retries=retries,
+                             **payload)
         except RpcError:
             return None
         except ValueError as e:
@@ -127,7 +151,8 @@ def wait_for_server(address: str, service: str, method: str = "Ping",
     deadline = time.time() + timeout
     try:
         while time.time() < deadline:
-            if c.try_call(method) is not None:
+            # no per-call retries: this loop IS the retry policy
+            if c.try_call(method, retries=0) is not None:
                 return
             time.sleep(0.1)
         raise RpcError(f"{service} at {address} not reachable "

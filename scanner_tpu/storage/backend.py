@@ -36,10 +36,16 @@ class StorageBackend:
         """Create `path` with `data` only if it does not exist.
 
         Returns True when this call created the blob, False when it already
-        existed (data untouched).  Atomic across processes — used for
-        cross-worker arbitration markers (first writer wins).
+        existed (data untouched).  Used for cross-worker arbitration
+        markers (first writer wins); backends should override with a
+        truly atomic variant.  This default is a best-effort
+        exists/write sequence — racy across processes, but it keeps
+        pre-existing third-party backends working at save time.
         """
-        raise NotImplementedError
+        if self.exists(path):
+            return False
+        self.write(path, data)
+        return True
 
     def exists(self, path: str) -> bool:
         raise NotImplementedError
@@ -112,6 +118,21 @@ class PosixStorage(StorageBackend):
             return True
         except FileExistsError:
             return False
+        except OSError:
+            # hard links are unsupported on gcsfuse and some NFS mounts
+            # (EPERM/ENOTSUP/EOPNOTSUPP); fall back to O_CREAT|O_EXCL,
+            # still atomic on POSIX though the loser may observe a
+            # partially-written marker on non-POSIX overlays
+            try:
+                fd = os.open(p, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                return False
+            try:
+                os.write(fd, data)
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+            return True
         finally:
             os.unlink(tmp)
 

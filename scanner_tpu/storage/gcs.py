@@ -20,11 +20,25 @@ from __future__ import annotations
 from typing import List, Optional
 
 from ..common import StorageException
+from ..util.retry import call_with_backoff
 from .backend import StorageBackend
 
 # resumable-upload chunk size; also the threshold above which the client
 # library switches from one-shot to resumable uploads
 _CHUNK_SIZE = 16 * 1024 * 1024
+
+# transient service errors worth retrying (rate limit + server-side);
+# matched structurally so fakes don't need the google exception classes
+_TRANSIENT_CODES = {429, 500, 502, 503, 504}
+_TRANSIENT_NAMES = {"TooManyRequests", "InternalServerError", "BadGateway",
+                    "ServiceUnavailable", "GatewayTimeout",
+                    "DeadlineExceeded", "RetryError"}
+
+
+def _transient(e: Exception) -> bool:
+    return getattr(e, "code", None) in _TRANSIENT_CODES \
+        or type(e).__name__ in _TRANSIENT_NAMES \
+        or isinstance(e, ConnectionError)
 
 
 def parse_gs_url(url: str):
@@ -42,7 +56,11 @@ class GcsStorage(StorageBackend):
     """Blobs are GCS objects under gs://bucket/prefix/."""
 
     def __init__(self, bucket: str, prefix: str = "",
-                 client=None):
+                 client=None, retries: int = 5,
+                 backoff_base: float = 0.1, backoff_cap: float = 5.0):
+        self._retries = retries
+        self._backoff_base = backoff_base
+        self._backoff_cap = backoff_cap
         if client is None:
             try:
                 from google.cloud import storage as gcs
@@ -84,42 +102,70 @@ class GcsStorage(StorageBackend):
         return getattr(e, "code", None) == 412 \
             or type(e).__name__ == "PreconditionFailed"
 
+    def _with_retry(self, fn):
+        """Run fn() retrying transient 429/5xx/connection errors with
+        full-jitter exponential backoff (storehouse retry parity)."""
+        return call_with_backoff(
+            fn, is_transient=_transient, retries=self._retries,
+            base=self._backoff_base, cap=self._backoff_cap)
+
     # -- reads ----------------------------------------------------------
 
     def read(self, path: str) -> bytes:
         try:
-            return self._blob(path).download_as_bytes()
+            return self._with_retry(
+                lambda: self._blob(path).download_as_bytes())
         except Exception as e:  # noqa: BLE001
             if self._not_found(e):
                 raise StorageException(f"not found: {path}") from e
             raise
 
     def read_range(self, path: str, offset: int, size: int) -> bytes:
+        def fetch(start: int, want: int) -> bytes:
+            try:
+                # GCS range end is INCLUSIVE
+                return self._with_retry(
+                    lambda: self._blob(path).download_as_bytes(
+                        start=start, end=start + want - 1))
+            except Exception as e:  # noqa: BLE001
+                if self._not_found(e):
+                    raise StorageException(f"not found: {path}") from e
+                # requesting past EOF returns 416; mirror POSIX short read
+                if getattr(e, "code", None) == 416:
+                    return b""
+                raise
+
         if size <= 0:
             return b""
-        try:
-            # GCS range end is INCLUSIVE
-            return self._blob(path).download_as_bytes(
-                start=offset, end=offset + size - 1)
-        except Exception as e:  # noqa: BLE001
-            if self._not_found(e):
-                raise StorageException(f"not found: {path}") from e
-            # requesting past EOF returns 416; mirror POSIX short read
-            if getattr(e, "code", None) == 416:
-                return b""
-            raise
+        # a truncated transfer surfaces as a short byte string; re-issue
+        # the remaining range until EOF (empty/unchanged) or complete
+        out = fetch(offset, size)
+        while 0 < len(out) < size:
+            more = fetch(offset + len(out), size - len(out))
+            if not more:
+                break  # genuine EOF — short read mirrors POSIX
+            out += more
+        return out
 
     # -- writes ---------------------------------------------------------
 
     def write(self, path: str, data: bytes) -> None:
         # resumable chunked upload above _CHUNK_SIZE; object visibility
-        # is atomic either way
-        self._blob(path, chunked=len(data) > _CHUNK_SIZE) \
+        # is atomic either way.  Retry-safe: re-uploading the same bytes
+        # is idempotent.
+        self._with_retry(
+            lambda: self._blob(path, chunked=len(data) > _CHUNK_SIZE)
             .upload_from_string(bytes(data),
-                                content_type="application/octet-stream")
+                                content_type="application/octet-stream"))
 
     def write_exclusive(self, path: str, data: bytes) -> bool:
         try:
+            # NOT retried wholesale: a retry after an ambiguous transient
+            # failure could observe its OWN first attempt's object and
+            # misreport "lost the race".  if_generation_match=0 makes the
+            # server reject duplicates, so only connection-refused (never
+            # sent) errors are safe to retry — covered by _transient on
+            # the underlying channel inside one upload call.
             self._blob(path).upload_from_string(
                 bytes(data), content_type="application/octet-stream",
                 if_generation_match=0)
@@ -132,17 +178,18 @@ class GcsStorage(StorageBackend):
     # -- metadata/management --------------------------------------------
 
     def exists(self, path: str) -> bool:
-        return bool(self._blob(path).exists())
+        return bool(self._with_retry(lambda: self._blob(path).exists()))
 
     def size(self, path: str) -> int:
-        blob = self._bucket.get_blob(self._key(path))
+        blob = self._with_retry(
+            lambda: self._bucket.get_blob(self._key(path)))
         if blob is None:
             raise StorageException(f"not found: {path}")
         return int(blob.size)
 
     def delete(self, path: str) -> None:
         try:
-            self._blob(path).delete()
+            self._with_retry(lambda: self._blob(path).delete())
         except Exception as e:  # noqa: BLE001
             if not self._not_found(e):
                 raise
@@ -159,11 +206,13 @@ class GcsStorage(StorageBackend):
 
     def delete_prefix(self, prefix: str) -> None:
         key = self._key(prefix)
-        for blob in self._client.list_blobs(self._bucket, prefix=key):
+        blobs = self._with_retry(
+            lambda: list(self._client.list_blobs(self._bucket, prefix=key)))
+        for blob in blobs:
             if not self._under(blob.name, key):
                 continue
             try:
-                blob.delete()
+                self._with_retry(blob.delete)
             except Exception as e:  # noqa: BLE001
                 if not self._not_found(e):
                     raise
@@ -171,7 +220,8 @@ class GcsStorage(StorageBackend):
     def list_prefix(self, prefix: str) -> List[str]:
         root = self._key(prefix)
         strip = len(self.prefix) + 1 if self.prefix else 0
-        return sorted(
-            blob.name[strip:] for blob in self._client.list_blobs(
-                self._bucket, prefix=root)
-            if self._under(blob.name, root))
+        blobs = self._with_retry(
+            lambda: list(self._client.list_blobs(self._bucket,
+                                                 prefix=root)))
+        return sorted(blob.name[strip:] for blob in blobs
+                      if self._under(blob.name, root))

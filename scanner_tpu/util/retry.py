@@ -1,0 +1,49 @@
+"""Exponential backoff with jitter for transient failures.
+
+Capability parity: the reference wraps every cross-process call in
+``GRPC_BACKOFF`` (reference scanner/util/grpc.h, used e.g.
+worker.cpp:886) and its storehouse layer retries transient storage
+errors.  One shared helper serves both the RPC client (UNAVAILABLE
+channels) and the GCS backend (429/5xx).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+def backoff_delays(retries: int, base: float = 0.05, cap: float = 2.0,
+                   rng: Optional[random.Random] = None):
+    """Yield `retries` sleep durations: full-jitter exponential backoff
+    (delay_i uniform in [0, min(cap, base * 2**i)]) — the AWS
+    'full jitter' scheme, which decorrelates thundering herds."""
+    r = rng or random
+    for i in range(retries):
+        yield r.uniform(0.0, min(cap, base * (2.0 ** i)))
+
+
+def call_with_backoff(fn: Callable[[], T], *,
+                      is_transient: Callable[[Exception], bool],
+                      retries: int = 4, base: float = 0.05,
+                      cap: float = 2.0,
+                      sleep: Callable[[float], None] = time.sleep,
+                      rng: Optional[random.Random] = None) -> T:
+    """Run fn(); on a transient exception retry up to `retries` times with
+    full-jitter exponential backoff.  Non-transient exceptions and the
+    final transient failure propagate unchanged."""
+    delays = backoff_delays(retries, base=base, cap=cap, rng=rng)
+    while True:
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001
+            if not is_transient(e):
+                raise
+            try:
+                delay = next(delays)
+            except StopIteration:
+                raise e from None
+            sleep(delay)

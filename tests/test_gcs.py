@@ -232,3 +232,152 @@ def test_engine_pipeline_on_gcs(tmp_path):
             assert frames[0].shape == (48, 64, 3)
     finally:
         sc.stop()
+
+
+# -- fault injection: transient errors + short reads ---------------------
+
+class FlakyBlob:
+    """Wraps a FakeBlob; raises a transient error on every other call and
+    optionally truncates ranged downloads to at most `max_range` bytes."""
+
+    def __init__(self, inner, state, code, max_range=None):
+        self._inner, self._state = inner, state
+        self._code, self._max_range = code, max_range
+        self.name = inner.name
+
+    @property
+    def chunk_size(self):
+        return self._inner.chunk_size
+
+    @chunk_size.setter
+    def chunk_size(self, v):
+        self._inner.chunk_size = v
+
+    @property
+    def size(self):
+        return self._inner.size
+
+    def _maybe_fail(self):
+        self._state["calls"] += 1
+        if self._state["calls"] % 2 == 1:
+            self._state["failures"] += 1
+            raise _ApiError(self._code)
+
+    def upload_from_string(self, *a, **kw):
+        self._maybe_fail()
+        return self._inner.upload_from_string(*a, **kw)
+
+    def download_as_bytes(self, start=None, end=None):
+        self._maybe_fail()
+        if (self._max_range is not None and start is not None
+                and end is not None and end - start + 1 > self._max_range):
+            end = start + self._max_range - 1  # truncated transfer
+        return self._inner.download_as_bytes(start=start, end=end)
+
+    def exists(self):
+        self._maybe_fail()
+        return self._inner.exists()
+
+    def delete(self):
+        self._maybe_fail()
+        return self._inner.delete()
+
+
+class FlakyGcsClient:
+    def __init__(self, code=503, max_range=None):
+        self._inner = FakeGcsClient()
+        self.state = {"calls": 0, "failures": 0}
+        self._code, self._max_range = code, max_range
+
+    def _wrap(self, blob):
+        return FlakyBlob(blob, self.state, self._code, self._max_range)
+
+    def bucket(self, name):
+        outer, inner_bucket = self, self._inner.bucket(name)
+
+        class _B:
+            name = inner_bucket.name
+
+            def blob(self, key):
+                return outer._wrap(inner_bucket.blob(key))
+
+            def get_blob(self, key):
+                outer.state["calls"] += 1
+                if outer.state["calls"] % 2 == 1:
+                    outer.state["failures"] += 1
+                    raise _ApiError(outer._code)
+                b = inner_bucket.get_blob(key)
+                return None if b is None else outer._wrap(b)
+
+        return _B()
+
+    def list_blobs(self, bucket, prefix=""):
+        self.state["calls"] += 1
+        if self.state["calls"] % 2 == 1:
+            self.state["failures"] += 1
+            raise _ApiError(self._code)
+        return [self._wrap(b)
+                for b in self._inner.list_blobs(bucket, prefix=prefix)]
+
+
+def _fast_gcs(client):
+    return GcsStorage("bkt", "db", client=client,
+                      backoff_base=0.001, backoff_cap=0.002)
+
+
+@pytest.mark.parametrize("code", [429, 500, 503])
+def test_gcs_transient_errors_are_retried(code):
+    """Every other API call fails with a retryable code; all operations
+    still succeed (storehouse retry parity)."""
+    client = FlakyGcsClient(code=code)
+    gcs = _fast_gcs(client)
+    gcs.write("a/b.bin", b"hello world")
+    assert gcs.read("a/b.bin") == b"hello world"
+    assert gcs.read_range("a/b.bin", 6, 5) == b"world"
+    assert gcs.exists("a/b.bin")
+    assert gcs.size("a/b.bin") == 11
+    assert gcs.list_prefix("a") == ["a/b.bin"]
+    gcs.delete("a/b.bin")
+    assert not gcs.exists("a/b.bin")
+    assert client.state["failures"] > 0
+
+
+def test_gcs_nontransient_errors_not_retried():
+    client = FlakyGcsClient(code=403)  # permission denied: surface once
+    gcs = _fast_gcs(client)
+    with pytest.raises(_ApiError):
+        gcs.write("a", b"x")
+    assert client.state["failures"] == 1
+
+
+def test_gcs_short_ranged_reads_are_completed():
+    """Truncated ranged transfers are re-issued until the full range (or
+    EOF) arrives."""
+    client = FlakyGcsClient(max_range=4)
+    gcs = _fast_gcs(client)
+    payload = bytes(range(64))
+    gcs.write("blob", payload)
+    assert gcs.read_range("blob", 8, 32) == payload[8:40]
+    assert gcs.read_range("blob", 48, 100) == payload[48:]  # EOF clip
+
+
+def test_gcs_retry_exhaustion_raises():
+    class AlwaysDown(FakeGcsClient):
+        def bucket(self, name):
+            class _B:
+                def blob(self, key):
+                    class _Blob:
+                        name = key
+                        chunk_size = None
+
+                        def download_as_bytes(self, **kw):
+                            raise _ApiError(503)
+
+                    return _Blob()
+
+            return _B()
+
+    gcs = GcsStorage("bkt", "db", client=AlwaysDown(), retries=2,
+                     backoff_base=0.001, backoff_cap=0.002)
+    with pytest.raises(_ApiError):
+        gcs.read("x")

@@ -124,3 +124,47 @@ def test_video_descriptor_roundtrip():
     assert vd2.width == 640 and vd2.fps == pytest.approx(29.97)
     assert (vd2.sample_offsets == vd.sample_offsets).all()
     assert (vd2.keyframe_indices == np.array([0, 5])).all()
+
+
+def test_posix_write_exclusive_without_hardlinks(tmp_path, monkeypatch):
+    """gcsfuse/NFS mounts reject os.link (EPERM/ENOTSUP); the marker path
+    must fall back to O_CREAT|O_EXCL instead of erroring (frame-sink mode
+    arbitration would otherwise break on those filesystems)."""
+    import errno
+    import os as _os
+
+    from scanner_tpu.storage import PosixStorage
+
+    def no_link(src, dst, **kw):
+        raise OSError(errno.EPERM, "Operation not permitted")
+
+    monkeypatch.setattr(_os, "link", no_link)
+    s = PosixStorage(str(tmp_path / "db"))
+    assert s.write_exclusive("m/marker", b"video") is True
+    assert s.write_exclusive("m/marker", b"pickle") is False
+    assert s.read("m/marker") == b"video"
+
+
+def test_backend_base_write_exclusive_default():
+    """Third-party backends that predate write_exclusive get a working
+    (best-effort) default from the base class instead of
+    NotImplementedError at save time."""
+    from scanner_tpu.storage.backend import StorageBackend
+
+    class Minimal(StorageBackend):
+        def __init__(self):
+            self.blobs = {}
+
+        def exists(self, path):
+            return path in self.blobs
+
+        def write(self, path, data):
+            self.blobs[path] = bytes(data)
+
+        def read(self, path):
+            return self.blobs[path]
+
+    s = Minimal()
+    assert s.write_exclusive("m", b"a") is True
+    assert s.write_exclusive("m", b"b") is False
+    assert s.read("m") == b"a"
