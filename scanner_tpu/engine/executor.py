@@ -339,7 +339,8 @@ class LocalExecutor:
                 f"pipeline finished {done}/{len(work)} tasks")
 
     def run_pipeline(self, info: A.GraphInfo, source,
-                     on_start=None, on_done=None, on_task_error=None,
+                     on_start=None, on_done=None, on_eval_done=None,
+                     on_task_error=None,
                      evaluator_factory=None, close_evaluators: bool = True,
                      queue_size: Optional[int] = None,
                      show_progress: bool = False, total: int = 0) -> int:
@@ -355,8 +356,11 @@ class LocalExecutor:
         called concurrently from loader threads.
         on_start(w) -> bool | None: evaluation-begin hook (cluster:
         StartedWork RPC); returning False drops the task without
-        evaluating (revoked attempt).  on_done(w): save-complete hook
-        (cluster: FinishedWork RPC).
+        evaluating (revoked attempt).  on_eval_done(w): evaluation-complete
+        hook, fired when the task hands off to the save stage (cluster:
+        EvalDone RPC so save-parked tasks stop counting against the
+        NextWork window).  on_done(w): save-complete hook (cluster:
+        FinishedWork RPC).
         on_task_error(w, exc) -> bool: True = task failure is reported and
         the pipeline continues (cluster); False/None = abort (local).
         evaluator_factory(idx, skip_fetch) -> TaskEvaluator: override to
@@ -453,6 +457,8 @@ class LocalExecutor:
                     except Exception as e:  # noqa: BLE001
                         task_failed(w, e)
                         continue
+                    if on_eval_done is not None:
+                        on_eval_done(w)
                     while not stop.is_set():
                         try:
                             save_q.put(w, timeout=0.25)

@@ -22,13 +22,15 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Set, Tuple
+from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 
 import cloudpickle
 
 from ..common import CacheMode, JobException, PerfParams, ScannerException
 from ..storage import Database, make_storage
+from ..storage import metadata as md
 from ..util.log import get_logger
 from ..util.profiler import Profiler
 from . import rpc
@@ -66,24 +68,39 @@ class _BulkJob:
     # mid-bulk loses at most N tasks of metadata (reference checkpoint
     # every N jobs, master.cpp:1100-1113); 0 disables
     checkpoint_frequency: int = 0
-    queue: List[Tuple[int, int]] = field(default_factory=list)
-    # (job, task) -> (worker id, clock start, attempt id, started).  The
-    # `started` flag records whether StartedWork arrived for this attempt:
-    # a timeout revocation of a task that only WAITED in a worker's queue
-    # is a scheduling artifact and must not count toward job blacklisting.
-    # The attempt id
+    # deque: NextWork pops the head O(1) — a 1000-video bulk is 10^5-10^6
+    # tasks and list.pop(0) would make dispatch quadratic (the reference
+    # shards tasks for the same reason, master.cpp:1558-1607)
+    queue: Deque[Tuple[int, int]] = field(default_factory=deque)
+    # (job, task) -> (worker id, clock start, attempt id, started,
+    # eval_done).  The `started` flag records whether StartedWork arrived
+    # for this attempt: a timeout revocation of a task that only WAITED in
+    # a worker's queue is a scheduling artifact and must not count toward
+    # job blacklisting.  The attempt id
     # makes assignments distinguishable: after a timeout revocation the
     # same worker may legitimately be re-assigned the task while its stale
     # attempt still runs, and only the *current* attempt's completion may
     # count (reference master.cpp:2111 stop_job_on_worker kills the stale
-    # attempt instead; here it reports and is ignored).
-    outstanding: Dict[Tuple[int, int], Tuple[int, float, int, bool]] = \
+    # attempt instead; here it reports and is ignored).  `eval_done` means
+    # the task is parked in the worker's save stage: it stays outstanding
+    # (timeout/fault tracking) but no longer counts against the worker's
+    # NextWork window (`held`).
+    outstanding: Dict[Tuple[int, int],
+                      Tuple[int, float, int, bool, bool]] = \
         field(default_factory=dict)
     next_attempt: int = 0
+    # per-worker count of outstanding assignments (kept in sync with
+    # `outstanding` so the NextWork window check is O(1))
+    held: Dict[int, int] = field(default_factory=dict)
     done: Set[Tuple[int, int]] = field(default_factory=set)
     failures: Dict[Tuple[int, int], int] = field(default_factory=dict)
     blacklisted_jobs: Set[int] = field(default_factory=set)
     total_tasks: int = 0
+    # counters so the finish check is O(1) per FinishedWork (a set
+    # comprehension over 10^5-10^6 tasks per completion would be
+    # quadratic): tasks in blacklisted jobs, and done-tasks among them
+    blacklisted_task_total: int = 0
+    done_in_blacklisted: int = 0
     job_tasks: Dict[int, Set[Tuple[int, int]]] = field(default_factory=dict)
     # job idx -> output table names, resolved at admission so completion
     # commits never deserialize the graph under the control-plane lock
@@ -115,7 +132,14 @@ class Master:
         self._bulk: Optional[_BulkJob] = None
         self._history: Dict[int, _BulkJob] = {}
         self._last_poke = time.time()
+        self._no_worker_since = time.time()
+        self._cleared_bulk_id: Optional[int] = None
         self._shutdown = threading.Event()
+        # resume an interrupted bulk BEFORE serving RPCs: workers that
+        # re-register see the restored bulk as active and pull its
+        # remaining tasks (reference recover_and_init_database,
+        # master.cpp:1311 + checkpoint master.cpp:1100-1113)
+        self._recover_bulk()
         self._server = rpc.RpcServer(MASTER_SERVICE, {
             "Ping": self._rpc_ping,
             "RegisterWorker": self._rpc_register_worker,
@@ -124,6 +148,7 @@ class Master:
             "GetJob": self._rpc_get_job,
             "NextWork": self._rpc_next_work,
             "StartedWork": self._rpc_started_work,
+            "EvalDone": self._rpc_eval_done,
             "FinishedWork": self._rpc_finished_work,
             "FailedWork": self._rpc_failed_work,
             "GetJobStatus": self._rpc_job_status,
@@ -212,7 +237,12 @@ class Master:
                 _mlog.info(
                     "bulk %d admitted: %d jobs, %d tasks",
                     bulk.bulk_id, len(bulk.job_tasks), bulk.total_tasks)
-                return {"bulk_id": bulk.bulk_id}
+            # persist admission state (outside the control-plane lock;
+            # still under the admission lock) so a master crash mid-bulk
+            # can resume instead of orphaning the job
+            if not bulk.finished:
+                self._persist_bulk_checkpoint(bulk)
+            return {"bulk_id": bulk.bulk_id}
 
     def _rpc_get_job(self, req: dict) -> dict:
         with self._lock:
@@ -241,18 +271,17 @@ class Master:
             if window:
                 # per-worker in-flight window: don't let one node's
                 # loaders hoard the queue while its siblings idle
-                held = sum(1 for a in bulk.outstanding.values()
-                           if a[0] == wid)
-                if held >= window and bulk.queue:
+                if bulk.held.get(wid, 0) >= window and bulk.queue:
                     return {"status": "wait"}
             while bulk.queue:
-                j, t = bulk.queue.pop(0)
+                j, t = bulk.queue.popleft()
                 if j in bulk.blacklisted_jobs or (j, t) in bulk.done:
                     continue
                 attempt = bulk.next_attempt
                 bulk.next_attempt += 1
                 bulk.outstanding[(j, t)] = (wid, time.time(), attempt,
-                                            False)
+                                            False, False)
+                bulk.held[wid] = bulk.held.get(wid, 0) + 1
                 _mlog.debug("task (%d,%d) assigned to worker %d "
                             "(attempt %d)", j, t, wid, attempt)
                 return {"status": "task", "job_idx": j, "task_idx": t,
@@ -274,7 +303,28 @@ class Master:
             cur = bulk.outstanding.get(key)
             if cur is not None and cur[0] == req.get("worker_id") \
                     and cur[2] == req.get("attempt"):
-                bulk.outstanding[key] = (cur[0], time.time(), cur[2], True)
+                bulk.outstanding[key] = (cur[0], time.time(), cur[2], True,
+                                         cur[4])
+                return {"ok": True}
+        return {"ok": False, "revoked": True}
+
+    def _rpc_eval_done(self, req: dict) -> dict:
+        """Worker signals that a task finished evaluation and is parked in
+        its save stage: it stops counting against the worker's NextWork
+        window so lagging savers cannot starve the evaluators (it stays
+        outstanding for timeout/fault tracking until FinishedWork)."""
+        key = (req["job_idx"], req["task_idx"])
+        with self._lock:
+            self._touch_worker(req.get("worker_id"))
+            bulk = self._bulk
+            if bulk is None or bulk.bulk_id != req["bulk_id"]:
+                return {"ok": False}
+            cur = bulk.outstanding.get(key)
+            if cur is not None and cur[0] == req.get("worker_id") \
+                    and cur[2] == req.get("attempt") and not cur[4]:
+                bulk.outstanding[key] = (cur[0], cur[1], cur[2], cur[3],
+                                         True)
+                self._dec_held(bulk, cur[0])
                 return {"ok": True}
         return {"ok": False, "revoked": True}
 
@@ -294,7 +344,7 @@ class Master:
             if cur is None or cur[0] != req.get("worker_id") \
                     or cur[2] != req.get("attempt"):
                 return {"ok": False, "revoked": True}
-            bulk.outstanding.pop(key, None)
+            self._unassign(bulk, key)
             if key in bulk.done or key[0] in bulk.blacklisted_jobs:
                 return {"ok": True}
             bulk.done.add(key)
@@ -306,13 +356,18 @@ class Master:
             need_ckpt = (bulk.checkpoint_frequency > 0 and not bulk.finished
                          and len(bulk.done) % bulk.checkpoint_frequency == 0)
             self._maybe_finish_bulk(bulk)
+            finished_now = bulk.finished
         if need_ckpt:
             # periodic metadata checkpoint: a master restart mid-bulk finds
-            # committed-so-far tables in the megafile.  Written OUTSIDE the
-            # control-plane lock — the Database has its own lock, and
-            # stalling heartbeats on a storage write would let the stale
-            # scan deactivate live workers.
+            # committed-so-far tables in the megafile and resumes from the
+            # persisted done-set.  Written OUTSIDE the control-plane lock —
+            # the Database has its own lock, and stalling heartbeats on a
+            # storage write would let the stale scan deactivate live
+            # workers.
             self.db.write_megafile()
+            self._persist_bulk_progress(bulk)
+        if finished_now:
+            self._clear_bulk_checkpoint(bulk.bulk_id)
         return {"ok": True}
 
     def _rpc_failed_work(self, req: dict) -> dict:
@@ -327,7 +382,7 @@ class Master:
             if cur is None or cur[0] != req.get("worker_id") \
                     or cur[2] != req.get("attempt"):
                 return {"ok": False, "revoked": True}
-            bulk.outstanding.pop(key, None)
+            self._unassign(bulk, key)
             if key in bulk.done:
                 return {"ok": True}
             n = bulk.failures.get(key, 0) + 1
@@ -336,13 +391,21 @@ class Master:
                           "(failure %d/%d): %s", key[0], key[1],
                           req.get("worker_id", -1), n, MAX_TASK_FAILURES,
                           err)
+            blacklisted_now = False
             if n >= MAX_TASK_FAILURES:
                 # job blacklisting (reference master.cpp:2161-2191): one
                 # poison stream cannot sink the bulk job
                 self._blacklist_job(bulk, key[0], err)
+                blacklisted_now = True
             else:
                 bulk.queue.append(key)
             self._maybe_finish_bulk(bulk)
+            finished_now = bulk.finished
+        if blacklisted_now and not finished_now:
+            # a restarted master must not resurrect the poisoned job
+            self._persist_bulk_progress(bulk)
+        if finished_now:
+            self._clear_bulk_checkpoint(bulk.bulk_id)
         return {"ok": True}
 
     def _rpc_job_status(self, req: dict) -> dict:
@@ -381,14 +444,157 @@ class Master:
         self._shutdown.set()
         return {"ok": True}
 
+    # -- bulk checkpoint / recovery -----------------------------------------
+
+    def _persist_bulk_checkpoint(self, bulk: _BulkJob) -> None:
+        """Write the admission state needed to resume this bulk after a
+        master restart.  Small by construction: the spec blob plus task
+        geometry — per-job sink names/custom sinks are re-derived on
+        recovery via prepare_readonly (the same derivation workers run)."""
+        state = {
+            "bulk_id": bulk.bulk_id,
+            "spec_blob": bulk.spec_blob,
+            "task_timeout": bulk.task_timeout,
+            "checkpoint_frequency": bulk.checkpoint_frequency,
+            "job_ntasks": {j: len(ts) for j, ts in bulk.job_tasks.items()},
+            "job_output_rows": dict(bulk.job_output_rows),
+        }
+        self.db.backend.write(md.bulk_checkpoint_path(),
+                              cloudpickle.dumps(state))
+
+    def _persist_bulk_progress(self, bulk: _BulkJob) -> None:
+        """Snapshot completion state (under the lock) and write it (storage
+        I/O must not stall heartbeats, so callers invoke this outside)."""
+        with self._lock:
+            prog = {
+                "bulk_id": bulk.bulk_id,
+                "done": sorted(bulk.done),
+                "failures": dict(bulk.failures),
+                "blacklisted_jobs": sorted(bulk.blacklisted_jobs),
+                "committed_jobs": sorted(bulk.committed_jobs),
+                "error": bulk.error,
+            }
+        self.db.backend.write(md.bulk_progress_path(),
+                              cloudpickle.dumps(prog))
+
+    def _clear_bulk_checkpoint(self, bulk_id: Optional[int] = None) -> None:
+        """Remove the (single, fixed-path) bulk checkpoint — but never a
+        NEWER active bulk's: callers run outside the control-plane lock,
+        so a NewJob admission can land between a bulk finishing and its
+        delayed cleanup.  The admission lock serializes us against the
+        admission sequence (which writes the new checkpoint while holding
+        it)."""
+        with self._admit_lock:
+            if bulk_id is not None:
+                with self._lock:
+                    cur = self._bulk
+                    if cur is not None and not cur.finished \
+                            and cur.bulk_id != bulk_id:
+                        return  # a newer active bulk owns the path
+            self.db.backend.delete(md.bulk_checkpoint_path())
+            self.db.backend.delete(md.bulk_progress_path())
+
+    def _recover_bulk(self) -> None:
+        """Resume the bulk job a previous master process left behind."""
+        try:
+            if not self.db.backend.exists(md.bulk_checkpoint_path()):
+                return
+            state = cloudpickle.loads(
+                self.db.backend.read(md.bulk_checkpoint_path()))
+            spec = cloudpickle.loads(state["spec_blob"])
+            ex = LocalExecutor(self.db)
+            _info, jobs = ex.prepare_readonly(spec["outputs"], spec["perf"])
+        except Exception:  # noqa: BLE001
+            # an unreadable checkpoint must not brick the master; the bulk
+            # is lost (client reruns it), new jobs proceed
+            _mlog.exception("bulk recovery failed; dropping checkpoint")
+            try:
+                self._clear_bulk_checkpoint()
+            except Exception:  # noqa: BLE001
+                pass
+            return
+        bulk = _BulkJob(
+            bulk_id=state["bulk_id"], spec_blob=state["spec_blob"],
+            task_timeout=state["task_timeout"],
+            checkpoint_frequency=state["checkpoint_frequency"])
+        for j, n in state["job_ntasks"].items():
+            job = jobs[j]
+            bulk.job_tasks[j] = {(j, t) for t in range(n)}
+            bulk.job_sink_names[j] = [
+                d.name for d, _c, _k, _e in job.sink_tables.values()]
+            bulk.job_custom_sinks[j] = list(job.custom_sinks.values())
+            bulk.job_output_rows[j] = state["job_output_rows"][j]
+            bulk.total_tasks += n
+        if self.db.backend.exists(md.bulk_progress_path()):
+            prog = cloudpickle.loads(
+                self.db.backend.read(md.bulk_progress_path()))
+            if prog.get("bulk_id") == bulk.bulk_id:
+                bulk.done = {tuple(k) for k in prog["done"]}
+                bulk.failures = {tuple(k): v
+                                 for k, v in prog["failures"].items()}
+                bulk.blacklisted_jobs = set(prog["blacklisted_jobs"])
+                bulk.committed_jobs = set(prog["committed_jobs"])
+                bulk.error = prog.get("error", "")
+                for j in bulk.blacklisted_jobs:
+                    bulk.blacklisted_task_total += len(
+                        bulk.job_tasks.get(j, ()))
+                    bulk.done_in_blacklisted += sum(
+                        1 for k in bulk.job_tasks.get(j, ())
+                        if k in bulk.done)
+        bulk.queue.extend(sorted(
+            k for j, ts in bulk.job_tasks.items()
+            if j not in bulk.blacklisted_jobs
+            for k in ts if k not in bulk.done))
+        self._bulk = bulk
+        self._history[bulk.bulk_id] = bulk
+        self._next_bulk_id = max(self._next_bulk_id, bulk.bulk_id + 1)
+        # tasks finished before the crash may complete whole jobs (or the
+        # whole bulk, if the crash hit between last-task and cleanup)
+        for j in list(bulk.job_tasks):
+            self._maybe_finish_job(bulk, j)
+        self._maybe_finish_bulk(bulk)
+        if bulk.finished:
+            self._clear_bulk_checkpoint()
+            _mlog.info("recovered bulk %d was already complete", bulk.bulk_id)
+        else:
+            _mlog.info(
+                "recovered bulk %d from checkpoint: %d/%d tasks done, "
+                "%d requeued", bulk.bulk_id, len(bulk.done),
+                bulk.total_tasks, len(bulk.queue))
+
     # -- internals ----------------------------------------------------------
 
+    @staticmethod
+    def _dec_held(bulk: _BulkJob, wid: int) -> None:
+        n = bulk.held.get(wid, 0) - 1
+        if n > 0:
+            bulk.held[wid] = n
+        else:
+            bulk.held.pop(wid, None)
+
+    @classmethod
+    def _unassign(cls, bulk: _BulkJob, key) -> Optional[Tuple]:
+        """Drop an outstanding assignment, keeping the per-worker held
+        count in sync (save-parked tasks were already released)."""
+        cur = bulk.outstanding.pop(key, None)
+        if cur is not None and not cur[4]:
+            cls._dec_held(bulk, cur[0])
+        return cur
+
     def _blacklist_job(self, bulk: _BulkJob, j: int, err: str) -> None:
+        if j in bulk.blacklisted_jobs:
+            # idempotent: two timed-out tasks of one job can both trip the
+            # failure threshold in a single scan pass; double-counting the
+            # finish counters would let the bulk "finish" early
+            return
         _mlog.error("job %d blacklisted after repeated failures: %s", j, err)
         bulk.blacklisted_jobs.add(j)
-        bulk.queue = [k for k in bulk.queue if k[0] != j]
+        bulk.blacklisted_task_total += len(bulk.job_tasks.get(j, ()))
+        bulk.done_in_blacklisted += sum(
+            1 for k in bulk.job_tasks.get(j, ()) if k in bulk.done)
+        bulk.queue = deque(k for k in bulk.queue if k[0] != j)
         for k in [k for k in bulk.outstanding if k[0] == j]:
-            bulk.outstanding.pop(k)
+            self._unassign(bulk, k)
         if not bulk.error:
             bulk.error = f"job {j} blacklisted after repeated failures: {err}"
 
@@ -407,9 +613,9 @@ class Master:
             bulk.committed_jobs.add(j)
 
     def _maybe_finish_bulk(self, bulk: _BulkJob) -> None:
-        active = {k for s in bulk.job_tasks.items()
-                  if s[0] not in bulk.blacklisted_jobs for k in s[1]}
-        if active <= bulk.done and not bulk.outstanding:
+        active_total = bulk.total_tasks - bulk.blacklisted_task_total
+        active_done = len(bulk.done) - bulk.done_in_blacklisted
+        if active_done >= active_total and not bulk.outstanding:
             bulk.finished = True
             _mlog.info("bulk %d finished: %d/%d tasks done",
                        bulk.bulk_id, len(bulk.done), bulk.total_tasks)
@@ -421,6 +627,7 @@ class Master:
         while not self._shutdown.is_set():
             time.sleep(0.5)
             now = time.time()
+            finished_bulk_id = None
             with self._lock:
                 # stale workers -> deactivate + requeue their tasks
                 for w in self._workers.values():
@@ -435,10 +642,10 @@ class Master:
                 if bulk is not None and not bulk.finished:
                     # per-task timeout
                     if bulk.task_timeout > 0:
-                        for key, (wid, t0, _a, started) in \
+                        for key, (wid, t0, _a, started, _ed) in \
                                 list(bulk.outstanding.items()):
                             if now - t0 > bulk.task_timeout:
-                                bulk.outstanding.pop(key)
+                                self._unassign(bulk, key)
                                 _mlog.warning(
                                     "task (%d,%d) timed out on worker %d "
                                     "after %.1fs (started=%s): revoking",
@@ -466,17 +673,23 @@ class Master:
                             bulk.finished = True
                     else:
                         self._no_worker_since = now
+                if bulk is not None and bulk.finished:
+                    finished_bulk_id = bulk.bulk_id
                 if self.enable_watchdog and \
                         now - self._last_poke > 30.0:
                     self._shutdown.set()
+            if finished_bulk_id is not None \
+                    and finished_bulk_id != self._cleared_bulk_id:
+                self._clear_bulk_checkpoint(finished_bulk_id)
+                self._cleared_bulk_id = finished_bulk_id
 
     def _requeue_worker_tasks(self, wid: int) -> None:
         bulk = self._bulk
         if bulk is None or bulk.finished:
             return
-        for key, (owner, _t0, _a, _s) in list(bulk.outstanding.items()):
+        for key, (owner, _t0, _a, _s, _ed) in list(bulk.outstanding.items()):
             if owner == wid:
-                bulk.outstanding.pop(key)
+                self._unassign(bulk, key)
                 bulk.queue.append(key)
 
     def wait_for_shutdown(self) -> None:
@@ -643,6 +856,10 @@ class Worker:
         (bulk over), or ('task_error', j, t, exc)."""
         if self._hb_reply.get("active_bulk") != bulk_id:
             return None
+        # the window covers the load+evaluate stages only: save-parked
+        # tasks are released from the master's held-count by the EvalDone
+        # RPC, so lagging savers can't throttle the evaluators while a
+        # small window still spreads small jobs across workers
         window = (self.executor.pipeline_instances
                   + self.executor.num_load_workers)
         reply = self.master.try_call("NextWork", worker_id=self.worker_id,
@@ -697,6 +914,14 @@ class Worker:
                 attempt=w.attempt)
             return reply is None or bool(reply.get("ok"))
 
+        def on_eval_done(w) -> None:
+            # hand-off to the save stage: release this task from the
+            # NextWork window so parked saves don't starve the evaluators
+            self.master.try_call(
+                "EvalDone", bulk_id=bulk_id, worker_id=self.worker_id,
+                job_idx=w.job.job_idx, task_idx=w.task_idx,
+                attempt=w.attempt)
+
         def on_done(w) -> None:
             self.master.try_call(
                 "FinishedWork", bulk_id=bulk_id, worker_id=self.worker_id,
@@ -725,7 +950,7 @@ class Worker:
 
         self.executor.run_pipeline(
             self._info, source, on_start=on_start, on_done=on_done,
-            on_task_error=on_task_error,
+            on_eval_done=on_eval_done, on_task_error=on_task_error,
             evaluator_factory=evaluator_factory, close_evaluators=False,
             queue_size=self._queue_size)
 
@@ -754,10 +979,15 @@ class ClusterClient:
 
     def __init__(self, master_address: str, db: Database,
                  enable_watchdog: bool = False, poll_interval: float = 0.25,
-                 **_kw):
+                 master_down_timeout: float = 120.0, **_kw):
         self.db = db
         self.master = rpc.RpcClient(master_address, MASTER_SERVICE)
         self.poll_interval = poll_interval
+        # how long GetJobStatus may fail continuously before the client
+        # gives up — long enough to ride out a master restart (it recovers
+        # the bulk from its checkpoint), short enough that a dead master
+        # raises instead of hanging the caller forever
+        self.master_down_timeout = master_down_timeout
         self._watchdog_stop = threading.Event()
         if enable_watchdog:
             t = threading.Thread(target=self._poke_loop, daemon=True)
@@ -777,8 +1007,26 @@ class ClusterClient:
         if "error" in reply:
             raise JobException(reply["error"])
         bulk_id = reply["bulk_id"]
+        last_ok = time.time()
         while True:
-            st = self.master.call("GetJobStatus", bulk_id=bulk_id)
+            # try_call: a master restarting mid-bulk (it recovers the job
+            # from its checkpoint) must look like slow progress, not a
+            # client-visible failure — but a master that stays dead past
+            # master_down_timeout raises instead of hanging forever
+            st = self.master.try_call("GetJobStatus", bulk_id=bulk_id)
+            if st is None:
+                if time.time() - last_ok > self.master_down_timeout:
+                    raise JobException(
+                        f"master unreachable for "
+                        f"{self.master_down_timeout:.0f}s while waiting "
+                        f"on bulk {bulk_id}")
+                time.sleep(self.poll_interval)
+                continue
+            last_ok = time.time()
+            if "tasks_done" not in st:
+                # the master came back without this bulk (recovery failed
+                # or checkpoint missing): surface, don't KeyError
+                raise JobException(st.get("error", "bulk job lost"))
             if show_progress:
                 print(f"\rtasks {st['tasks_done']}/{st['total_tasks']} "
                       f"workers={st['num_workers']}", end="", flush=True)

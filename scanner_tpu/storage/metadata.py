@@ -59,6 +59,19 @@ def job_profile_path(job_id: int, node: str) -> str:
     return f"jobs/{job_id}/profile_{node}.trace"
 
 
+def bulk_checkpoint_path() -> str:
+    """Active bulk job's admission state (spec blob + task geometry) —
+    lets a restarted master resume the job (reference
+    recover_and_init_database, master.cpp:1311)."""
+    return "jobs/active_bulk.bin"
+
+
+def bulk_progress_path() -> str:
+    """Active bulk job's progress (done-set, blacklist, commits), written
+    with each periodic checkpoint."""
+    return "jobs/active_bulk_progress.bin"
+
+
 # ---------------------------------------------------------------------------
 # msgpack helpers with numpy support
 # ---------------------------------------------------------------------------

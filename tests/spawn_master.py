@@ -1,0 +1,14 @@
+"""Standalone master process for restart-recovery tests (the reference
+master is its own process too; recover_and_init_database master.cpp:1311).
+
+Usage: python spawn_master.py <db_path> <port>
+"""
+
+import sys
+
+from scanner_tpu.engine.service import start_master
+
+if __name__ == "__main__":
+    db_path = sys.argv[1]
+    port = int(sys.argv[2])
+    start_master(db_path, port=port, no_workers_timeout=60.0, block=True)
