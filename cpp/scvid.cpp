@@ -392,7 +392,8 @@ SCVID_API ScvidEncoder* scvid_encoder_create(int32_t width, int32_t height,
                                              int32_t fps_num, int32_t fps_den,
                                              const char* codec_name,
                                              int64_t bitrate, int32_t crf,
-                                             int32_t keyint) {
+                                             int32_t keyint,
+                                             int32_t bframes) {
   const AVCodec* codec = avcodec_find_encoder_by_name(codec_name);
   if (!codec) {
     set_error(std::string("no encoder: ") + codec_name);
@@ -405,7 +406,10 @@ SCVID_API ScvidEncoder* scvid_encoder_create(int32_t width, int32_t height,
   ctx->framerate = {fps_num, fps_den};
   ctx->pix_fmt = AV_PIX_FMT_YUV420P;
   ctx->gop_size = keyint > 0 ? keyint : 16;
-  ctx->max_b_frames = 0;  // simplifies exact-seek on our own outputs
+  // bframes=0 (the sink default) keeps exact-seek trivial on our own
+  // outputs; >0 produces pts!=dts reordered streams — how real-world
+  // mp4s look, and what the decode-index tests exercise
+  ctx->max_b_frames = bframes > 0 ? bframes : 0;
   // SPS/PPS in extradata, not per-keyframe (matches mp4-style storage)
   ctx->flags |= AV_CODEC_FLAG_GLOBAL_HEADER;
   if (bitrate > 0) ctx->bit_rate = bitrate;

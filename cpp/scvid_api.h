@@ -47,7 +47,8 @@ int64_t scvid_decoder_emitted(ScvidDecoder* d);
 ScvidEncoder* scvid_encoder_create(int32_t width, int32_t height,
                                    int32_t fps_num, int32_t fps_den,
                                    const char* codec_name, int64_t bitrate,
-                                   int32_t crf, int32_t keyint);
+                                   int32_t crf, int32_t keyint,
+                                   int32_t bframes);
 void scvid_encoder_destroy(ScvidEncoder* e);
 int64_t scvid_encoder_extradata(ScvidEncoder* e, uint8_t* buf,
                                 int64_t bufsize);

@@ -47,7 +47,7 @@ int main() {
 
   // --- encode a deterministic clip -------------------------------------
   ScvidEncoder* enc = scvid_encoder_create(W, H, 24, 1, "libx264", 0, 18,
-                                           KEYINT);
+                                           KEYINT, 0);
   CHECK(enc != nullptr, "encoder create");
   std::vector<uint8_t> frame(W * H * 3);
   for (int i = 0; i < N; ++i) {

@@ -283,9 +283,12 @@ def frame_pattern_id(frame: np.ndarray) -> int:
 
 def encode_frames_mp4(path: str, frames, width: int, height: int,
                       fps: float = 24.0, keyint: int = 12,
-                      crf: int = 18) -> None:
-    """Encode an iterable of (H, W, 3) uint8 frames to an .mp4."""
-    enc = lib.Encoder(width, height, fps=fps, keyint=keyint, crf=crf)
+                      crf: int = 18, bframes: int = 0) -> None:
+    """Encode an iterable of (H, W, 3) uint8 frames to an .mp4.
+    bframes>0 produces a reordered (pts!=dts) stream like real-world
+    encodes — the decode-index tests' fixture knob."""
+    enc = lib.Encoder(width, height, fps=fps, keyint=keyint, crf=crf,
+                      bframes=bframes)
     for frame in frames:
         enc.feed(frame)
     enc.flush()
@@ -297,8 +300,8 @@ def encode_frames_mp4(path: str, frames, width: int, height: int,
 
 def synthesize_video(path: str, num_frames: int = 90, width: int = 128,
                      height: int = 96, fps: float = 24.0,
-                     keyint: int = 12) -> None:
+                     keyint: int = 12, bframes: int = 0) -> None:
     """Encode a deterministic test clip to an .mp4 with libx264."""
     encode_frames_mp4(
         path, (frame_pattern(i, height, width) for i in range(num_frames)),
-        width, height, fps=fps, keyint=keyint)
+        width, height, fps=fps, keyint=keyint, bframes=bframes)

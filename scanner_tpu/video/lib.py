@@ -88,7 +88,7 @@ def get_lib():
         lib.scvid_encoder_create.restype = C.c_void_p
         lib.scvid_encoder_create.argtypes = [
             C.c_int32, C.c_int32, C.c_int32, C.c_int32, C.c_char_p,
-            C.c_int64, C.c_int32, C.c_int32]
+            C.c_int64, C.c_int32, C.c_int32, C.c_int32]
         lib.scvid_encoder_destroy.argtypes = [C.c_void_p]
         lib.scvid_encoder_extradata.restype = C.c_int64
         lib.scvid_encoder_extradata.argtypes = [C.c_void_p, C.c_void_p,
@@ -210,14 +210,14 @@ class Decoder:
 class Encoder:
     def __init__(self, width: int, height: int, fps: float = 30.0,
                  codec: str = "libx264", bitrate: int = 0, crf: int = 20,
-                 keyint: int = 16):
+                 keyint: int = 16, bframes: int = 0):
         self._lib = get_lib()
         fps_num, fps_den = _fps_to_rational(fps)
         self.width, self.height = width, height
         self.fps_num, self.fps_den = fps_num, fps_den
         self._h = self._lib.scvid_encoder_create(
             width, height, fps_num, fps_den, codec.encode(), bitrate, crf,
-            keyint)
+            keyint, bframes)
         if not self._h:
             raise ScannerException(f"encoder create failed: {_err()}")
 

@@ -151,3 +151,96 @@ def test_encoder_decoder_roundtrip_lossless_geometry():
         assert scv.frame_pattern_id(out[i]) == expected_id(i, 48, 64)
     dec.close()
     enc.close()
+
+
+# -- B-frame / reordered (pts != dts) streams ---------------------------
+# Real-world encodes reorder: the display-order <-> decode-order maps in
+# VideoIndex (dec_of_disp) are non-trivial.  Reference coverage:
+# decoder_automata_test.cpp (seeks/discontinuities) + feeder
+# discontinuity logic decoder_automata.cpp:238.
+
+@pytest.fixture(scope="module")
+def bclip(tmp_path_factory):
+    p = str(tmp_path_factory.mktemp("vids") / "bclip.mp4")
+    scv.synthesize_video(p, num_frames=48, width=64, height=48, fps=24,
+                         keyint=8, bframes=2)
+    return p
+
+
+def test_bframe_stream_actually_reorders(bclip):
+    vd = scv.ingest_file(bclip, None)
+    assert vd.num_frames == 48
+    pts = np.asarray(vd.sample_pts)
+    # decode order != display order somewhere, else the fixture is moot
+    assert not np.all(np.diff(pts) > 0), \
+        "encoder produced no reordering; bframes knob broken"
+    idx = VideoIndex(vd)
+    assert not np.array_equal(idx.dec_of_disp, np.arange(48))
+    # the display<->decode maps are mutually inverse permutations
+    assert np.array_equal(idx.disp_of_dec[idx.dec_of_disp], np.arange(48))
+
+
+def test_bframe_full_sequential_decode(tmp_db, bclip):
+    scv.ingest_videos(tmp_db, [("bclip_seq", bclip)])
+    frames = scv.load_frames(tmp_db, "bclip_seq", list(range(48)))
+    ids = [scv.frame_pattern_id(f) for f in frames]
+    assert ids == [expected_id(r, 48, 64) for r in range(48)], \
+        "display-order delivery broken on a reordered stream"
+
+
+def test_bframe_gather_near_gop_boundaries(tmp_db, bclip):
+    """Isolated frames just before/at/after each keyframe (keyint=8):
+    exactly where pts!=dts reordering bites the decode plan."""
+    scv.ingest_videos(tmp_db, [("bclip_gop", bclip)])
+    rows = [6, 7, 8, 9, 15, 16, 17, 31, 32, 40, 47]
+    frames = scv.load_frames(tmp_db, "bclip_gop", rows)
+    for got, r in zip(frames, rows):
+        assert scv.frame_pattern_id(got) == expected_id(r, 48, 64), \
+            f"frame {r} wrong on reordered stream"
+
+
+def test_bframe_unsorted_with_duplicates(tmp_db, bclip):
+    scv.ingest_videos(tmp_db, [("bclip_dup", bclip)])
+    rows = [30, 7, 7, 45, 0, 23]
+    frames = scv.load_frames(tmp_db, "bclip_dup", rows)
+    assert (frames[1] == frames[2]).all()
+    for got, r in zip(frames, rows):
+        assert scv.frame_pattern_id(got) == expected_id(r, 48, 64)
+
+
+def test_bframe_inplace_ingest(tmp_db, bclip):
+    """In-place (external container) reads must also survive reordering."""
+    scv.ingest_videos(tmp_db, [("bclip_inp", bclip)], inplace=True)
+    rows = [5, 8, 20, 41]
+    frames = scv.load_frames(tmp_db, "bclip_inp", rows)
+    for got, r in zip(frames, rows):
+        assert scv.frame_pattern_id(got) == expected_id(r, 48, 64)
+
+
+def test_bframe_engine_gather_pipeline(tmp_db, bclip, tmp_path):
+    """Full engine path (DAG analysis -> decode plan -> kernel -> sink)
+    over a Gather of a reordered stream."""
+    from scanner_tpu import (CacheMode, Client, NamedStream,
+                            NamedVideoStream, PerfParams)
+    import scanner_tpu.kernels  # noqa: F401
+
+    sc = Client(db_path=str(tmp_path / "bdb"))
+    try:
+        movie = NamedVideoStream(sc, "bmovie", path=bclip)
+        frames = sc.io.Input([movie])
+        rows = [2, 8, 9, 15, 16, 30, 47]
+        picked = sc.streams.Gather(frames, [rows])
+        hist = sc.ops.Histogram(frame=picked)
+        out = NamedStream(sc, "bhists")
+        sc.run(sc.io.Output(hist, [out]), PerfParams.manual(4, 8),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+        hists = list(out.load())
+        assert len(hists) == len(rows)
+        # cross-check against direct exact decode of the same rows
+        direct = scv.load_frames(sc._db, "bmovie", rows)
+        from scanner_tpu.kernels.imgproc import Histogram as HK
+        for h, f in zip(hists, direct):
+            expect = HK._histogram_np(f[None])[0]
+            assert np.array_equal(np.stack(h), expect)
+    finally:
+        sc.stop()
