@@ -1,0 +1,71 @@
+"""Face detection app: per-frame face boxes over a video, using the
+shipped trained weights.  (Reference: examples/apps/face_detection/main.py,
+which runs an externally-trained face detector; these weights come from
+scanner_tpu.models.detect_train's synthetic face-scene task.)
+
+Usage: python examples/face_detection.py [path/to/video.mp4] [stride]
+With no video argument a synthetic face-scene clip is generated and the
+reported boxes are scored (recall/IoU) against the ground truth.
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+from scanner_tpu import (CacheMode, Client, NamedStream, NamedVideoStream,
+                         PerfParams)
+import scanner_tpu.models  # registers FaceDetect
+from scanner_tpu.models.detect_train import (WIDTH, box_iou,
+                                             render_face_scene,
+                                             synth_scene_video)
+
+
+def main():
+    video_path = sys.argv[1] if len(sys.argv) > 1 else None
+    stride = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    truth = None
+    if video_path is None:
+        video_path = os.path.join(tempfile.mkdtemp(prefix="facedet_ex_"),
+                                  "faces.mp4")
+        truth = synth_scene_video(video_path, renderer=render_face_scene,
+                                  num_frames=16)
+
+    sc = Client(db_path=os.path.join(
+        tempfile.mkdtemp(prefix="facedet_db_"), "db"))
+    try:
+        movie = NamedVideoStream(sc, "facedet_movie", path=video_path)
+        frames = sc.io.Input([movie])
+        sampled = sc.streams.Stride(frames, [{"stride": stride}])
+        # width 8 restores the shipped trained face weights by default
+        dets = sc.ops.FaceDetect(frame=sampled, width=WIDTH,
+                                 score_thresh=0.3)
+        out = NamedStream(sc, "face_detections")
+        sc.run(sc.io.Output(dets, [out]), PerfParams.estimate(),
+               cache_mode=CacheMode.Overwrite)
+
+        hits = total = 0
+        for i, det in enumerate(out.load()):
+            boxes, scores = det["boxes"], det["scores"]
+            if i < 5:
+                tops = ", ".join(
+                    f"[{b[0]:.2f} {b[1]:.2f} {b[2]:.2f} {b[3]:.2f}]@"
+                    f"{s:.2f}" for b, s in zip(boxes[:3], scores[:3]))
+                print(f"frame {i * stride}: {len(boxes)} faces  {tops}")
+            if truth is not None:
+                for gt in truth[i * stride]:
+                    total += 1
+                    if any(box_iou(gt, b) >= 0.3 for b in boxes):
+                        hits += 1
+        if truth is not None:
+            print(f"recall@IoU0.3: {hits}/{total} "
+                  f"({100.0 * hits / max(total, 1):.0f}%)")
+            assert hits >= 0.7 * total, \
+                "shipped face detector failed to localize the scenes"
+    finally:
+        sc.stop()
+
+
+if __name__ == "__main__":
+    main()

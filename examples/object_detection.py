@@ -1,0 +1,69 @@
+"""Object detection app: per-frame boxes over a video, using the shipped
+trained SSD weights.  (Reference: examples/apps/object_detection_tensorflow/
+main.py, which downloads an externally-trained SSD-mobilenet; these
+weights come from scanner_tpu.models.detect_train's synthetic scene task.)
+
+Usage: python examples/object_detection.py [path/to/video.mp4] [stride]
+With no video argument a synthetic rectangle-scene clip is generated and
+the reported boxes are scored (recall/IoU) against the ground truth.
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+from scanner_tpu import (CacheMode, Client, NamedStream, NamedVideoStream,
+                         PerfParams)
+import scanner_tpu.models  # registers ObjectDetect
+from scanner_tpu.models.detect_train import (WIDTH, box_iou,
+                                             synth_scene_video)
+
+
+def main():
+    video_path = sys.argv[1] if len(sys.argv) > 1 else None
+    stride = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    truth = None
+    if video_path is None:
+        video_path = os.path.join(tempfile.mkdtemp(prefix="objdet_ex_"),
+                                  "scenes.mp4")
+        truth = synth_scene_video(video_path, num_frames=16)
+
+    sc = Client(db_path=os.path.join(tempfile.mkdtemp(prefix="objdet_db_"),
+                                     "db"))
+    try:
+        movie = NamedVideoStream(sc, "objdet_movie", path=video_path)
+        frames = sc.io.Input([movie])
+        sampled = sc.streams.Stride(frames, [{"stride": stride}])
+        # width 8 restores the shipped trained weights by default
+        dets = sc.ops.ObjectDetect(frame=sampled, width=WIDTH,
+                                   score_thresh=0.3)
+        out = NamedStream(sc, "detections")
+        sc.run(sc.io.Output(dets, [out]), PerfParams.estimate(),
+               cache_mode=CacheMode.Overwrite)
+
+        hits = total = 0
+        for i, det in enumerate(out.load()):
+            boxes, scores = det["boxes"], det["scores"]
+            if i < 5:
+                tops = ", ".join(
+                    f"[{b[0]:.2f} {b[1]:.2f} {b[2]:.2f} {b[3]:.2f}]@"
+                    f"{s:.2f}" for b, s in zip(boxes[:3], scores[:3]))
+                print(f"frame {i * stride}: {len(boxes)} boxes  {tops}")
+            if truth is not None:
+                for gt in truth[i * stride]:
+                    total += 1
+                    if any(box_iou(gt, b) >= 0.3 for b in boxes):
+                        hits += 1
+        if truth is not None:
+            print(f"recall@IoU0.3: {hits}/{total} "
+                  f"({100.0 * hits / max(total, 1):.0f}%)")
+            assert hits >= 0.7 * total, \
+                "shipped detector failed to localize the synthetic scenes"
+    finally:
+        sc.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -42,10 +42,12 @@ def main():
     try:
         movie = NamedVideoStream(sc, "reid_movie", path=video_path)
         frames = sc.io.Input([movie])
-        det = sc.ops.ObjectDetect(frame=frames, width=16)
+        # width 8 restores the shipped trained weights by default
+        # (models/weights/, provenance models/detect_train.py)
+        det = sc.ops.ObjectDetect(frame=frames, width=8)
         box = sc.ops.TopBox(det=det)
         crops = sc.ops.CropResize(frame=frames, box=box, size=64)
-        feats = sc.ops.FaceEmbedding(frame=crops, width=16, dim=64)
+        feats = sc.ops.FaceEmbedding(frame=crops, width=8)
         out = NamedStream(sc, "reid_features")
         sc.run(sc.io.Output(feats, [out]), PerfParams.estimate(),
                cache_mode=CacheMode.Overwrite)

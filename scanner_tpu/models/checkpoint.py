@@ -95,6 +95,18 @@ def init_or_restore(model, rng, dummy_input, checkpoint_dir: Optional[str]):
     return model.init(rng, dummy_input)
 
 
+def shipped_weights(filename: str) -> Optional[str]:
+    """Path of a weight file shipped in models/weights/, or None.
+
+    Model kernels default to shipped trained weights when the caller gives
+    no checkpoint and the requested width matches the shipped
+    configuration (the reference apps likewise download pretrained models
+    by default, object_detection_tensorflow/main.py:16-23)."""
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "weights", filename)
+    return p if os.path.exists(p) else None
+
+
 def export_params_npz(params: Any, path: str) -> None:
     """Flatten a param tree into one portable .npz (the shippable weight
     format — orbax trees are for resumable TRAINING state)."""

@@ -117,14 +117,26 @@ def batched_nms(boxes, scores, top_k: int = 32, iou_thresh: float = 0.5):
 @register_op(device=DeviceType.TPU, batch=8)
 class ObjectDetect(Kernel):
     """Per-frame object detections: list of (box[y1,x1,y2,x2], score)
-    in unit coordinates (reference TF SSD app equivalent)."""
+    in unit coordinates (reference TF SSD app equivalent).
+
+    With no `checkpoint_dir`, width-8 instances restore the shipped
+    synthetic-task weights (models/weights/detect_ssd_w8.npz, provenance
+    models/detect_train.py) — like the reference app downloading SSD
+    mobilenet by default; pass `pretrained=False` for random init."""
+
+    _shipped = "detect_ssd_w8.npz"
+    _shipped_width = 8
 
     def __init__(self, config, width: int = 32, num_classes: int = 2,
                  score_thresh: float = 0.05, seed: int = 0,
-                 checkpoint_dir: Optional[str] = None):
+                 checkpoint_dir: Optional[str] = None,
+                 pretrained: bool = True):
         super().__init__(config)
         self.model = SSDDetector(num_classes=num_classes, width=width)
-        from .checkpoint import init_or_restore
+        from .checkpoint import init_or_restore, shipped_weights
+        if checkpoint_dir is None and pretrained \
+                and width == self._shipped_width and num_classes == 2:
+            checkpoint_dir = shipped_weights(self._shipped)
         self.params = init_or_restore(
             self.model, jax.random.PRNGKey(seed),
             jnp.zeros((1, 128, 128, 3), jnp.uint8), checkpoint_dir)

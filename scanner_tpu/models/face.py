@@ -25,13 +25,20 @@ from .nets import Backbone
 @register_op(name="FaceDetect", device=DeviceType.TPU, batch=8)
 class FaceDetect(ObjectDetect):
     """SSD detector with face-tuned defaults (reference face_detection
-    app)."""
+    app).  Width-8 instances restore the shipped face-task weights
+    (models/weights/face_ssd_w8.npz, models/detect_train.py) unless a
+    checkpoint is given or pretrained=False."""
+
+    _shipped = "face_ssd_w8.npz"
+    _shipped_width = 8
 
     def __init__(self, config, width: int = 32, score_thresh: float = 0.1,
-                 seed: int = 1, checkpoint_dir: Optional[str] = None):
+                 seed: int = 1, checkpoint_dir: Optional[str] = None,
+                 pretrained: bool = True):
         super().__init__(config, width=width, num_classes=2,
                          score_thresh=score_thresh, seed=seed,
-                         checkpoint_dir=checkpoint_dir)
+                         checkpoint_dir=checkpoint_dir,
+                         pretrained=pretrained)
 
 
 class EmbeddingNet(nn.Module):
@@ -53,14 +60,24 @@ class EmbeddingNet(nn.Module):
 @register_op(device=DeviceType.TPU, batch=16)
 class FaceEmbedding(Kernel):
     """L2-normalized face/crop embedding vectors (reference face-embedding
-    pipeline, BASELINE config 5)."""
+    pipeline, BASELINE config 5).  Width-8/dim-128 instances restore the
+    shipped identity-metric weights (models/weights/embed_w8.npz,
+    models/detect_train.py) unless a checkpoint is given or
+    pretrained=False."""
+
+    _shipped = "embed_w8.npz"
+    _shipped_width = 8
 
     def __init__(self, config, dim: int = 128, width: int = 32,
-                 seed: int = 2, checkpoint_dir: Optional[str] = None):
+                 seed: int = 2, checkpoint_dir: Optional[str] = None,
+                 pretrained: bool = True):
         super().__init__(config)
         self.model = EmbeddingNet(dim=dim, width=width)
-        from .checkpoint import init_or_restore
+        from .checkpoint import init_or_restore, shipped_weights
         from .infer import DataParallelApply
+        if checkpoint_dir is None and pretrained \
+                and width == self._shipped_width and dim == 128:
+            checkpoint_dir = shipped_weights(self._shipped)
         params = init_or_restore(
             self.model, jax.random.PRNGKey(seed),
             jnp.zeros((1, 128, 128, 3), jnp.uint8), checkpoint_dir)
@@ -70,5 +87,6 @@ class FaceEmbedding(Kernel):
         self.params = self._dp.params
 
     def execute(self, frame: Sequence[FrameType]) -> Sequence[Any]:
-        emb = np.asarray(self._dp(jnp.asarray(frame)))
-        return list(emb)
+        # (B, dim) embeddings returned without a host sync (device arrays
+        # chain through the column store; the sink fetches once per task)
+        return self._dp(jnp.asarray(frame))

@@ -152,21 +152,45 @@ class PoseDetect(Kernel):
     the provenance is scanner_tpu.models.pose_train).  `width` must
     match the trained configuration."""
 
+    _shipped = "pose_blobnet_w8.npz"
+    _shipped_width = 8
+
     def __init__(self, config, width: int = 32, seed: int = 0,
-                 checkpoint_dir: Optional[str] = None):
+                 checkpoint_dir: Optional[str] = None,
+                 pretrained: bool = True):
         super().__init__(config)
-        from .checkpoint import init_or_restore
+        from .checkpoint import init_or_restore, shipped_weights
         from .infer import DataParallelApply
         self.model = VideoPoseNet(width=width)
+        if checkpoint_dir is None and pretrained \
+                and width == self._shipped_width:
+            checkpoint_dir = shipped_weights(self._shipped)
+
+        def apply_and_peaks(params, clip):
+            """Forward + on-device argmax: ship (B,K,3) keypoints off the
+            chip, not (B,h,w,K) heatmaps — heatmaps are ~MBs per batch
+            and the d2h hop is latency-bound (PERF.md §1)."""
+            heat = self.model.apply(params, clip)[:, 0]   # (B, h, w, K)
+            B, h, w, K = heat.shape
+            flat = heat.reshape(B, h * w, K)
+            idx = flat.argmax(axis=1)                     # (B, K)
+            scores = jnp.take_along_axis(flat, idx[:, None, :],
+                                         axis=1)[:, 0, :]
+            ys, xs = idx // w, idx % w
+            return jnp.stack([xs.astype(jnp.float32),
+                              ys.astype(jnp.float32), scores], axis=-1)
+
         params = init_or_restore(
             self.model, jax.random.PRNGKey(seed),
             jnp.zeros((1, 1, 128, 128, 3), jnp.uint8), checkpoint_dir)
         # dp-shard batches over every chip the engine handed this kernel
-        self._dp = DataParallelApply(jax.jit(self.model.apply), params,
+        self._dp = DataParallelApply(jax.jit(apply_and_peaks), params,
                                      config.devices)
         self.params = self._dp.params
 
     def execute(self, frame: Sequence[FrameType]) -> Sequence[Any]:
         clip = jnp.asarray(frame)[:, None]  # (B, 1, H, W, 3)
-        heat = np.asarray(self._dp(clip))[:, 0]
-        return [heatmaps_to_keypoints(h) for h in heat]
+        # (B, K, 3) [x, y, score] in heatmap coords, returned WITHOUT a
+        # host sync: the column store chains device arrays and the sink
+        # fetches once per task
+        return self._dp(clip)

@@ -27,7 +27,13 @@ EXAMPLES = [
     "pose_detection.py",
     "reid_features.py",
     "shot_detection.py",
+    "object_detection.py",
+    "face_detection.py",
 ]
+
+# examples that synthesize their own scene video and assert recall
+# against ground truth when run with no arguments
+SELF_CONTAINED = {"object_detection.py", "face_detection.py"}
 
 
 @pytest.fixture(scope="module")
@@ -43,11 +49,14 @@ def test_example_runs(example, clip, tmp_path):
     from scanner_tpu.util.jaxenv import cpu_only_env
     env = cpu_only_env()
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    args = [sys.executable, os.path.join(REPO, "examples", example), clip]
-    if example == "pose_detection.py":
-        args.append("5")  # stride (it makes its own temp db)
+    args = [sys.executable, os.path.join(REPO, "examples", example)]
+    if example in SELF_CONTAINED:
+        pass  # no args: synthesize scenes + assert recall vs ground truth
+    elif example == "pose_detection.py":
+        args += [clip, "5"]  # stride (it makes its own temp db)
     else:
-        args.append(str(tmp_path / "db"))  # hermetic per-test database
+        # hermetic per-test database
+        args += [clip, str(tmp_path / "db")]
     r = subprocess.run(args, env=env, capture_output=True, text=True,
                        timeout=240)
     assert r.returncode == 0, f"{example} failed:\n{r.stdout}\n{r.stderr}"

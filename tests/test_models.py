@@ -230,3 +230,97 @@ def test_data_parallel_inference_multichip():
     np.testing.assert_allclose(np.stack(k1.execute(odd)),
                                np.stack(k4.execute(odd)),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_detect_shipped_weights_localize(tmp_path):
+    """E2E: ObjectDetect with the SHIPPED weights (restored by default at
+    width 8) localizes synthetic scenes through the video codec path —
+    reference object-detection app semantics (trained model by default,
+    object_detection_tensorflow/main.py:16-23)."""
+    from scanner_tpu import (CacheMode, Client, NamedStream,
+                             NamedVideoStream, PerfParams)
+    from scanner_tpu.models.detect_train import (WIDTH, box_iou,
+                                                 synth_scene_video)
+    from scanner_tpu.models.checkpoint import shipped_weights
+
+    assert shipped_weights("detect_ssd_w8.npz"), "shipped weights missing"
+    vid = str(tmp_path / "scenes.mp4")
+    truth = synth_scene_video(vid, num_frames=12, seed=21)
+    sc = Client(db_path=str(tmp_path / "db"))
+    try:
+        movie = NamedVideoStream(sc, "scenes", path=vid)
+        dets = sc.ops.ObjectDetect(frame=sc.io.Input([movie]), width=WIDTH,
+                                   score_thresh=0.3)
+        out = NamedStream(sc, "dets_out")
+        sc.run(sc.io.Output(dets, [out]), PerfParams.estimate(),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+        hits = total = 0
+        for i, det in enumerate(out.load()):
+            for gt in truth[i]:
+                total += 1
+                if any(box_iou(gt, b) >= 0.3 for b in det["boxes"]):
+                    hits += 1
+        assert total >= 12
+        assert hits >= 0.7 * total, f"recall {hits}/{total}"
+    finally:
+        sc.stop()
+
+
+def test_face_shipped_weights_localize(tmp_path):
+    """E2E: FaceDetect's shipped face-task weights localize face scenes
+    (reference face_detection app semantics)."""
+    from scanner_tpu import (CacheMode, Client, NamedStream,
+                             NamedVideoStream, PerfParams)
+    from scanner_tpu.models.detect_train import (WIDTH, box_iou,
+                                                 render_face_scene,
+                                                 synth_scene_video)
+    from scanner_tpu.models.checkpoint import shipped_weights
+
+    assert shipped_weights("face_ssd_w8.npz"), "shipped weights missing"
+    vid = str(tmp_path / "faces.mp4")
+    truth = synth_scene_video(vid, renderer=render_face_scene,
+                              num_frames=12, seed=22)
+    sc = Client(db_path=str(tmp_path / "db"))
+    try:
+        movie = NamedVideoStream(sc, "faces", path=vid)
+        dets = sc.ops.FaceDetect(frame=sc.io.Input([movie]), width=WIDTH,
+                                 score_thresh=0.3)
+        out = NamedStream(sc, "faces_out")
+        sc.run(sc.io.Output(dets, [out]), PerfParams.estimate(),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+        hits = total = 0
+        for i, det in enumerate(out.load()):
+            for gt in truth[i]:
+                total += 1
+                if any(box_iou(gt, b) >= 0.3 for b in det["boxes"]):
+                    hits += 1
+        assert total >= 12
+        assert hits >= 0.7 * total, f"recall {hits}/{total}"
+    finally:
+        sc.stop()
+
+
+def test_embedding_shipped_weights_recall():
+    """The shipped embedding separates identities: probe views match
+    gallery views of the same procedural identity (recall@1) well above
+    chance (1/8)."""
+    import jax.numpy as jnp
+
+    from scanner_tpu.graph.ops import KernelConfig
+    from scanner_tpu.common import DeviceType
+    from scanner_tpu.models.detect_train import WIDTH, render_identity
+    from scanner_tpu.models.face import FaceEmbedding
+    from scanner_tpu.models.checkpoint import shipped_weights
+
+    assert shipped_weights("embed_w8.npz"), "shipped weights missing"
+    k = FaceEmbedding(KernelConfig(device=DeviceType.CPU), width=WIDTH)
+    rng = np.random.RandomState(99)
+    idents = list(range(8))
+    gallery = np.stack([render_identity(i, rng) for i in idents])
+    probe = np.stack([render_identity(i, rng) for i in idents])
+    g = np.stack(k.execute(gallery))
+    p = np.stack(k.execute(probe))
+    sim = p @ g.T                      # cosine (embeddings normalized)
+    pred = sim.argmax(1)
+    recall = float((pred == np.arange(8)).mean())
+    assert recall >= 0.75, f"recall@1 {recall:.2f}"
