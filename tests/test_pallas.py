@@ -36,3 +36,19 @@ def test_pallas_histogram_padding_exact():
     expect = np.zeros((3, 4), np.int32)
     expect[:, 2] = 7
     np.testing.assert_array_equal(got, expect)
+
+
+def test_histogram_cmp_matches_bincount():
+    """The TPU-fast compare+sum lowering is numerically identical to the
+    bincount path (it is the default device path on TPU, PERF.md)."""
+    import numpy as np
+
+    from scanner_tpu.kernels.imgproc import (_histogram_cmp_impl,
+                                             _histogram_impl)
+    rng = np.random.default_rng(7)
+    frames = rng.integers(0, 256, size=(5, 33, 41, 3), dtype=np.uint8)
+    a = np.asarray(_histogram_impl(frames))
+    b = np.asarray(_histogram_cmp_impl(frames))
+    assert np.array_equal(a, b)
+    assert b.dtype == np.int32
+    assert b.sum() == 5 * 33 * 41 * 3
