@@ -158,26 +158,48 @@ def cluster_create_commands(cloud: CloudConfig,
     (reference kube.py get_or_create_cluster; gcloud only runs when the
     operator executes these)."""
     base = ["gcloud", "container", "--project", cloud.project]
+    hosts = tpu_hosts(cfg.worker.tpu_type)
     cmds = [
         base + ["clusters", "create", cfg.id,
                 "--zone", cloud.zone,
                 "--num-nodes", "1",
                 "--machine-type", f"n2-standard-{cfg.master_cpus}"],
-        base + ["node-pools", "create", f"{cfg.id}-tpu",
-                "--cluster", cfg.id,
-                "--zone", cloud.zone,
-                "--machine-type", cfg.worker.machine_type(),
-                "--tpu-topology", tpu_topology(cfg.worker.tpu_type),
-                "--num-nodes", str(cfg.num_workers
-                                   * tpu_hosts(cfg.worker.tpu_type))],
     ]
-    if cfg.worker.spot:
-        cmds[1].append("--spot")
-    if cfg.autoscale:
-        max_slices = cfg.max_workers or cfg.num_workers * 2
-        cmds[1] += ["--enable-autoscaling", "--min-nodes", "0",
-                    "--max-nodes",
-                    str(max_slices * tpu_hosts(cfg.worker.tpu_type))]
+
+    def pool_cmd(name: str, nodes: int) -> List[str]:
+        c = base + ["node-pools", "create", name,
+                    "--cluster", cfg.id,
+                    "--zone", cloud.zone,
+                    "--machine-type", cfg.worker.machine_type(),
+                    "--tpu-topology", tpu_topology(cfg.worker.tpu_type),
+                    "--num-nodes", str(nodes)]
+        if cfg.worker.spot:
+            c.append("--spot")
+        return c
+
+    if hosts <= 1:
+        pool = pool_cmd(f"{cfg.id}-tpu", cfg.num_workers)
+        if cfg.autoscale:
+            max_slices = cfg.max_workers or cfg.num_workers * 2
+            pool += ["--enable-autoscaling", "--min-nodes", "0",
+                     "--max-nodes", str(max_slices)]
+        cmds.append(pool)
+    else:
+        # one node pool PER SLICE: a multi-host coordinator group must be
+        # slice-coherent, and only a dedicated pool (selected via
+        # cloud.google.com/gke-nodepool) guarantees its pods land on one
+        # physical slice.  With autoscale, idle slices park at 0 nodes.
+        n_pools = (cfg.max_workers or cfg.num_workers * 2) \
+            if cfg.autoscale else cfg.num_workers
+        for i in range(n_pools):
+            # surplus autoscale pools (no StatefulSet yet) start empty:
+            # the autoscaler fills a slice pool only when its pods arrive
+            nodes = hosts if i < cfg.num_workers else 0
+            pool = pool_cmd(f"{cfg.id}-tpu-{i}", nodes)
+            if cfg.autoscale:
+                pool += ["--enable-autoscaling", "--min-nodes", "0",
+                         "--max-nodes", str(hosts)]
+            cmds.append(pool)
     return cmds
 
 
@@ -190,12 +212,38 @@ def cluster_delete_commands(cloud: CloudConfig,
 
 def cluster_resize_commands(cloud: CloudConfig, cfg: ClusterConfig,
                             num_workers: int) -> List[List[str]]:
-    return [["gcloud", "container", "--project", cloud.project,
-             "clusters", "resize", cfg.id,
-             "--node-pool", f"{cfg.id}-tpu",
-             "--num-nodes", str(num_workers
-                                * tpu_hosts(cfg.worker.tpu_type)),
-             "--zone", cloud.zone, "--quiet"]]
+    """Scale worker capacity from cfg.num_workers to num_workers.
+    Single-host: resize the shared pool.  Multi-host: slices scale by
+    creating/deleting whole per-slice pools."""
+    hosts = tpu_hosts(cfg.worker.tpu_type)
+    base = ["gcloud", "container", "--project", cloud.project]
+    if cfg.autoscale:
+        # autoscaling pools follow their pods: scaling is kubectl-only
+        # (per-slice pools were pre-created 0..hosts at cluster create,
+        # and re-creating them here would fail with already-exists)
+        return []
+    if hosts <= 1:
+        return [base + ["clusters", "resize", cfg.id,
+                        "--node-pool", f"{cfg.id}-tpu",
+                        "--num-nodes", str(num_workers),
+                        "--zone", cloud.zone, "--quiet"]]
+    cur = cfg.num_workers
+    cmds = []
+    for i in range(cur, num_workers):       # grow: add slice pools
+        c = base + ["node-pools", "create", f"{cfg.id}-tpu-{i}",
+                    "--cluster", cfg.id,
+                    "--zone", cloud.zone,
+                    "--machine-type", cfg.worker.machine_type(),
+                    "--tpu-topology", tpu_topology(cfg.worker.tpu_type),
+                    "--num-nodes", str(hosts)]
+        if cfg.worker.spot:
+            c.append("--spot")
+        cmds.append(c)
+    for i in range(num_workers, cur):       # shrink: drop slice pools
+        cmds.append(base + ["node-pools", "delete", f"{cfg.id}-tpu-{i}",
+                            "--cluster", cfg.id,
+                            "--zone", cloud.zone, "--quiet"])
+    return cmds
 
 
 # ---------------------------------------------------------------------------
@@ -245,10 +293,12 @@ def master_manifest(cfg: ClusterConfig) -> Dict:
     }
 
 
-def _worker_command(cfg: ClusterConfig, hosts: int) -> List[str]:
+def _worker_command(cfg: ClusterConfig, hosts: int,
+                    slice_idx: int = 0) -> List[str]:
     """Worker entry: single-host slices start a plain worker; multi-host
-    slices derive rank from the pod ordinal and join pod 0's
-    jax.distributed coordinator before serving."""
+    slices derive the in-slice rank directly from the pod ordinal (each
+    slice is its own StatefulSet) and join pod 0's jax.distributed
+    coordinator before serving."""
     if hosts <= 1:
         return ["python", "-c",
                 ("from scanner_tpu.engine.service import start_worker; "
@@ -256,16 +306,14 @@ def _worker_command(cfg: ClusterConfig, hosts: int) -> List[str]:
                  f"'{cfg.db_path}', "
                  f"pipeline_instances={cfg.pipeline_instances}, "
                  "block=True)")]
-    # pods ordinal o: slice index o // hosts, in-slice rank o % hosts;
-    # each slice's rank-0 pod is its jax.distributed coordinator
+    sts = f"{cfg.id}-worker-s{slice_idx}"
     return ["python", "-c", (
         "import os; "
         "from scanner_tpu.engine.service import start_worker; "
         "from scanner_tpu.parallel.distributed import CoordinatorConfig; "
-        "ordinal = int(os.environ['POD_NAME'].rsplit('-', 1)[1]); "
-        f"pid = ordinal % {hosts}; base = ordinal - pid; "
+        "pid = int(os.environ['POD_NAME'].rsplit('-', 1)[1]); "
         f"coord = CoordinatorConfig("
-        f"address=f\"{cfg.id}-worker-{{base}}.{cfg.id}-workers:8476\", "
+        f"address=\"{sts}-0.{cfg.id}-workers:8476\", "
         f"num_processes={hosts}, process_id=pid); "
         f"start_worker('{cfg.id}-master:{cfg.master_port}', "
         f"'{cfg.db_path}', "
@@ -273,29 +321,35 @@ def _worker_command(cfg: ClusterConfig, hosts: int) -> List[str]:
         "coordinator=coord, block=True)")]
 
 
-def worker_manifest(cfg: ClusterConfig) -> Dict:
-    """Workers are a StatefulSet behind a headless Service: multi-host
-    slices need stable per-pod identities for jax.distributed ranks."""
-    hosts = tpu_hosts(cfg.worker.tpu_type)
+def _worker_statefulset(cfg: ClusterConfig, name: str, replicas: int,
+                        command: List[str],
+                        extra_selector: Optional[Dict] = None) -> Dict:
     per_host_chips = tpu_chips_per_host(cfg.worker.tpu_type)
+    node_selector = {
+        "cloud.google.com/gke-tpu-accelerator":
+            tpu_accelerator_label(cfg.worker.tpu_type),
+        # GKE TPU pods must state the physical slice topology they expect
+        "cloud.google.com/gke-tpu-topology":
+            tpu_topology(cfg.worker.tpu_type),
+    }
+    node_selector.update(extra_selector or {})
     return {
         "apiVersion": "apps/v1", "kind": "StatefulSet",
-        "metadata": {"name": f"{cfg.id}-worker"},
+        "metadata": {"name": name},
         "spec": {
             "serviceName": f"{cfg.id}-workers",
-            "replicas": cfg.num_workers * hosts,
+            "replicas": replicas,
             "podManagementPolicy": "Parallel",
-            "selector": {"matchLabels": {"app": f"{cfg.id}-worker"}},
+            "selector": {"matchLabels": {"app": f"{cfg.id}-worker",
+                                         "sts": name}},
             "template": {
-                "metadata": {"labels": {"app": f"{cfg.id}-worker"}},
+                "metadata": {"labels": {"app": f"{cfg.id}-worker",
+                                        "sts": name}},
                 "spec": {
-                    "nodeSelector": {
-                        "cloud.google.com/gke-tpu-accelerator":
-                            tpu_accelerator_label(cfg.worker.tpu_type),
-                    },
+                    "nodeSelector": node_selector,
                     "containers": [{
                         "name": "worker", "image": cfg.image,
-                        "command": _worker_command(cfg, hosts),
+                        "command": command,
                         "env": [
                             {"name": "SCANNER_TPU_LOG",
                              "value": cfg.log_level},
@@ -320,6 +374,39 @@ def worker_manifest(cfg: ClusterConfig) -> Dict:
             },
         },
     }
+
+
+def worker_manifests(cfg: ClusterConfig) -> List[Dict]:
+    """Worker StatefulSets behind one headless Service.
+
+    Single-host slices: one StatefulSet, one pod per slice.  Multi-host
+    slices: one StatefulSet PER SLICE, pinned to that slice's dedicated
+    node pool (cloud.google.com/gke-nodepool) — nothing else guarantees a
+    jax.distributed coordinator group lands on one physical slice, and a
+    group split across slices hangs at initialize()."""
+    hosts = tpu_hosts(cfg.worker.tpu_type)
+    if hosts <= 1:
+        return [_worker_statefulset(cfg, f"{cfg.id}-worker",
+                                    cfg.num_workers,
+                                    _worker_command(cfg, hosts))]
+    return [
+        _worker_statefulset(
+            cfg, f"{cfg.id}-worker-s{i}", hosts,
+            _worker_command(cfg, hosts, slice_idx=i),
+            extra_selector={
+                "cloud.google.com/gke-nodepool": f"{cfg.id}-tpu-{i}"})
+        for i in range(cfg.num_workers)
+    ]
+
+
+def worker_manifest(cfg: ClusterConfig) -> Dict:
+    """Back-compat single-manifest accessor (single-host configs)."""
+    ms = worker_manifests(cfg)
+    if len(ms) != 1:
+        raise ScannerException(
+            "multi-host configs produce one StatefulSet per slice; use "
+            "worker_manifests()")
+    return ms[0]
 
 
 def service_manifest(cfg: ClusterConfig) -> Dict:
@@ -363,7 +450,7 @@ class Cluster:
         return [config_manifest(self.cfg), master_manifest(self.cfg),
                 service_manifest(self.cfg),
                 workers_service_manifest(self.cfg),
-                worker_manifest(self.cfg)]
+                *worker_manifests(self.cfg)]
 
     def manifests_json(self) -> str:
         return "\n---\n".join(json.dumps(m, indent=2)
@@ -404,16 +491,32 @@ class Cluster:
                 "kubectl not available; use manifests_json() / "
                 "*_commands() and run manually")
         hosts = tpu_hosts(self.cfg.worker.tpu_type)
-        self._run(["kubectl", "scale",
-                   f"statefulset/{self.cfg.id}-worker",
-                   f"--replicas={num_workers * hosts}"])
-        self.cfg.num_workers = num_workers
+        # pool changes are derived from old-vs-new worker counts, so
+        # compute them BEFORE mutating cfg
         resize = cluster_resize_commands(self.cloud, self.cfg, num_workers)
+        old = self.cfg.num_workers
+        if hosts <= 1:
+            self._run(["kubectl", "scale",
+                       f"statefulset/{self.cfg.id}-worker",
+                       f"--replicas={num_workers}"])
+        else:
+            # slice-granular: apply manifests for the new slice set, drop
+            # StatefulSets of removed slices
+            self.cfg.num_workers = num_workers
+            self._run(["kubectl", "apply", "-f", "-"],
+                      input_data=self.manifests_json())
+            for i in range(num_workers, old):
+                self._run(["kubectl", "delete", "statefulset",
+                           f"{self.cfg.id}-worker-s{i}", "--ignore-not-found"])
+        self.cfg.num_workers = num_workers
+        if not resize:
+            return  # autoscaling pools follow their pods
         if shutil.which("gcloud") is None:
-            # autoscaling pools grow on their own; otherwise the operator
-            # resizes the pool with the printed command
-            print("deploy: gcloud not available; resize the node pool "
-                  "manually:", " ".join(resize[0]))
+            # the operator applies the pool changes with the printed
+            # commands
+            print("deploy: gcloud not available; run manually:")
+            for cmd in resize:
+                print(" ", " ".join(cmd))
             return
         for cmd in resize:
             self._run(cmd)

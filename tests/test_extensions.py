@@ -161,9 +161,13 @@ def test_deploy_manifests():
 
 
 def test_deploy_multihost_slice():
-    """A v5litepod-8 slice spans 2 hosts: one worker pod per host with
-    stable StatefulSet identities, rank from the pod ordinal, and the
-    jax.distributed coordinator at pod 0's headless-service DNS name."""
+    """A v5litepod-8 slice spans 2 hosts: each slice is its OWN
+    StatefulSet pinned to a dedicated per-slice node pool (nodeSelector
+    gke-nodepool + gke-tpu-topology) so a jax.distributed coordinator
+    group is guaranteed slice-coherent; in-slice rank = pod ordinal,
+    coordinator at pod 0's headless-service DNS name."""
+    import ast
+
     from scanner_tpu.deploy import (CloudConfig, Cluster, ClusterConfig,
                                     MachineType, tpu_hosts)
     assert tpu_hosts("v5litepod-8") == 2
@@ -173,26 +177,21 @@ def test_deploy_multihost_slice():
     cluster = Cluster(CloudConfig(project="p"), cfg)
     by_kind = {(m["kind"], m["metadata"]["name"]): m
                for m in cluster.manifests()}
-    workers = by_kind[("StatefulSet", "sc-worker")]
-    assert workers["spec"]["replicas"] == 6       # 3 slices x 2 hosts
-    payload = workers["spec"]["template"]["spec"]["containers"][0][
-        "command"][2]
-    assert "CoordinatorConfig" in payload and "num_processes=2" in payload
-    # rank math: pod ordinal 5 -> slice 2, in-slice rank 1, coordinator
-    # at pod 4 of the headless service
-    import ast
-    ast.parse(payload)  # generated -c program must be valid python
-    rank_math = payload.split("coord = CoordinatorConfig")[0]
-    rank_math = rank_math.replace(
-        "from scanner_tpu.engine.service import start_worker; ", "")
-    rank_math = rank_math.replace(
-        "from scanner_tpu.parallel.distributed import "
-        "CoordinatorConfig; ", "")
-    ns = {"os": __import__("os")}
-    ns["os"].environ["POD_NAME"] = "sc-worker-5"
-    exec(rank_math + "addr = f\"sc-worker-{base}.sc-workers:8476\"", ns)
-    assert ns["pid"] == 1 and ns["base"] == 4
-    assert ns["addr"] == "sc-worker-4.sc-workers:8476"
+    for i in range(3):
+        workers = by_kind[("StatefulSet", f"sc-worker-s{i}")]
+        assert workers["spec"]["replicas"] == 2   # hosts per slice
+        pod = workers["spec"]["template"]["spec"]
+        # slice coherence: dedicated pool + declared physical topology
+        assert pod["nodeSelector"]["cloud.google.com/gke-nodepool"] \
+            == f"sc-tpu-{i}"
+        assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] \
+            == "2x4"
+        payload = pod["containers"][0]["command"][2]
+        ast.parse(payload)  # generated -c program must be valid python
+        assert "num_processes=2" in payload
+        assert f"sc-worker-s{i}-0.sc-workers:8476" in payload
+        # in-slice rank comes straight from the pod ordinal
+        assert "rsplit('-', 1)[1]" in payload
     # headless service for stable pod DNS
     svc = by_kind[("Service", "sc-workers")]
     assert svc["spec"]["clusterIP"] == "None"
@@ -211,15 +210,32 @@ def test_deploy_gcloud_commands():
     cluster = Cluster(CloudConfig(project="proj", zone="us-east5-a"), cfg)
     cmds = cluster.create_commands()
     assert cmds[0][:3] == ["gcloud", "container", "--project"]
-    pool = cmds[1]
-    assert "node-pools" in pool and "--spot" in pool
-    assert "--enable-autoscaling" in pool
-    # 2 slices x 2 hosts = 4 nodes
-    assert pool[pool.index("--num-nodes") + 1] == "4"
-    assert "ct5lp-hightpu-4t" in pool
-    # GKE needs the physical slice topology, and autoscale caps in NODES
-    assert pool[pool.index("--tpu-topology") + 1] == "2x4"
-    assert pool[pool.index("--max-nodes") + 1] == "8"  # 4 slices x 2 hosts
+    # multi-host + autoscale: one pool PER candidate slice (autoscale cap
+    # = 2x num_workers), each 0..hosts nodes
+    pools = cmds[1:]
+    assert len(pools) == 4
+    for i, pool in enumerate(pools):
+        assert "node-pools" in pool and "--spot" in pool
+        assert pool[pool.index("create") + 1] == f"sc-tpu-{i}"
+        assert "--enable-autoscaling" in pool
+        # active slices start full; surplus autoscale pools start empty
+        want_nodes = "2" if i < 2 else "0"
+        assert pool[pool.index("--num-nodes") + 1] == want_nodes
+        assert "ct5lp-hightpu-4t" in pool
+        # GKE needs the physical slice topology
+        assert pool[pool.index("--tpu-topology") + 1] == "2x4"
+        assert pool[pool.index("--max-nodes") + 1] == "2"
+    from scanner_tpu.deploy import cluster_resize_commands
+    # autoscale: pools pre-exist and follow their pods — no gcloud needed
+    assert cluster_resize_commands(cluster.cloud, cfg, 3) == []
+    # non-autoscale multi-host: slice-granular pool create/delete
+    cfg2 = ClusterConfig(id="sc", num_workers=2,
+                         worker=MachineType(tpu_type="v5litepod-8"))
+    grow = cluster_resize_commands(cluster.cloud, cfg2, 3)
+    assert len(grow) == 1 and "sc-tpu-2" in grow[0]
+    shrink = cluster_resize_commands(cluster.cloud, cfg2, 1)
+    assert len(shrink) == 1 and "delete" in shrink[0] \
+        and "sc-tpu-1" in shrink[0]
     dele = cluster.delete_commands()[0]
     assert "delete" in dele and "sc" in dele
     # spot pricing discounts
