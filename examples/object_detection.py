@@ -17,6 +17,7 @@ import numpy as np
 from scanner_tpu import (CacheMode, Client, NamedStream, NamedVideoStream,
                          PerfParams)
 import scanner_tpu.models  # registers ObjectDetect
+from scanner_tpu.models import unpack_detections
 from scanner_tpu.models.detect_train import (WIDTH, box_iou,
                                              synth_scene_video)
 
@@ -45,7 +46,8 @@ def main():
 
         hits = total = 0
         for i, det in enumerate(out.load()):
-            boxes, scores = det["boxes"], det["scores"]
+            d = unpack_detections(det)
+            boxes, scores = d["boxes"], d["scores"]
             if i < 5:
                 tops = ", ".join(
                     f"[{b[0]:.2f} {b[1]:.2f} {b[2]:.2f} {b[3]:.2f}]@"

@@ -19,6 +19,7 @@ from scanner_tpu import (CacheMode, Client, NamedStream, NamedVideoStream,
                          PerfParams, register_op)
 import scanner_tpu.kernels  # CropResize
 import scanner_tpu.models   # ObjectDetect, FaceEmbedding
+from scanner_tpu.models import unpack_detections
 
 
 @register_op()
@@ -26,9 +27,10 @@ def TopBox(config, det: Any) -> Any:
     """Strongest non-degenerate detection's box; the whole frame when
     nothing usable fired.  Border-clipped boxes can collapse to zero
     area — skip those, not legitimately small detections."""
-    order = np.argsort(det["scores"])[::-1]
+    d = unpack_detections(det)
+    order = np.argsort(d["scores"])[::-1]
     for i in order:
-        b = np.asarray(det["boxes"][i], np.float32)
+        b = np.asarray(d["boxes"][i], np.float32)
         if (b[2] - b[0]) * (b[3] - b[1]) > 1e-6:
             return b
     return np.asarray([0.0, 0.0, 1.0, 1.0], np.float32)

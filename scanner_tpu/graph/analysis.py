@@ -362,6 +362,14 @@ def derive_task_streams(info: GraphInfo, jr: JobRows,
             # regular op: state warmup, then stencil dilation, then clamp
             cur = set(downstream.tolist())
             if n.spec is not None and n.spec.unbounded_state:
+                # Unbounded state means EVERY task recomputes rows 0..end
+                # so tasks stay self-contained and reassignable (the
+                # reference instead pins a task's packets to one worker,
+                # save_coordinator worker.cpp:373-415).  Total work is
+                # O(stream_len^2 / io_packet): fine for the trackers such
+                # ops model on typical streams, but callers with very long
+                # streams should Slice() them (per-group state reset
+                # bounds the recompute span) or declare bounded_state.
                 cur = set(range(int(downstream[-1]) + 1)) if len(downstream) \
                     else set()
             elif ((n.spec is not None and n.spec.bounded_state is not None)

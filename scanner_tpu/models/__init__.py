@@ -1,8 +1,10 @@
 # Importing registers the model ops (PoseDetect, ObjectDetect, FaceDetect,
 # FaceEmbedding) — the analogue of the reference's scannertools model zoo.
 from . import detection, face, pose  # noqa: F401
+from .detection import unpack_detections
 from .pose import (VideoPoseNet, init_params, make_sharded_train_step,
                    make_train_step)
 
 __all__ = ["VideoPoseNet", "init_params", "make_sharded_train_step",
-           "make_train_step", "detection", "face", "pose"]
+           "make_train_step", "detection", "face", "pose",
+           "unpack_detections"]

@@ -114,10 +114,25 @@ def batched_nms(boxes, scores, top_k: int = 32, iou_thresh: float = 0.5):
     return jax.vmap(one_image)(boxes, scores)
 
 
+def unpack_detections(row) -> Dict[str, np.ndarray]:
+    """Unpack one stored ObjectDetect/FaceDetect row — a (top_k, 6) array
+    [y1, x1, y2, x2, score, valid] — into the classic
+    {"boxes": (n, 4), "scores": (n,)} dict, dropping padding rows.
+    Rows from tables written before the packed format (per-row dicts)
+    pass through unchanged, so old committed tables stay readable."""
+    if isinstance(row, dict):
+        return {"boxes": np.asarray(row["boxes"], np.float32),
+                "scores": np.asarray(row["scores"], np.float32)}
+    a = np.asarray(row, np.float32)
+    keep = a[:, 5] > 0.5
+    return {"boxes": a[keep, :4], "scores": a[keep, 4]}
+
+
 @register_op(device=DeviceType.TPU, batch=8)
 class ObjectDetect(Kernel):
-    """Per-frame object detections: list of (box[y1,x1,y2,x2], score)
-    in unit coordinates (reference TF SSD app equivalent).
+    """Per-frame object detections as packed (top_k, 6) rows
+    [y1, x1, y2, x2, score, valid] in unit coordinates — decode with
+    `unpack_detections` (reference TF SSD app equivalent).
 
     With no `checkpoint_dir`, width-8 instances restore the shipped
     synthetic-task weights (models/weights/detect_ssd_w8.npz, provenance
@@ -143,6 +158,8 @@ class ObjectDetect(Kernel):
         self.score_thresh = float(score_thresh)
         self._anchors = {}  # (fh, fw) -> anchor tensor, per resolution
 
+        thresh = self.score_thresh
+
         @jax.jit
         def infer(params, images, anchors):
             cls, deltas = self.model.apply(params, images)
@@ -152,22 +169,26 @@ class ObjectDetect(Kernel):
             idx, ssc = batched_nms(boxes, scores)
             sel = jnp.take_along_axis(boxes, jnp.maximum(idx, 0)[..., None],
                                       axis=1)
-            return sel, ssc, idx
+            valid = ((idx >= 0) & (ssc > thresh)).astype(jnp.float32)
+            # packed (B, top_k, 6) [y1,x1,y2,x2,score,valid]: fixed shape
+            # end to end so results stay on device (variable-length
+            # filtering happens at the consumer via unpack_detections)
+            return jnp.concatenate(
+                [sel, ssc[..., None], valid[..., None]], axis=-1)
 
         self._infer = infer
 
     def execute(self, frame: Sequence[FrameType]) -> Sequence[Any]:
+        """Returns a (B, top_k, 6) float32 batch — per row a (top_k, 6)
+        array [y1, x1, y2, x2, score, valid] in unit coordinates,
+        `valid`-padded (see unpack_detections).  Returned WITHOUT a host
+        sync: device arrays chain through the column store and the sink
+        fetches once per task (a per-packet fetch would serialize the
+        pipeline on d2h latency, PERF.md §1)."""
         images = jnp.asarray(frame)
         # SAME-padded stride-16 backbone -> ceil-divided feature map
         fh = -(-images.shape[1] // 16)
         fw = -(-images.shape[2] // 16)
         if (fh, fw) not in self._anchors:
             self._anchors[(fh, fw)] = jnp.asarray(make_anchors(fh, fw))
-        boxes, scores, idx = self._infer(self.params, images,
-                                         self._anchors[(fh, fw)])
-        boxes, scores, idx = map(np.asarray, (boxes, scores, idx))
-        out = []
-        for b in range(boxes.shape[0]):
-            keep = (idx[b] >= 0) & (scores[b] > self.score_thresh)
-            out.append({"boxes": boxes[b][keep], "scores": scores[b][keep]})
-        return out
+        return self._infer(self.params, images, self._anchors[(fh, fw)])

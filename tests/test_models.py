@@ -49,7 +49,11 @@ def test_object_and_face_detect_e2e(sc):
     det = sc.ops.ObjectDetect(frame=sampled)
     rows = _run(sc, det, "det_out")
     assert len(rows) == 4
-    assert "boxes" in rows[0] and rows[0]["boxes"].shape[1] == 4
+    # packed (top_k, 6) rows [y1,x1,y2,x2,score,valid]
+    from scanner_tpu.models import unpack_detections
+    d0 = unpack_detections(rows[0])
+    assert np.asarray(rows[0]).shape[1] == 6
+    assert "boxes" in d0 and d0["boxes"].shape[1:] == (4,)
 
     frame = sc.io.Input([NamedVideoStream(sc, "test1")])
     sampled = sc.streams.Range(frame, [(0, 4)])
@@ -255,10 +259,12 @@ def test_detect_shipped_weights_localize(tmp_path):
         sc.run(sc.io.Output(dets, [out]), PerfParams.estimate(),
                cache_mode=CacheMode.Overwrite, show_progress=False)
         hits = total = 0
+        from scanner_tpu.models import unpack_detections
         for i, det in enumerate(out.load()):
+            boxes = unpack_detections(det)["boxes"]
             for gt in truth[i]:
                 total += 1
-                if any(box_iou(gt, b) >= 0.3 for b in det["boxes"]):
+                if any(box_iou(gt, b) >= 0.3 for b in boxes):
                     hits += 1
         assert total >= 12
         assert hits >= 0.7 * total, f"recall {hits}/{total}"
@@ -289,10 +295,12 @@ def test_face_shipped_weights_localize(tmp_path):
         sc.run(sc.io.Output(dets, [out]), PerfParams.estimate(),
                cache_mode=CacheMode.Overwrite, show_progress=False)
         hits = total = 0
+        from scanner_tpu.models import unpack_detections
         for i, det in enumerate(out.load()):
+            boxes = unpack_detections(det)["boxes"]
             for gt in truth[i]:
                 total += 1
-                if any(box_iou(gt, b) >= 0.3 for b in det["boxes"]):
+                if any(box_iou(gt, b) >= 0.3 for b in boxes):
                     hits += 1
         assert total >= 12
         assert hits >= 0.7 * total, f"recall {hits}/{total}"
