@@ -417,6 +417,14 @@ SCVID_API ScvidEncoder* scvid_encoder_create(int32_t width, int32_t height,
     av_opt_set(ctx->priv_data, "preset", "veryfast", 0);
     if (bitrate <= 0)
       av_opt_set_int(ctx->priv_data, "crf", crf > 0 ? crf : 20, 0);
+    if (bframes > 0) {
+      // fixed B pattern (b-adapt=0, no scenecut): the knob exists to
+      // produce reordered (pts != dts) streams deterministically;
+      // x264's adaptive strategy / scenecut would silently emit
+      // all-I/P for simple content
+      av_opt_set(ctx->priv_data, "x264-params", "b-adapt=0:scenecut=0",
+                 0);
+    }
   }
   int err = avcodec_open2(ctx, codec, nullptr);
   if (err < 0) {

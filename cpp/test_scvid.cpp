@@ -28,11 +28,23 @@
 static const int W = 64, H = 48, N = 40, KEYINT = 8;
 
 static void fill_frame(uint8_t* rgb, int i) {
-  for (int p = 0; p < W * H; ++p) {
-    rgb[3 * p + 0] = (uint8_t)((i * 16) % 224);
-    rgb[3 * p + 1] = (uint8_t)(p % 240);
-    rgb[3 * p + 2] = 0;
+  // R encodes the frame id; G is a SMOOTH horizontal ramp (a per-pixel
+  // sawtooth would put high-frequency energy into chroma, and 4:2:0
+  // subsampling then bleeds it into decoded R, breaking frame_id)
+  for (int y = 0; y < H; ++y) {
+    for (int x = 0; x < W; ++x) {
+      int p = y * W + x;
+      rgb[3 * p + 0] = (uint8_t)((i * 16) % 224);
+      rgb[3 * p + 1] = (uint8_t)((x * 239) / (W - 1));
+      rgb[3 * p + 2] = 0;
+    }
   }
+  // moving bright square: per-frame motion like the Python fixture's
+  // (B-frame emission itself is guaranteed by b-adapt=0 in the encoder
+  // when bframes>0 — the motion just keeps the clip non-degenerate)
+  int sq = 8, sx = (i * 5) % (W - sq);
+  for (int y = 0; y < sq; ++y)
+    for (int x = sx; x < sx + sq; ++x) rgb[3 * (y * W + x) + 2] = 230;
 }
 
 static int frame_id(const uint8_t* rgb) {
@@ -130,6 +142,80 @@ int main() {
   scvid_index_free(idx);
   remove(mp4);
   remove(pkts);
+
+  // --- B-frame stream: encode -> mux -> ingest -> full decode -----------
+  // bframes>0 produces a reordered (pts != dts) stream; the decoder must
+  // still emit display-ordered frames with correct content.
+  {
+    const char* bmp4 = "/tmp/scvid_test_b.mp4";
+    const char* bpkts = "/tmp/scvid_test_b.pkts";
+    ScvidEncoder* benc = scvid_encoder_create(W, H, 24, 1, "libx264", 0,
+                                              18, KEYINT, 2);
+    CHECK(benc != nullptr, "bframe encoder create");
+    for (int i = 0; i < N; ++i) {
+      fill_frame(frame.data(), i);
+      CHECK(scvid_encoder_feed(benc, frame.data(), 1) == 0,
+            "bframe encoder feed");
+    }
+    CHECK(scvid_encoder_flush(benc) == 0, "bframe encoder flush");
+    int64_t bn = scvid_encoder_pending(benc);
+    CHECK(bn == N, "bframe one packet per frame");
+    std::vector<uint8_t> bdata(scvid_encoder_pending_bytes(benc));
+    std::vector<uint64_t> bsizes(bn);
+    std::vector<uint8_t> bkeys(bn);
+    std::vector<int64_t> bpts(bn), bdts(bn);
+    scvid_encoder_take(benc, bdata.data(), bsizes.data(), bkeys.data(),
+                       bpts.data(), bdts.data());
+    bool reordered = false;
+    for (int i = 1; i < N; ++i)
+      if (bpts[i] < bpts[i - 1]) reordered = true;
+    CHECK(reordered, "bframe stream actually reorders (pts != dts)");
+    int64_t bx = scvid_encoder_extradata(benc, nullptr, 0);
+    std::vector<uint8_t> bextra(bx);
+    scvid_encoder_extradata(benc, bextra.data(), bx);
+    CHECK(scvid_mp4_write(bmp4, W, H, 24, 1, 1, 24, "h264", bextra.data(),
+                          bx, bdata.data(), bsizes.data(), bkeys.data(),
+                          bpts.data(), bdts.data(), bn) == 0,
+          "bframe mp4 write");
+    scvid_encoder_destroy(benc);
+
+    ScvidIndex* bidx = scvid_ingest(bmp4, bpkts);
+    CHECK(bidx != nullptr, "bframe ingest");
+    CHECK(bidx->num_samples == N, "bframe sample count");
+    ScvidDecoder* bdec = scvid_decoder_create("h264", bidx->extradata,
+                                              bidx->extradata_size, W, H,
+                                              1);
+    FILE* bf = fopen(bpkts, "rb");
+    CHECK(bf != nullptr, "bframe packet file open");
+    long total = (long)(bidx->sample_offsets[N - 1] +
+                        bidx->sample_sizes[N - 1]);
+    std::vector<uint8_t> ball(total);
+    CHECK(fread(ball.data(), 1, ball.size(), bf) == ball.size(),
+          "bframe packet read");
+    fclose(bf);
+    std::vector<uint64_t> ball_sizes(bidx->sample_sizes,
+                                     bidx->sample_sizes + N);
+    std::vector<uint8_t> ball_wanted(N, 1);
+    std::vector<uint8_t> bout((size_t)N * W * H * 3);
+    int64_t bdims[2] = {0, 0};
+    int64_t bgot = scvid_decode_run(bdec, ball.data(), ball_sizes.data(),
+                                    N, ball_wanted.data(), N, 1,
+                                    bout.data(), (int64_t)bout.size(),
+                                    bdims);
+    CHECK(bgot == N, "bframe full decode emits every frame");
+    bool ids_ok = true;
+    for (int i = 0; i < N; ++i)
+      if (frame_id(bout.data() + (size_t)i * W * H * 3) !=
+          (i * 16 % 224 + 8) / 16 % 14)
+        ids_ok = false;
+    CHECK(ids_ok, "bframe frames emitted in display order with correct "
+                  "content");
+    scvid_decoder_destroy(bdec);
+    scvid_index_free(bidx);
+    remove(bmp4);
+    remove(bpkts);
+  }
+
   printf("all native checks passed\n");
   return 0;
 }
