@@ -185,6 +185,7 @@ int main() {
     ScvidDecoder* bdec = scvid_decoder_create("h264", bidx->extradata,
                                               bidx->extradata_size, W, H,
                                               1);
+    CHECK(bdec != nullptr, "bframe decoder create");
     FILE* bf = fopen(bpkts, "rb");
     CHECK(bf != nullptr, "bframe packet file open");
     long total = (long)(bidx->sample_offsets[N - 1] +

@@ -582,3 +582,68 @@ def test_scheduler_dispatch_throughput(tmp_path):
               f"({total} tasks, {dt:.2f}s)")
     finally:
         master.stop()
+
+
+def test_scheduler_concurrent_dispatch_stress(tmp_path):
+    """Many worker threads hammer the master's RPC handlers concurrently
+    (the real server dispatches from a thread pool): every task completes
+    exactly once, counters balance, no deadlock."""
+    import threading
+
+    from scanner_tpu.engine.service import Master, _BulkJob
+
+    master = Master(db_path=str(tmp_path / "db"), no_workers_timeout=60.0)
+    try:
+        n_jobs, tasks_per_job = 200, 25
+        bulk = _BulkJob(bulk_id=0, spec_blob=b"", task_timeout=0.0)
+        for j in range(n_jobs):
+            tasks = {(j, t) for t in range(tasks_per_job)}
+            bulk.job_tasks[j] = tasks
+            bulk.job_sink_names[j] = []
+            bulk.job_custom_sinks[j] = []
+            bulk.job_output_rows[j] = 0
+            bulk.queue.extend(sorted(tasks))
+            bulk.total_tasks += len(tasks)
+        with master._lock:
+            master._bulk = bulk
+            master._history[0] = bulk
+
+        completed = []
+        lock = threading.Lock()
+
+        def worker_thread():
+            wid = master._rpc_register_worker({"address": "x"})["worker_id"]
+            done_here = 0
+            while True:
+                r = master._rpc_next_work(
+                    {"worker_id": wid, "bulk_id": 0, "window": 4})
+                if r["status"] in ("done", "none"):
+                    # "none" = bulk finished (a sibling completed the
+                    # last task); real workers exit via the same signal
+                    break
+                if r["status"] != "task":
+                    time.sleep(0.0005)
+                    continue
+                base = {"worker_id": wid, "bulk_id": 0,
+                        "job_idx": r["job_idx"], "task_idx": r["task_idx"],
+                        "attempt": r["attempt"]}
+                assert master._rpc_started_work(dict(base))["ok"]
+                assert master._rpc_eval_done(dict(base))["ok"]
+                assert master._rpc_finished_work(dict(base))["ok"]
+                done_here += 1
+            with lock:
+                completed.append(done_here)
+
+        threads = [threading.Thread(target=worker_thread)
+                   for _ in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "dispatch deadlocked"
+        assert sum(completed) == n_jobs * tasks_per_job
+        assert bulk.finished
+        assert len(bulk.done) == bulk.total_tasks
+        assert not bulk.outstanding and not bulk.held
+    finally:
+        master.stop()

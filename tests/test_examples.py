@@ -24,6 +24,7 @@ EXAMPLES = [
     "06_compression.py",
     "07_profiling.py",
     "08_distributed.py",
+    "09_native_ops.py",
     "pose_detection.py",
     "reid_features.py",
     "shot_detection.py",
