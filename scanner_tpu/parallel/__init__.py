@@ -1,13 +1,14 @@
 from .mesh import AXIS_ORDER, auto_axes, make_mesh, shard_batch, sharding
 from .halo import sharded_stencil_map, temporal_diff
 from .ring_attention import make_ring_attention, reference_attention
+from .ulysses import make_ulysses_attention
 from .distributed import (CoordinatorConfig, host_local_array,
                           initialize, is_initialized, replicate_to_global)
 
 __all__ = [
     "AXIS_ORDER", "auto_axes", "make_mesh", "shard_batch", "sharding",
     "sharded_stencil_map", "temporal_diff", "make_ring_attention",
-    "reference_attention",
+    "make_ulysses_attention", "reference_attention",
     "CoordinatorConfig", "host_local_array", "initialize",
     "is_initialized", "replicate_to_global",
 ]
