@@ -462,18 +462,50 @@ class Master:
         self.db.backend.write(md.bulk_checkpoint_path(),
                               cloudpickle.dumps(state))
 
+    @staticmethod
+    def _encode_task_set(tasks) -> Dict[int, List[int]]:
+        """{job: [s0, e0, s1, e1, ...]} half-open runs — tasks complete
+        mostly in order, so a million-task done-set encodes in a few
+        runs per job instead of 10^6 tuples per checkpoint write."""
+        by_job: Dict[int, List[int]] = {}
+        for j, t in tasks:
+            by_job.setdefault(j, []).append(t)
+        out: Dict[int, List[int]] = {}
+        for j, ts in by_job.items():
+            ts.sort()
+            runs: List[int] = []
+            s = p = ts[0]
+            for t in ts[1:]:
+                if t == p + 1:
+                    p = t
+                    continue
+                runs += [s, p + 1]
+                s = p = t
+            runs += [s, p + 1]
+            out[j] = runs
+        return out
+
+    @staticmethod
+    def _decode_task_set(enc: Dict[int, List[int]]) -> Set[Tuple[int, int]]:
+        return {(j, t) for j, runs in enc.items()
+                for i in range(0, len(runs), 2)
+                for t in range(runs[i], runs[i + 1])}
+
     def _persist_bulk_progress(self, bulk: _BulkJob) -> None:
         """Snapshot completion state (under the lock) and write it (storage
         I/O must not stall heartbeats, so callers invoke this outside)."""
         with self._lock:
+            # C-speed snapshot only; the Python-level run-length encode
+            # happens outside so heartbeats/NextWork never wait on it
+            done = set(bulk.done)
             prog = {
                 "bulk_id": bulk.bulk_id,
-                "done": sorted(bulk.done),
                 "failures": dict(bulk.failures),
                 "blacklisted_jobs": sorted(bulk.blacklisted_jobs),
                 "committed_jobs": sorted(bulk.committed_jobs),
                 "error": bulk.error,
             }
+        prog["done_runs"] = self._encode_task_set(done)
         self.db.backend.write(md.bulk_progress_path(),
                               cloudpickle.dumps(prog))
 
@@ -525,22 +557,35 @@ class Master:
             bulk.job_custom_sinks[j] = list(job.custom_sinks.values())
             bulk.job_output_rows[j] = state["job_output_rows"][j]
             bulk.total_tasks += n
-        if self.db.backend.exists(md.bulk_progress_path()):
-            prog = cloudpickle.loads(
-                self.db.backend.read(md.bulk_progress_path()))
-            if prog.get("bulk_id") == bulk.bulk_id:
-                bulk.done = {tuple(k) for k in prog["done"]}
-                bulk.failures = {tuple(k): v
-                                 for k, v in prog["failures"].items()}
-                bulk.blacklisted_jobs = set(prog["blacklisted_jobs"])
-                bulk.committed_jobs = set(prog["committed_jobs"])
-                bulk.error = prog.get("error", "")
-                for j in bulk.blacklisted_jobs:
-                    bulk.blacklisted_task_total += len(
-                        bulk.job_tasks.get(j, ()))
-                    bulk.done_in_blacklisted += sum(
-                        1 for k in bulk.job_tasks.get(j, ())
-                        if k in bulk.done)
+        try:
+            if self.db.backend.exists(md.bulk_progress_path()):
+                prog = cloudpickle.loads(
+                    self.db.backend.read(md.bulk_progress_path()))
+                if prog.get("bulk_id") == bulk.bulk_id:
+                    if "done_runs" in prog:
+                        bulk.done = self._decode_task_set(
+                            prog["done_runs"])
+                    else:  # earlier format stored explicit tuples
+                        bulk.done = {tuple(k)
+                                     for k in prog.get("done", ())}
+                    bulk.failures = {tuple(k): v
+                                     for k, v in prog["failures"].items()}
+                    bulk.blacklisted_jobs = set(prog["blacklisted_jobs"])
+                    bulk.committed_jobs = set(prog["committed_jobs"])
+                    bulk.error = prog.get("error", "")
+                    for j in bulk.blacklisted_jobs:
+                        bulk.blacklisted_task_total += len(
+                            bulk.job_tasks.get(j, ()))
+                        bulk.done_in_blacklisted += sum(
+                            1 for k in bulk.job_tasks.get(j, ())
+                            if k in bulk.done)
+        except Exception:  # noqa: BLE001
+            # a corrupt progress file costs completed-task state, not the
+            # bulk: resume from zero done rather than brick the master
+            _mlog.exception("bulk progress unreadable; resuming from "
+                            "admission state")
+            bulk.done = set()
+            bulk.failures = {}
         bulk.queue.extend(sorted(
             k for j, ts in bulk.job_tasks.items()
             if j not in bulk.blacklisted_jobs

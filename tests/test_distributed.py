@@ -470,7 +470,7 @@ def test_master_restart_recovers_bulk(tmp_path):
             try:
                 with open(prog_path, "rb") as f:
                     prog = cloudpickle.loads(f.read())
-                if len(prog["done"]) >= 3:
+                if len(Master._decode_task_set(prog["done_runs"])) >= 3:
                     break
             except Exception:
                 pass
@@ -478,8 +478,8 @@ def test_master_restart_recovers_bulk(tmp_path):
         m1.kill()
         m1.wait()
         with open(prog_path, "rb") as f:
-            state["done_at_kill"] = {
-                tuple(k) for k in cloudpickle.loads(f.read())["done"]}
+            state["done_at_kill"] = Master._decode_task_set(
+                cloudpickle.loads(f.read())["done_runs"])
         state["rows_at_kill"] = open(log).read().splitlines()
         time.sleep(1.0)
         state["m2"] = spawn_master()
@@ -647,3 +647,21 @@ def test_scheduler_concurrent_dispatch_stress(tmp_path):
         assert not bulk.outstanding and not bulk.held
     finally:
         master.stop()
+
+
+def test_progress_task_set_codec():
+    """Run-length task-set codec round-trips arbitrary done-sets (the
+    progress checkpoint stores intervals, not 10^6 tuples)."""
+    import random
+
+    rng = random.Random(3)
+    for _ in range(20):
+        tasks = {(rng.randrange(5), rng.randrange(50))
+                 for _ in range(rng.randrange(0, 120))}
+        enc = Master._encode_task_set(tasks)
+        assert Master._decode_task_set(enc) == tasks
+    # contiguous million-task job encodes tiny
+    big = {(0, t) for t in range(100000)}
+    enc = Master._encode_task_set(big)
+    assert enc == {0: [0, 100000]}
+    assert Master._decode_task_set({}) == set()
