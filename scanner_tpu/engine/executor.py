@@ -26,8 +26,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..common import (CacheMode, JobException, NullElement, PerfParams,
-                      ScannerException)
+from ..common import (CacheMode, DeviceType, JobException, NullElement,
+                      PerfParams, ScannerException)
 from ..graph import analysis as A
 from ..graph import ops as O
 from ..storage import Database
@@ -94,6 +94,11 @@ class LocalExecutor:
         # libav threads per decoder handle (frame threading); total decode
         # parallelism = num_load_workers x decoder_threads
         self.decoder_threads = decoder_threads
+        # per-graph memo for _column_device_bound (keyed by GraphInfo
+        # identity; cleared when a different graph runs).  Locked: loader
+        # threads share it and a concurrent clear() mid-read would KeyError
+        self._device_bound_cache: Dict[Any, Any] = {}
+        self._device_bound_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     # Job-set preparation (reference master.cpp:1367 process_job admission)
@@ -540,7 +545,59 @@ class LocalExecutor:
                 info, w.job.jr, w.output_range,
                 job_idx=w.job.job_idx, task_idx=w.task_idx)
             w.elements = self._load_sources(w, tls)
+            self._prestage_device_columns(info, w)
         return w
+
+    def _prestage_device_columns(self, info: A.GraphInfo,
+                                 w: TaskItem) -> None:
+        """Start the host->device transfer of device-bound source columns
+        from the LOADER thread.  device_put is async: the copy proceeds
+        while this loader decodes the next task and while the evaluator
+        computes earlier tasks, so h2d overlaps decode instead of
+        serializing at the front of the evaluate stage (PERF.md §3: h2d is
+        a first-order term over the tunnel).  Only columns whose every
+        first non-builtin consumer is a device kernel are staged — staging
+        a host-kernel input would add a device->host round-trip."""
+        from .evaluate import _accel_backend
+        if not _accel_backend():
+            return
+        for nid, b in w.elements.items():
+            if self._column_device_bound(info, nid) \
+                    and isinstance(b.data, np.ndarray) \
+                    and b.data.dtype != object:
+                w.elements[nid] = b.to_device()
+
+    def _column_device_bound(self, info: A.GraphInfo, node_id: int) -> bool:
+        with self._device_bound_lock:
+            cache = self._device_bound_cache
+            if cache.get("info") is not info:
+                cache.clear()
+                cache["info"] = info
+            if node_id in cache:
+                return cache[node_id]
+        by_id = {n.id: n for n in info.ops}
+        devices: List[bool] = []
+        seen = set()
+        frontier = [node_id]
+        while frontier:
+            nid = frontier.pop()
+            if nid in seen:
+                continue
+            seen.add(nid)
+            for cid in info.consumers.get(nid, []):
+                c = by_id[cid]
+                if c.name == O.OUTPUT_OP:
+                    devices.append(False)  # sink fetches to host
+                elif c.is_builtin:
+                    frontier.append(cid)   # gathers run wherever data is
+                else:
+                    devices.append(
+                        c.effective_device() == DeviceType.TPU)
+        res = bool(devices) and all(devices)
+        with self._device_bound_lock:
+            if self._device_bound_cache.get("info") is info:
+                self._device_bound_cache[node_id] = res
+        return res
 
     def _load_sources(self, w: TaskItem, tls) -> Dict[int, ColumnBatch]:
         """Read/decode exactly the rows the task needs.  Video sources

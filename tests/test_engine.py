@@ -794,3 +794,119 @@ def test_pipeline_concurrency_stress(tmp_path):
         assert all(abs(a - b) < 6.0 for a, b in zip(expect, want))
     finally:
         client.stop()
+
+
+def test_prestage_device_bound_analysis():
+    """The loader pre-stages a source column host->device only when every
+    first non-builtin consumer is a device kernel (executor.py
+    _column_device_bound): staging a host-kernel input would force a
+    device->host round-trip instead of saving one."""
+    from scanner_tpu.engine.executor import LocalExecutor
+    from scanner_tpu.graph import analysis as A
+    from scanner_tpu.graph import ops as O
+    from scanner_tpu.graph.streams_dsl import IOGenerator, StreamsGenerator
+
+    @register_op(name="_DevK", device=DeviceType.TPU, batch=4)
+    class _DevK(Kernel):
+        def execute(self, frame: FrameType) -> Any:  # pragma: no cover
+            return frame
+
+    @register_op(name="_HostK")
+    class _HostK(Kernel):
+        def execute(self, frame: FrameType) -> Any:  # pragma: no cover
+            return frame
+
+    io = IOGenerator()
+    streams = StreamsGenerator()
+    ops = O.OpGenerator()
+
+    class FakeStream:
+        is_video = False
+
+        def __init__(self, n):
+            self.n = n
+
+    import threading
+    ex = LocalExecutor.__new__(LocalExecutor)
+    ex._device_bound_cache = {}
+    ex._device_bound_lock = threading.Lock()
+
+    def input_id(info):
+        return next(n.id for n in info.ops if n.name == O.INPUT_OP)
+
+    # device kernel behind a builtin sampler: stage
+    frames = io.Input([FakeStream(16)])
+    ranged = streams.Range(frames, [(0, 8)])
+    info = A.analyze([io.Output(ops._DevK(frame=ranged), [FakeStream(8)])])
+    assert ex._column_device_bound(info, input_id(info)) is True
+
+    # host kernel: don't stage
+    ex._device_bound_cache = {}
+    frames = io.Input([FakeStream(16)])
+    info = A.analyze([io.Output(ops._HostK(frame=frames), [FakeStream(16)])])
+    assert ex._column_device_bound(info, input_id(info)) is False
+
+    # mixed consumers (device + host see the same column): don't stage
+    ex._device_bound_cache = {}
+    frames = io.Input([FakeStream(16)])
+    d = ops._DevK(frame=frames)
+    h = ops._HostK(frame=frames)
+    info = A.analyze([io.Output(d, [FakeStream(16)]),
+                      io.Output(h, [FakeStream(16)])])
+    assert ex._column_device_bound(info, input_id(info)) is False
+
+
+def test_prestage_pipeline_e2e(tmp_path, monkeypatch):
+    """Run the pipeline with device staging active (accel check faked on
+    the CPU backend): LOADERS pre-stage source columns as jax arrays (the
+    evaluator would also stage lazily, so the loader-side staging is
+    spied on directly), the evaluator chains them, results match the
+    host path."""
+    from scanner_tpu.engine import evaluate as EV
+    from scanner_tpu.engine.executor import LocalExecutor
+    monkeypatch.setattr(EV, "_BACKEND", "fake_accel")
+
+    # spy: count tasks whose source column left the loader already staged
+    staged_tasks = []
+    orig_prestage = LocalExecutor._prestage_device_columns
+
+    def spy_prestage(self, info, w):
+        orig_prestage(self, info, w)
+        from scanner_tpu.engine.batch import _is_jax
+        if all(_is_jax(b.data) for b in w.elements.values()):
+            staged_tasks.append(w.task_idx)
+    monkeypatch.setattr(LocalExecutor, "_prestage_device_columns",
+                        spy_prestage)
+
+    @register_op(name="_DevMean", device=DeviceType.TPU, batch=8)
+    class _DevMean(Kernel):
+        def execute(self, frame: Sequence[FrameType]) -> Sequence[Any]:
+            import jax.numpy as jnp
+            assert not isinstance(frame, np.ndarray)  # staged on device
+            return jnp.mean(jnp.asarray(frame, jnp.float32), axis=(1, 2, 3))
+
+    vid = str(tmp_path / "v.mp4")
+    scv.synthesize_video(vid, num_frames=24, width=64, height=48, fps=24,
+                         keyint=8)
+    client = Client(db_path=str(tmp_path / "db"), num_load_workers=2)
+    try:
+        client.ingest_videos([("v", vid)])
+        frames = client.io.Input([NamedVideoStream(client, "v")])
+        out = NamedStream(client, "m")
+        client.run(client.io.Output(client.ops._DevMean(frame=frames),
+                                    [out]),
+                   PerfParams.manual(8, 16),
+                   cache_mode=CacheMode.Overwrite, show_progress=False)
+        rows = list(out.load())
+        assert len(rows) == 24
+        from scanner_tpu.video.ingest import frame_pattern
+        want = [float(frame_pattern(i, 48, 64).astype(np.float32).mean())
+                for i in range(24)]
+        got = [float(r) for r in rows]
+        # H.264 is lossy: compare means with a tolerance
+        assert all(abs(a - b) < 4.0 for a, b in zip(got, want))
+        # every task (24 rows / 16-row io packets = 2) left the loader
+        # with its source column already on device
+        assert len(staged_tasks) == 2, staged_tasks
+    finally:
+        client.stop()
