@@ -20,7 +20,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
-NEG_INF = -1e30
+# single source of truth: the pallas kernel's masked-row guards compare
+# the m carry this module initializes against the same sentinel
+from ..kernels.pallas_attention import HAVE_PALLAS, NEG_INF
 
 
 def _flash_block_k(tl: int, block_k: Optional[int]) -> int:
@@ -106,18 +108,114 @@ def _ring_attention_block(q, k, v, axis_name: str, causal: bool,
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # (B,Tl,H,D)
 
 
+def _ring_attention_block_pallas(q, k, v, axis_name: str, causal: bool,
+                                 scale: Optional[float],
+                                 block_q: Optional[int] = None,
+                                 block_k: Optional[int] = None,
+                                 interpret: bool = False):
+    """Pallas variant of the local ring step: each arriving K/V block is
+    consumed by ONE fused flash kernel (kernels/pallas_attention.py) —
+    logits stay in VMEM, the online-softmax update fuses with both MXU
+    matmuls.  Exactness is identical to the XLA path."""
+    from ..kernels.pallas_attention import flash_block_update
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, Tl, H, D = q.shape
+    s = scale if scale is not None else (D ** -0.5)
+    # (B, Tl, H, D) -> (B*H, Tl, D): per-head rows for the kernel grid
+    qf = jnp.transpose(q.astype(jnp.float32) * s, (0, 2, 1, 3)) \
+        .reshape(B * H, Tl, D)
+
+    vary = lambda x: jax.lax.pcast(x, (axis_name,), to="varying")
+    m0 = vary(jnp.full((B * H, Tl), NEG_INF, jnp.float32))
+    l0 = vary(jnp.zeros((B * H, Tl), jnp.float32))
+    acc0 = vary(jnp.zeros((B * H, Tl, D), jnp.float32))
+    q_off = idx * Tl
+    bq = block_q or 256
+    bk = block_k or 256
+
+    # the ring is unrolled (n is a static mesh size): each iteration is
+    # one pallas call + one ppermute, and unrolling sidesteps a jax
+    # lowering-cache bug with interpret-mode pallas inside fori_loop
+    m, l, acc, kb, vb = m0, l0, acc0, k, v
+    perm = [(j, (j - 1) % n) for j in range(n)]
+    for i in range(n):
+        src = (idx + i) % n
+        kb_next = jax.lax.ppermute(kb, axis_name, perm) if i < n - 1 \
+            else kb
+        vb_next = jax.lax.ppermute(vb, axis_name, perm) if i < n - 1 \
+            else vb
+        kf = jnp.transpose(kb, (0, 2, 1, 3)).reshape(B * H, Tl, D)
+        vf = jnp.transpose(vb, (0, 2, 1, 3)).reshape(B * H, Tl, D)
+        m, l, acc = flash_block_update(
+            qf, kf, vf, m, l, acc, q_off, src * Tl, causal=causal,
+            block_q=bq, block_k=bk, interpret=interpret,
+            vma=(axis_name,))
+        kb, vb = kb_next, vb_next
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    out = out.reshape(B, H, Tl, D)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # (B,Tl,H,D)
+
+
 def make_ring_attention(mesh: Mesh, axis: str = "sp", causal: bool = False,
                         scale: Optional[float] = None,
-                        block_k: Optional[int] = None):
+                        block_k: Optional[int] = None,
+                        impl: str = "xla",
+                        block_q: Optional[int] = None,
+                        interpret: Optional[bool] = None):
     """Returns attn(q, k, v) over arrays (B, T, H, D) with T sharded on
     `axis` (batch replicated or dp-sharded orthogonally).  `block_k`
     bounds the flash tile width (default 512, clipped to the local
-    block)."""
+    block).
+
+    impl="pallas" runs each ring step through the fused pallas flash
+    kernel (forward only — the backward pass recomputes through the XLA
+    path via custom_vjp, so gradients work identically); its tiles
+    default to 256x256 (`block_q`/`block_k`), clipped to divisors of the
+    local block.  `interpret` defaults to auto: native on TPU,
+    interpreter elsewhere (tests)."""
     fn = functools.partial(_ring_attention_block, axis_name=axis,
                            causal=causal, scale=scale, block_k=block_k)
-    return shard_map(fn, mesh=mesh,
-                     in_specs=(P(None, axis), P(None, axis), P(None, axis)),
-                     out_specs=P(None, axis))
+    specs = dict(in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+                 out_specs=P(None, axis))
+    xla_sm = shard_map(fn, mesh=mesh, **specs)
+    if impl == "xla":
+        return xla_sm
+    if impl != "pallas":
+        raise ValueError(f"impl must be 'xla' or 'pallas', got {impl!r}")
+    if not HAVE_PALLAS:
+        raise RuntimeError(
+            "impl='pallas' requires jax.experimental.pallas, which this "
+            "jax build lacks; use impl='xla'")
+    for name, b in (("block_q", block_q), ("block_k", block_k)):
+        if b is not None and b < 1:
+            raise ValueError(f"{name} must be >= 1, got {b}")
+    if interpret is None:
+        try:
+            interpret = jax.devices()[0].platform != "tpu"
+        except Exception:  # pragma: no cover
+            interpret = True
+    pfn = functools.partial(_ring_attention_block_pallas, axis_name=axis,
+                            causal=causal, scale=scale, block_q=block_q,
+                            block_k=block_k, interpret=interpret)
+    # check_vma=False: the pallas interpreter's internal dynamic_slices
+    # don't propagate varying-axis types (jax asks for exactly this
+    # workaround in its error); the XLA path keeps full vma checking
+    pal_sm = shard_map(pfn, mesh=mesh, check_vma=False, **specs)
+
+    @jax.custom_vjp
+    def attn(q, k, v):
+        return pal_sm(q, k, v)
+
+    def fwd(q, k, v):
+        return pal_sm(q, k, v), (q, k, v)
+
+    def bwd(res, g):
+        _, vjp = jax.vjp(xla_sm, *res)
+        return vjp(g)
+
+    attn.defvjp(fwd, bwd)
+    return attn
 
 
 def reference_attention(q, k, v, causal: bool = False,

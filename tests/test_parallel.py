@@ -184,3 +184,38 @@ def test_pose_net_with_ulysses_attention():
     out = jax.jit(model.apply)(params, clip)
     assert out.shape == (2, 4, 8, 8, 17)
     assert np.isfinite(np.asarray(out)).all()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_pallas_matches_reference(causal):
+    """impl='pallas' runs each ring step through the fused flash kernel
+    (interpret mode off-TPU); results match the exact reference, incl.
+    causal masks crossing ring-block and flash-tile boundaries."""
+    mesh = make_mesh({"sp": 4, "dp": 1, "tp": 1})
+    rng = np.random.RandomState(7)
+    B, T, H, D = 2, 32, 2, 16   # Tl = 8; block_q/k of 4 -> 2x2 tiles/step
+    q = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+    ring = make_ring_attention(mesh, axis="sp", causal=causal,
+                               impl="pallas", block_q=4, block_k=4)
+    got = np.asarray(ring(q, k, v))
+    ref = np.asarray(reference_attention(q, k, v, causal=causal))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_pallas_grad_matches_xla():
+    """The pallas forward carries an XLA-path custom_vjp: gradients are
+    available and identical to the XLA ring (which matches reference)."""
+    mesh = make_mesh({"sp": 2, "dp": 1, "tp": 1})
+    rng = np.random.RandomState(8)
+    B, T, H, D = 1, 16, 1, 8
+    q = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+    pal = make_ring_attention(mesh, axis="sp", impl="pallas")
+    xla = make_ring_attention(mesh, axis="sp")
+    gp = jax.grad(lambda q: pal(q, k, v).sum())(q)
+    gx = jax.grad(lambda q: xla(q, k, v).sum())(q)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gx), rtol=1e-5,
+                               atol=1e-6)
