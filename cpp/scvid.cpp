@@ -371,6 +371,107 @@ SCVID_API int64_t scvid_decode_run(ScvidDecoder* d, const uint8_t* packets,
 
 SCVID_API int64_t scvid_decoder_emitted(ScvidDecoder* d) { return d->emitted; }
 
+// Pts-matched variant of scvid_decode_run: packets carry their container
+// pts, and frames are selected by timestamp membership instead of emission
+// position.  This stays exact on streams where positional masks break:
+//   - open-GOP seeks, where the decoder may emit (or drop) leading frames
+//     whose references precede the seek keyframe;
+//   - VFR streams, where display position is defined by pts order alone.
+//
+//   pkt_pts     : pts per packet, n_packets entries (decode order)
+//   wanted_pts  : sorted ascending, unique; frames are emitted in pts order
+//                 so a single forward cursor matches them
+//   deliv       : uint8 per wanted entry, set to 1 when that pts is written
+//
+// Output frames are packed in delivery (ascending-pts) order.  Returns the
+// number written (<= n_wanted), or -1 on error.  Missing timestamps are NOT
+// an error here — the caller inspects `deliv` and replans (e.g. restart
+// from an earlier keyframe for open-GOP leading frames).
+SCVID_API int64_t scvid_decode_run_pts(
+    ScvidDecoder* d, const uint8_t* packets, const uint64_t* pkt_sizes,
+    const int64_t* pkt_pts, int64_t n_packets, const int64_t* wanted_pts,
+    int64_t n_wanted, uint8_t* deliv, int32_t flush, uint8_t* out,
+    int64_t out_capacity, int64_t* out_dims) {
+  int64_t written = 0;
+  int64_t cursor = 0;  // next wanted_pts candidate (emission is pts-ordered)
+  int64_t frame_bytes = 0;
+  AVPacket* pkt = av_packet_alloc();
+  const uint8_t* cur = packets;
+  memset(deliv, 0, (size_t)n_wanted);
+
+  auto drain = [&]() -> int {
+    while (true) {
+      int err = avcodec_receive_frame(d->ctx, d->frame);
+      if (err == AVERROR(EAGAIN) || err == AVERROR_EOF) return 0;
+      if (err < 0) {
+        set_av_error("receive_frame", err);
+        return -1;
+      }
+      if (frame_bytes == 0) {
+        out_dims[0] = d->frame->height;
+        out_dims[1] = d->frame->width;
+        frame_bytes = (int64_t)d->frame->height * d->frame->width * 3;
+      } else if (d->frame->height != out_dims[0] ||
+                 d->frame->width != out_dims[1]) {
+        set_error("frame geometry changed mid-run (mid-stream SPS change?)");
+        return -1;
+      }
+      d->emitted++;
+      int64_t fpts = d->frame->best_effort_timestamp != AV_NOPTS_VALUE
+                         ? d->frame->best_effort_timestamp
+                         : d->frame->pts;
+      // skip wanted entries the stream has passed (left undelivered)
+      while (cursor < n_wanted && wanted_pts[cursor] < fpts) cursor++;
+      if (cursor < n_wanted && wanted_pts[cursor] == fpts) {
+        if ((written + 1) * frame_bytes > out_capacity) {
+          set_error("decode output exceeds buffer capacity (geometry "
+                    "mismatch with index?)");
+          return -1;
+        }
+        if (convert_to_rgb(d, out + written * frame_bytes) < 0) return -1;
+        deliv[cursor] = 1;
+        cursor++;
+        written++;
+      }
+      av_frame_unref(d->frame);
+    }
+  };
+
+  for (int64_t i = 0; i < n_packets; ++i) {
+    av_packet_unref(pkt);
+    pkt->data = const_cast<uint8_t*>(cur);
+    pkt->size = (int)pkt_sizes[i];
+    pkt->pts = pkt_pts[i];
+    cur += pkt_sizes[i];
+    int err;
+    while ((err = avcodec_send_packet(d->ctx, pkt)) == AVERROR(EAGAIN)) {
+      if (drain() < 0) {
+        av_packet_free(&pkt);
+        return -1;
+      }
+    }
+    if (err < 0) {
+      set_av_error("send_packet", err);
+      av_packet_free(&pkt);
+      return -1;
+    }
+    if (drain() < 0) {
+      av_packet_free(&pkt);
+      return -1;
+    }
+  }
+  if (flush) {
+    avcodec_send_packet(d->ctx, nullptr);
+    if (drain() < 0) {
+      av_packet_free(&pkt);
+      return -1;
+    }
+    avcodec_flush_buffers(d->ctx);
+  }
+  av_packet_free(&pkt);
+  return written;
+}
+
 // ---------------------------------------------------------------------------
 // Encoder: RGB24 frames -> H.264 (or any libavcodec encoder) packets.
 // ---------------------------------------------------------------------------
@@ -393,7 +494,8 @@ SCVID_API ScvidEncoder* scvid_encoder_create(int32_t width, int32_t height,
                                              const char* codec_name,
                                              int64_t bitrate, int32_t crf,
                                              int32_t keyint,
-                                             int32_t bframes) {
+                                             int32_t bframes,
+                                             int32_t open_gop) {
   const AVCodec* codec = avcodec_find_encoder_by_name(codec_name);
   if (!codec) {
     set_error(std::string("no encoder: ") + codec_name);
@@ -417,14 +519,24 @@ SCVID_API ScvidEncoder* scvid_encoder_create(int32_t width, int32_t height,
     av_opt_set(ctx->priv_data, "preset", "veryfast", 0);
     if (bitrate <= 0)
       av_opt_set_int(ctx->priv_data, "crf", crf > 0 ? crf : 20, 0);
+    std::string params;
     if (bframes > 0) {
       // fixed B pattern (b-adapt=0, no scenecut): the knob exists to
       // produce reordered (pts != dts) streams deterministically;
       // x264's adaptive strategy / scenecut would silently emit
       // all-I/P for simple content
-      av_opt_set(ctx->priv_data, "x264-params", "b-adapt=0:scenecut=0",
-                 0);
+      params = "b-adapt=0:scenecut=0";
     }
+    if (open_gop) {
+      // non-IDR recovery points: GOP-boundary I frames whose leading B
+      // frames reference the previous GOP — the stream shape that makes
+      // positional seek masks unsafe (the pts-matched decode path covers
+      // it; tests build such fixtures through this knob)
+      if (!params.empty()) params += ":";
+      params += "open-gop=1";
+    }
+    if (!params.empty())
+      av_opt_set(ctx->priv_data, "x264-params", params.c_str(), 0);
   }
   int err = avcodec_open2(ctx, codec, nullptr);
   if (err < 0) {
@@ -484,8 +596,12 @@ int encoder_drain(ScvidEncoder* e) {
 }  // namespace
 
 // Feed n RGB24 frames (contiguous, h*w*3 each). Returns 0 / -1.
-SCVID_API int32_t scvid_encoder_feed(ScvidEncoder* e, const uint8_t* rgb,
-                                     int64_t n_frames) {
+// `pts` (optional, may be NULL): per-frame presentation timestamps in the
+// encoder time base — strictly increasing; enables VFR streams.  NULL
+// keeps the default sequential numbering.
+SCVID_API int32_t scvid_encoder_feed_pts(ScvidEncoder* e, const uint8_t* rgb,
+                                         int64_t n_frames,
+                                         const int64_t* pts) {
   for (int64_t i = 0; i < n_frames; ++i) {
     av_frame_make_writable(e->frame);
     const uint8_t* src_planes[4] = {rgb + i * 3 * e->ctx->width * e->ctx->height,
@@ -493,7 +609,16 @@ SCVID_API int32_t scvid_encoder_feed(ScvidEncoder* e, const uint8_t* rgb,
     int src_stride[4] = {3 * e->ctx->width, 0, 0, 0};
     sws_scale(e->sws, src_planes, src_stride, 0, e->ctx->height,
               e->frame->data, e->frame->linesize);
-    e->frame->pts = e->pts++;
+    if (pts) {
+      if (pts[i] < e->pts) {
+        set_error("feed_pts: timestamps must be strictly increasing");
+        return -1;
+      }
+      e->frame->pts = pts[i];
+      e->pts = pts[i] + 1;
+    } else {
+      e->frame->pts = e->pts++;
+    }
     int err = avcodec_send_frame(e->ctx, e->frame);
     if (err < 0) {
       set_av_error("send_frame", err);
@@ -502,6 +627,11 @@ SCVID_API int32_t scvid_encoder_feed(ScvidEncoder* e, const uint8_t* rgb,
     if (encoder_drain(e) < 0) return -1;
   }
   return 0;
+}
+
+SCVID_API int32_t scvid_encoder_feed(ScvidEncoder* e, const uint8_t* rgb,
+                                     int64_t n_frames) {
+  return scvid_encoder_feed_pts(e, rgb, n_frames, nullptr);
 }
 
 SCVID_API int32_t scvid_encoder_flush(ScvidEncoder* e) {

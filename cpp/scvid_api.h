@@ -42,18 +42,26 @@ int64_t scvid_decode_run(ScvidDecoder* d, const uint8_t* packets,
                          const uint8_t* wanted, int64_t n_wanted,
                          int32_t flush, uint8_t* out, int64_t out_capacity,
                          int64_t* out_dims);
+int64_t scvid_decode_run_pts(ScvidDecoder* d, const uint8_t* packets,
+                             const uint64_t* pkt_sizes,
+                             const int64_t* pkt_pts, int64_t n_packets,
+                             const int64_t* wanted_pts, int64_t n_wanted,
+                             uint8_t* deliv, int32_t flush, uint8_t* out,
+                             int64_t out_capacity, int64_t* out_dims);
 int64_t scvid_decoder_emitted(ScvidDecoder* d);
 
 ScvidEncoder* scvid_encoder_create(int32_t width, int32_t height,
                                    int32_t fps_num, int32_t fps_den,
                                    const char* codec_name, int64_t bitrate,
                                    int32_t crf, int32_t keyint,
-                                   int32_t bframes);
+                                   int32_t bframes, int32_t open_gop);
 void scvid_encoder_destroy(ScvidEncoder* e);
 int64_t scvid_encoder_extradata(ScvidEncoder* e, uint8_t* buf,
                                 int64_t bufsize);
 int32_t scvid_encoder_feed(ScvidEncoder* e, const uint8_t* rgb,
                            int64_t n_frames);
+int32_t scvid_encoder_feed_pts(ScvidEncoder* e, const uint8_t* rgb,
+                               int64_t n_frames, const int64_t* pts);
 int32_t scvid_encoder_flush(ScvidEncoder* e);
 int64_t scvid_encoder_pending(ScvidEncoder* e);
 int64_t scvid_encoder_pending_bytes(ScvidEncoder* e);

@@ -12,6 +12,7 @@
 #include <cstring>
 #include <string>
 #include <vector>
+#include <algorithm>
 
 #include "scvid_api.h"
 
@@ -59,7 +60,7 @@ int main() {
 
   // --- encode a deterministic clip -------------------------------------
   ScvidEncoder* enc = scvid_encoder_create(W, H, 24, 1, "libx264", 0, 18,
-                                           KEYINT, 0);
+                                           KEYINT, 0, 0);
   CHECK(enc != nullptr, "encoder create");
   std::vector<uint8_t> frame(W * H * 3);
   for (int i = 0; i < N; ++i) {
@@ -150,7 +151,7 @@ int main() {
     const char* bmp4 = "/tmp/scvid_test_b.mp4";
     const char* bpkts = "/tmp/scvid_test_b.pkts";
     ScvidEncoder* benc = scvid_encoder_create(W, H, 24, 1, "libx264", 0,
-                                              18, KEYINT, 2);
+                                              18, KEYINT, 2, 0);
     CHECK(benc != nullptr, "bframe encoder create");
     for (int i = 0; i < N; ++i) {
       fill_frame(frame.data(), i);
@@ -211,6 +212,45 @@ int main() {
         ids_ok = false;
     CHECK(ids_ok, "bframe frames emitted in display order with correct "
                   "content");
+
+    // --- pts-matched selection on the same reordered stream -------------
+    // Request a sparse display-order subset by timestamp; delivery must
+    // be exact and the deliv mask complete (the open-GOP/VFR decode path).
+    {
+      // display order = pts ascending; pick every 7th display frame.
+      // NOTE: wanted/packet pts must share a clock — use the ingested
+      // index's container-timescale pts for both (encoder-tick pts from
+      // take_packets are a different clock after muxing)
+      std::vector<int64_t> sorted_pts(bidx->sample_pts,
+                                      bidx->sample_pts + N);
+      std::sort(sorted_pts.begin(), sorted_pts.end());
+      std::vector<int64_t> wanted_pts;
+      for (int i = 0; i < N; i += 7) wanted_pts.push_back(sorted_pts[i]);
+      std::vector<int64_t> pkt_pts(bidx->sample_pts,
+                                   bidx->sample_pts + N);
+      std::vector<uint8_t> deliv(wanted_pts.size());
+      std::vector<uint8_t> pout(wanted_pts.size() * (size_t)W * H * 3);
+      int64_t pdims[2] = {0, 0};
+      scvid_decoder_reset(bdec);
+      int64_t pgot = scvid_decode_run_pts(
+          bdec, ball.data(), ball_sizes.data(), pkt_pts.data(), N,
+          wanted_pts.data(), (int64_t)wanted_pts.size(), deliv.data(), 1,
+          pout.data(), (int64_t)pout.size(), pdims);
+      CHECK(pgot == (int64_t)wanted_pts.size(),
+            "pts-matched decode delivers every wanted frame");
+      bool deliv_ok = true;
+      for (auto d : deliv)
+        if (!d) deliv_ok = false;
+      CHECK(deliv_ok, "pts-matched deliv mask complete");
+      bool pids_ok = true;
+      for (size_t i = 0; i < wanted_pts.size(); ++i) {
+        int disp = (int)(i * 7);
+        if (frame_id(pout.data() + i * (size_t)W * H * 3) !=
+            (disp * 16 % 224 + 8) / 16 % 14)
+          pids_ok = false;
+      }
+      CHECK(pids_ok, "pts-matched frames carry the right content");
+    }
     scvid_decoder_destroy(bdec);
     scvid_index_free(bidx);
     remove(bmp4);

@@ -30,8 +30,6 @@ class DecodeRun:
     """One keyframe-aligned packet feed."""
     start_dec: int       # first packet (decode order), always a keyframe
     end_dec: int         # last packet fed, inclusive
-    first_disp: int      # display index of the first emitted frame
-    mask: np.ndarray     # uint8 over emitted frames: 1 = deliver
     out_disp: np.ndarray  # display indices delivered, ascending
 
 
@@ -80,17 +78,14 @@ class VideoIndex:
                 f"frame request {wanted[0]}..{wanted[-1]} out of range "
                 f"(video has {self.vd.num_frames} frames)")
         runs: List[DecodeRun] = []
-        cur_start = cur_end = cur_first_disp = -1
+        cur_start = cur_end = -1
         cur_disps: List[int] = []
 
         def close_run():
             if cur_start < 0:
                 return
-            disps = np.asarray(cur_disps, np.int64)
-            mask = np.zeros(int(disps[-1]) - cur_first_disp + 1, np.uint8)
-            mask[disps - cur_first_disp] = 1
-            runs.append(DecodeRun(cur_start, cur_end, cur_first_disp, mask,
-                                  disps))
+            runs.append(DecodeRun(cur_start, cur_end,
+                                  np.asarray(cur_disps, np.int64)))
 
         for w in wanted:
             kf_dec, kf_disp = self.governing_keyframe(int(w))
@@ -101,7 +96,6 @@ class VideoIndex:
             else:
                 close_run()
                 cur_start, cur_end = kf_dec, need_end
-                cur_first_disp = kf_disp
                 cur_disps = [int(w)]
         close_run()
         return runs
@@ -159,6 +153,45 @@ class DecoderAutomata:
                 f"short packet read from {self.data_path}")
         return data, sizes.astype(np.uint64)
 
+    def _decode_run_pts(self, run: DecodeRun, out: np.ndarray) -> None:
+        """Decode one run into `out` ((n_out, h*w*3) rows in display
+        order), selecting frames by TIMESTAMP rather than emission
+        position.  Pts matching keeps delivery exact on streams where
+        positional masks break: open-GOP seeks (the decoder emits or
+        drops leading frames whose references precede the keyframe) and
+        VFR containers (display order is defined by pts alone).  If a
+        wanted frame is not delivered — an open-GOP leading frame whose
+        references live in the previous GOP — the whole run retries from
+        one keyframe earlier until it decodes or the stream start is hit
+        (reference decoder_automata feeder restarts at decoder_automata
+        .cpp:238; the reference never handled open GOPs at all)."""
+        h, w = self.vd.height, self.vd.width
+        pts_all = np.asarray(self.vd.sample_pts, np.int64)
+        wanted_pts = pts_all[self.index.dec_of_disp[
+            np.asarray(run.out_disp, np.int64)]]
+        start = run.start_dec
+        while True:
+            data, sizes = self._read_packets(start, run.end_dec)
+            pkt_pts = pts_all[start:run.end_dec + 1]
+            self.decoder.reset()
+            n, oh, ow, deliv = self.decoder.decode_run_pts(
+                data, sizes, pkt_pts, wanted_pts, out, flush=True)
+            if n and (oh, ow) != (h, w):
+                raise ScannerException(
+                    f"decoded geometry {oh}x{ow} != descriptor {h}x{w}")
+            if deliv.all():
+                return
+            # open-GOP leading frames: restart from one keyframe earlier
+            ki = int(np.searchsorted(self.index.kf_decs, start,
+                                     side="right")) - 1
+            if ki <= 0 or start <= 0:
+                missing = wanted_pts[~deliv].tolist()
+                raise ScannerException(
+                    f"frames with pts {missing[:5]} not delivered "
+                    f"(run {start}..{run.end_dec}; stream damaged or "
+                    f"index stale)")
+            start = int(self.index.kf_decs[ki - 1])
+
     def get_frames(self, rows: Sequence[int]) -> np.ndarray:
         """Decode exactly the given display-order frame indices.
 
@@ -177,18 +210,7 @@ class DecoderAutomata:
             # fast path: the run emits exactly the requested rows in
             # request order — decode straight into the result batch (the
             # zero-copy head of the engine's batched column path)
-            run = runs[0]
-            data, sizes = self._read_packets(run.start_dec, run.end_dec)
-            self.decoder.reset()
-            n, oh, ow = self.decoder.decode_run(
-                data, sizes, run.mask, result.reshape(-1), flush=True)
-            if n != len(rows_arr):
-                raise ScannerException(
-                    f"decode returned {n} frames, wanted {len(rows_arr)} "
-                    f"(run {run.start_dec}..{run.end_dec})")
-            if (oh, ow) != (h, w):
-                raise ScannerException(
-                    f"decoded geometry {oh}x{ow} != descriptor {h}x{w}")
+            self._decode_run_pts(runs[0], result.reshape(-1))
             return result
         # request-order positions of each decoded display index
         positions: dict = {}
@@ -198,17 +220,7 @@ class DecoderAutomata:
             n_out = len(run.out_disp)
             scratch = self._scratch_buf(n_out * frame_bytes)
             out = scratch[:n_out * frame_bytes]
-            data, sizes = self._read_packets(run.start_dec, run.end_dec)
-            self.decoder.reset()
-            n, oh, ow = self.decoder.decode_run(data, sizes, run.mask, out,
-                                                flush=True)
-            if n != n_out:
-                raise ScannerException(
-                    f"decode returned {n} frames, wanted {n_out} "
-                    f"(run {run.start_dec}..{run.end_dec})")
-            if (oh, ow) != (h, w):
-                raise ScannerException(
-                    f"decoded geometry {oh}x{ow} != descriptor {h}x{w}")
+            self._decode_run_pts(run, out)
             out = out.reshape(n_out, h, w, 3)
             for i, d in enumerate(run.out_disp):
                 for pos in positions.get(int(d), ()):

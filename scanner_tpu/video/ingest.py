@@ -283,14 +283,25 @@ def frame_pattern_id(frame: np.ndarray) -> int:
 
 def encode_frames_mp4(path: str, frames, width: int, height: int,
                       fps: float = 24.0, keyint: int = 12,
-                      crf: int = 18, bframes: int = 0) -> None:
+                      crf: int = 18, bframes: int = 0,
+                      open_gop: bool = False,
+                      frame_pts=None) -> None:
     """Encode an iterable of (H, W, 3) uint8 frames to an .mp4.
+
     bframes>0 produces a reordered (pts!=dts) stream like real-world
-    encodes — the decode-index tests' fixture knob."""
+    encodes; open_gop=True additionally uses non-IDR recovery-point
+    keyframes (leading B frames reference across GOP boundaries);
+    frame_pts (iterable of int, 1/fps ticks, strictly increasing)
+    produces a variable-frame-rate stream — the three fixture knobs for
+    real-world-stream decode tests."""
     enc = lib.Encoder(width, height, fps=fps, keyint=keyint, crf=crf,
-                      bframes=bframes)
-    for frame in frames:
-        enc.feed(frame)
+                      bframes=bframes, open_gop=open_gop)
+    if frame_pts is None:
+        for frame in frames:
+            enc.feed(frame)
+    else:
+        for frame, p in zip(frames, frame_pts, strict=True):
+            enc.feed(frame, pts=np.asarray([p], np.int64))
     enc.flush()
     data, sizes, keys, pts, dts = enc.take_packets()
     lib.write_mp4(path, width, height, fps, "h264", enc.extradata, data,
@@ -300,8 +311,10 @@ def encode_frames_mp4(path: str, frames, width: int, height: int,
 
 def synthesize_video(path: str, num_frames: int = 90, width: int = 128,
                      height: int = 96, fps: float = 24.0,
-                     keyint: int = 12, bframes: int = 0) -> None:
+                     keyint: int = 12, bframes: int = 0,
+                     open_gop: bool = False, frame_pts=None) -> None:
     """Encode a deterministic test clip to an .mp4 with libx264."""
     encode_frames_mp4(
         path, (frame_pattern(i, height, width) for i in range(num_frames)),
-        width, height, fps=fps, keyint=keyint, bframes=bframes)
+        width, height, fps=fps, keyint=keyint, bframes=bframes,
+        open_gop=open_gop, frame_pts=frame_pts)

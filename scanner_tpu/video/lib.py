@@ -83,18 +83,27 @@ def get_lib():
             C.c_void_p, C.c_char_p, C.POINTER(C.c_uint64), C.c_int64,
             C.c_char_p, C.c_int64, C.c_int32, C.c_void_p, C.c_int64,
             C.POINTER(C.c_int64)]
+        lib.scvid_decode_run_pts.restype = C.c_int64
+        lib.scvid_decode_run_pts.argtypes = [
+            C.c_void_p, C.c_char_p, C.POINTER(C.c_uint64),
+            C.POINTER(C.c_int64), C.c_int64, C.POINTER(C.c_int64),
+            C.c_int64, C.c_char_p, C.c_int32, C.c_void_p, C.c_int64,
+            C.POINTER(C.c_int64)]
         lib.scvid_decoder_emitted.restype = C.c_int64
         lib.scvid_decoder_emitted.argtypes = [C.c_void_p]
         lib.scvid_encoder_create.restype = C.c_void_p
         lib.scvid_encoder_create.argtypes = [
             C.c_int32, C.c_int32, C.c_int32, C.c_int32, C.c_char_p,
-            C.c_int64, C.c_int32, C.c_int32, C.c_int32]
+            C.c_int64, C.c_int32, C.c_int32, C.c_int32, C.c_int32]
         lib.scvid_encoder_destroy.argtypes = [C.c_void_p]
         lib.scvid_encoder_extradata.restype = C.c_int64
         lib.scvid_encoder_extradata.argtypes = [C.c_void_p, C.c_void_p,
                                                 C.c_int64]
         lib.scvid_encoder_feed.restype = C.c_int32
         lib.scvid_encoder_feed.argtypes = [C.c_void_p, C.c_void_p, C.c_int64]
+        lib.scvid_encoder_feed_pts.restype = C.c_int32
+        lib.scvid_encoder_feed_pts.argtypes = [
+            C.c_void_p, C.c_void_p, C.c_int64, C.POINTER(C.c_int64)]
         lib.scvid_encoder_flush.restype = C.c_int32
         lib.scvid_encoder_flush.argtypes = [C.c_void_p]
         lib.scvid_encoder_pending.restype = C.c_int64
@@ -206,18 +215,48 @@ class Decoder:
             raise ScannerException(f"decode failed: {_err()}")
         return int(n), int(dims[0]), int(dims[1])
 
+    def decode_run_pts(self, packets: bytes, sizes: np.ndarray,
+                       pkt_pts: np.ndarray, wanted_pts: np.ndarray,
+                       out: np.ndarray, flush: bool = True
+                       ) -> Tuple[int, int, int, np.ndarray]:
+        """Decode a packet run selecting frames by TIMESTAMP membership
+        (robust to open-GOP leading frames and VFR streams; see
+        scvid_decode_run_pts).  wanted_pts must be sorted ascending,
+        unique.  Returns (n_written, height, width, delivered_mask);
+        missing timestamps are reported in the mask, not raised — the
+        caller replans (e.g. from an earlier keyframe)."""
+        sizes = np.ascontiguousarray(sizes, dtype=np.uint64)
+        pkt_pts = np.ascontiguousarray(pkt_pts, dtype=np.int64)
+        wanted_pts = np.ascontiguousarray(wanted_pts, dtype=np.int64)
+        assert out.dtype == np.uint8 and out.flags["C_CONTIGUOUS"]
+        deliv = np.zeros(len(wanted_pts), np.uint8)
+        dims = (C.c_int64 * 2)()
+        n = self._lib.scvid_decode_run_pts(
+            self._h, packets,
+            sizes.ctypes.data_as(C.POINTER(C.c_uint64)),
+            pkt_pts.ctypes.data_as(C.POINTER(C.c_int64)), len(sizes),
+            wanted_pts.ctypes.data_as(C.POINTER(C.c_int64)),
+            len(wanted_pts),
+            deliv.ctypes.data_as(C.c_char_p),
+            1 if flush else 0,
+            out.ctypes.data_as(C.c_void_p), out.nbytes, dims)
+        if n < 0:
+            raise ScannerException(f"decode failed: {_err()}")
+        return int(n), int(dims[0]), int(dims[1]), deliv.astype(bool)
+
 
 class Encoder:
     def __init__(self, width: int, height: int, fps: float = 30.0,
                  codec: str = "libx264", bitrate: int = 0, crf: int = 20,
-                 keyint: int = 16, bframes: int = 0):
+                 keyint: int = 16, bframes: int = 0,
+                 open_gop: bool = False):
         self._lib = get_lib()
         fps_num, fps_den = _fps_to_rational(fps)
         self.width, self.height = width, height
         self.fps_num, self.fps_den = fps_num, fps_den
         self._h = self._lib.scvid_encoder_create(
             width, height, fps_num, fps_den, codec.encode(), bitrate, crf,
-            keyint, bframes)
+            keyint, bframes, 1 if open_gop else 0)
         if not self._h:
             raise ScannerException(f"encoder create failed: {_err()}")
 
@@ -241,8 +280,13 @@ class Encoder:
         self._lib.scvid_encoder_extradata(self._h, buf, n)
         return buf.raw
 
-    def feed(self, frames: np.ndarray) -> None:
-        """frames: uint8 array (n, h, w, 3) or (h, w, 3)."""
+    def feed(self, frames: np.ndarray,
+             pts: Optional[np.ndarray] = None) -> None:
+        """frames: uint8 array (n, h, w, 3) or (h, w, 3).
+
+        pts (optional): per-frame presentation timestamps in the encoder
+        time base (1/fps ticks), strictly increasing across all feeds —
+        gaps produce variable-frame-rate streams."""
         frames = np.ascontiguousarray(frames, dtype=np.uint8)
         if frames.ndim == 3:
             frames = frames[None]
@@ -251,8 +295,18 @@ class Encoder:
                 f"encoder expects {self.height}x{self.width}x3 frames, got "
                 f"{frames.shape[1:]}")
         n = frames.shape[0]
-        if self._lib.scvid_encoder_feed(
-                self._h, frames.ctypes.data_as(C.c_void_p), n) < 0:
+        if pts is None:
+            ok = self._lib.scvid_encoder_feed(
+                self._h, frames.ctypes.data_as(C.c_void_p), n)
+        else:
+            pts = np.ascontiguousarray(pts, dtype=np.int64)
+            if len(pts) != n:
+                raise ScannerException(
+                    f"{len(pts)} timestamps for {n} frames")
+            ok = self._lib.scvid_encoder_feed_pts(
+                self._h, frames.ctypes.data_as(C.c_void_p), n,
+                pts.ctypes.data_as(C.POINTER(C.c_int64)))
+        if ok < 0:
             raise ScannerException(f"encode failed: {_err()}")
 
     def flush(self) -> None:

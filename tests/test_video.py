@@ -244,3 +244,224 @@ def test_bframe_engine_gather_pipeline(tmp_db, bclip, tmp_path):
             assert np.array_equal(np.stack(h), expect)
     finally:
         sc.stop()
+
+
+# ---------------------------------------------------------------------------
+# Open-GOP streams: non-IDR recovery-point keyframes whose leading B frames
+# reference the PREVIOUS GOP.  Seeking to such a keyframe and counting
+# emitted frames misdelivers; the pts-matched decode path
+# (scvid_decode_run_pts + automata._decode_run_pts) detects undelivered
+# timestamps and restarts from an earlier keyframe.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def oclip(tmp_path_factory):
+    p = str(tmp_path_factory.mktemp("vids") / "oclip.mp4")
+    scv.synthesize_video(p, num_frames=48, width=64, height=48, fps=24,
+                         keyint=8, bframes=2, open_gop=True)
+    return p
+
+
+def test_open_gop_fixture_shape(oclip):
+    """The fixture must really be open-GOP: reordered pts, and at least
+    one keyframe with a leading frame (display index before the
+    keyframe's own display position but decode index after it)."""
+    vd = scv.ingest_file(oclip, None)
+    assert vd.num_frames == 48
+    idx = VideoIndex(vd)
+    pts = np.asarray(vd.sample_pts)
+    assert not np.all(np.diff(pts) > 0), "no reordering in open-GOP clip"
+    leading = 0
+    for kf_dec in np.asarray(vd.keyframe_indices)[1:]:
+        kf_disp = idx.disp_of_dec[kf_dec]
+        # frames decoded after the keyframe but displayed before it
+        after = idx.disp_of_dec[kf_dec + 1:kf_dec + 4]
+        leading += int(np.sum(after < kf_disp))
+    assert leading > 0, (
+        "fixture has no leading frames; open_gop knob produced closed GOPs")
+
+
+def test_open_gop_full_sequential_decode(tmp_db, oclip):
+    scv.ingest_videos(tmp_db, [("oclip_seq", oclip)])
+    frames = scv.load_frames(tmp_db, "oclip_seq", list(range(48)))
+    ids = [scv.frame_pattern_id(f) for f in frames]
+    assert ids == [expected_id(r, 48, 64) for r in range(48)]
+
+
+def test_open_gop_leading_frame_gathers(tmp_db, oclip):
+    """Isolated requests for frames around every GOP boundary — incl. the
+    leading B frames that are NOT decodable from their governing keyframe
+    alone (the earlier-keyframe retry path)."""
+    scv.ingest_videos(tmp_db, [("oclip_gop", oclip)])
+    from scanner_tpu.video.ingest import load_video_meta
+    vd = load_video_meta(tmp_db, "oclip_gop")
+    idx = VideoIndex(vd)
+    rows = set()
+    for kf_dec in np.asarray(vd.keyframe_indices)[1:]:
+        kf_disp = int(idx.disp_of_dec[kf_dec])
+        for r in (kf_disp - 2, kf_disp - 1, kf_disp, kf_disp + 1):
+            if 0 <= r < 48:
+                rows.add(r)
+    rows = sorted(rows)
+    # one at a time: each request must be individually exact
+    for r in rows:
+        f = scv.load_frames(tmp_db, "oclip_gop", [r])
+        assert scv.frame_pattern_id(f[0]) == expected_id(r, 48, 64), \
+            f"frame {r} wrong near open-GOP boundary"
+
+
+def test_open_gop_engine_pipeline(tmp_db, oclip, tmp_path):
+    from scanner_tpu import (CacheMode, Client, NamedStream,
+                            NamedVideoStream, PerfParams)
+    import scanner_tpu.kernels  # noqa: F401
+
+    sc = Client(db_path=str(tmp_path / "odb"))
+    try:
+        movie = NamedVideoStream(sc, "omovie", path=oclip)
+        frames = sc.io.Input([movie])
+        rows = [6, 7, 8, 9, 22, 23, 24, 38, 39, 40]
+        picked = sc.streams.Gather(frames, [rows])
+        hist = sc.ops.Histogram(frame=picked)
+        out = NamedStream(sc, "ohists")
+        sc.run(sc.io.Output(hist, [out]), PerfParams.manual(4, 8),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+        hists = list(out.load())
+        assert len(hists) == len(rows)
+        direct = scv.load_frames(sc._db, "omovie", rows)
+        from scanner_tpu.kernels.imgproc import Histogram as HK
+        for h, f in zip(hists, direct):
+            expect = HK._histogram_np(f[None])[0]
+            assert np.array_equal(np.stack(h), expect)
+    finally:
+        sc.stop()
+
+
+# ---------------------------------------------------------------------------
+# VFR (variable frame rate) streams: display order and identity are defined
+# by pts alone; sample durations vary.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def vfr_clip(tmp_path_factory):
+    p = str(tmp_path_factory.mktemp("vids") / "vfr.mp4")
+    # irregular (but strictly increasing) timestamps: 1,2,4,7,8,11,...
+    rng = np.random.RandomState(11)
+    gaps = rng.randint(1, 5, size=60)
+    pts = np.cumsum(gaps) - gaps[0]
+    scv.synthesize_video(p, num_frames=60, width=64, height=48, fps=24,
+                         keyint=10, frame_pts=pts.tolist())
+    return p, pts
+
+
+def test_vfr_index_and_durations(vfr_clip):
+    p, pts = vfr_clip
+    vd = scv.ingest_file(p, None)
+    assert vd.num_frames == 60
+    got = np.sort(np.asarray(vd.sample_pts))
+    # container timescale may rescale pts; spacing RATIOS must survive
+    gaps_in = np.diff(pts).astype(np.float64)
+    gaps_out = np.diff(got).astype(np.float64)
+    ratio = gaps_out / gaps_in
+    assert np.allclose(ratio, ratio[0]), "VFR spacing lost in mux/ingest"
+    assert not np.allclose(gaps_out, gaps_out[0]), "fixture is CFR"
+
+
+def test_vfr_exact_decode(tmp_db, vfr_clip):
+    p, _ = vfr_clip
+    scv.ingest_videos(tmp_db, [("vfr", p)])
+    frames = scv.load_frames(tmp_db, "vfr", list(range(60)))
+    ids = [scv.frame_pattern_id(f) for f in frames]
+    assert ids == [expected_id(r, 48, 64) for r in range(60)]
+    # sparse gather across keyframes
+    rows = [0, 9, 10, 11, 29, 30, 59, 30]
+    frames = scv.load_frames(tmp_db, "vfr", rows)
+    for got, r in zip(frames, rows):
+        assert scv.frame_pattern_id(got) == expected_id(r, 48, 64)
+
+
+def test_vfr_bframe_combined(tmp_db, tmp_path_factory):
+    """VFR + B-frames + open GOP together — the worst real-world shape."""
+    p = str(tmp_path_factory.mktemp("vids") / "vfrb.mp4")
+    rng = np.random.RandomState(13)
+    gaps = rng.randint(1, 4, size=40)
+    pts = (np.cumsum(gaps) - gaps[0])
+    scv.synthesize_video(p, num_frames=40, width=64, height=48, fps=24,
+                         keyint=8, bframes=2, open_gop=True,
+                         frame_pts=pts.tolist())
+    scv.ingest_videos(tmp_db, [("vfrb", p)])
+    frames = scv.load_frames(tmp_db, "vfrb", list(range(40)))
+    ids = [scv.frame_pattern_id(f) for f in frames]
+    assert ids == [expected_id(r, 48, 64) for r in range(40)]
+    rows = [7, 8, 9, 15, 16, 17, 31, 32, 39]
+    for r in rows:
+        f = scv.load_frames(tmp_db, "vfrb", [r])
+        assert scv.frame_pattern_id(f[0]) == expected_id(r, 48, 64)
+
+
+def test_false_keyframe_retry_recovers(tmp_db, bclip):
+    """A stream whose index wrongly marks a mid-GOP frame as a seek point
+    (stale/foreign index, non-compliant container): the first decode
+    attempt fails to deliver the wanted timestamp (the decoder drops
+    frames with missing references), and the automata retries from the
+    previous TRUE keyframe — delivering bit-exact frames."""
+    from scanner_tpu.storage import metadata as md
+    from scanner_tpu.video.automata import DecoderAutomata
+    from scanner_tpu.video.ingest import load_video_meta
+
+    scv.ingest_videos(tmp_db, [("bclip_fake", bclip)])
+    vd = load_video_meta(tmp_db, "bclip_fake")
+    idx0 = VideoIndex(vd)
+    item = md.column_item_path(tmp_db.table_descriptor("bclip_fake").id,
+                               "frame", 0)
+    clean_auto = DecoderAutomata(tmp_db.backend, vd, item)
+    clean = clean_auto.get_frames(list(range(48)))
+    clean_auto.close()
+
+    fake_dec = 11  # mid-GOP (true keyframes are multiples of 8)
+    assert fake_dec not in set(np.asarray(vd.keyframe_indices).tolist())
+    vd.keyframe_indices = np.sort(np.append(vd.keyframe_indices, fake_dec))
+    auto = DecoderAutomata(tmp_db.backend, vd, item)
+    try:
+        orig = auto.decoder.decode_run_pts
+        attempts = []
+
+        def spy(*a, **k):
+            r = orig(*a, **k)
+            attempts.append(bool(r[3].all()))
+            return r
+        auto.decoder.decode_run_pts = spy
+        row = int(idx0.disp_of_dec[fake_dec]) + 2
+        f = auto.get_frames([row])
+        assert attempts[0] is False and attempts[-1] is True, attempts
+        assert np.array_equal(f[0], clean[row]), \
+            "retry delivered non-exact frame"
+    finally:
+        auto.close()
+
+
+def test_open_gop_boundary_bit_exact(tmp_db, oclip):
+    """Frames at/after a non-IDR recovery point must reconstruct
+    BIT-EXACTLY when decoded from that recovery point (H.264 recovery
+    contract) — a stronger check than the pattern id, which tolerates
+    concealment artifacts."""
+    from scanner_tpu.storage import metadata as md
+    from scanner_tpu.video.automata import DecoderAutomata
+    from scanner_tpu.video.ingest import load_video_meta
+
+    scv.ingest_videos(tmp_db, [("oclip_exact", oclip)])
+    vd = load_video_meta(tmp_db, "oclip_exact")
+    idx = VideoIndex(vd)
+    item = md.column_item_path(tmp_db.table_descriptor("oclip_exact").id,
+                               "frame", 0)
+    auto = DecoderAutomata(tmp_db.backend, vd, item)
+    try:
+        clean = auto.get_frames(list(range(48)))
+        for kf_dec in np.asarray(vd.keyframe_indices)[1:]:
+            kf_disp = int(idx.disp_of_dec[kf_dec])
+            for r in (kf_disp, kf_disp + 1, kf_disp + 2):
+                if r < 48:
+                    f = auto.get_frames([r])
+                    assert np.array_equal(f[0], clean[r]), \
+                        f"frame {r} (recovery point {kf_disp}) not exact"
+    finally:
+        auto.close()
