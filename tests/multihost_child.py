@@ -1,8 +1,12 @@
-"""Child process for the multi-host mesh test: joins a 2-process JAX
-runtime (4 virtual CPU devices each), builds a GLOBAL 8-device mesh, and
-runs one sharded train step whose collectives cross the process boundary.
+"""Child process for the multi-host mesh tests: joins an n-process JAX
+runtime (k virtual CPU devices each), builds a GLOBAL mesh, and runs one
+sharded train step whose collectives cross the process boundaries.
 
 Usage: python multihost_child.py <coordinator_port> <process_id> [n_procs]
+                                 [mode]
+mode: "train" (default) or "crash" — crash exits(1) right after joining
+the runtime, simulating a host dying mid-job (the surviving ranks must
+fail or be killable, never complete wrongly).
 """
 
 import sys
@@ -10,33 +14,79 @@ import sys
 from scanner_tpu.parallel.distributed import CoordinatorConfig, initialize
 
 
-def spawn_multihost(n_processes: int = 2, devices_per_process: int = 4,
-                    timeout: float = 600.0):
-    """Launch n child processes running this script against one fresh
-    coordinator and collect their stdout.  Kills the whole set if any
-    child fails or times out (no orphans blocked on a dead coordinator).
-    Returns the list of child stdouts."""
-    import os
+def free_port() -> int:
     import socket
-    import subprocess
-
-    from scanner_tpu.util.jaxenv import cpu_only_env
 
     with socket.socket() as s:
         s.bind(("localhost", 0))
-        port = s.getsockname()[1]
+        return s.getsockname()[1]
+
+
+def spawn_multihost(n_processes: int = 2, devices_per_process: int = 4,
+                    timeout: float = 600.0, crash_rank=None, port=None):
+    """Launch n child processes running this script against one fresh
+    coordinator and collect their stdout.  `timeout` bounds the WHOLE
+    launch (shared deadline across children).  Kills the set on any
+    failure or timeout (no orphans blocked on a dead coordinator).
+    Returns the list of child stdouts.
+
+    crash_rank: that child runs mode="crash" — it must join the runtime
+    (prints MULTIHOST_JOINED) and then exit(1).  spawn_multihost verifies
+    that really happened, verifies no surviving rank completes
+    successfully, and raises RuntimeError — the deterministic
+    rank-death-fails-the-group proof.
+    port: explicit coordinator port (reuse across launches to prove a
+    fresh group can bind where a failed one died)."""
+    import os
+    import subprocess
+    import time
+
+    from scanner_tpu.util.jaxenv import cpu_only_env
+
+    if port is None:
+        port = free_port()
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     child = os.path.abspath(__file__)
     env = cpu_only_env(n_devices=devices_per_process)
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    deadline = time.time() + timeout
     procs = [subprocess.Popen(
-        [sys.executable, child, str(port), str(pid), str(n_processes)],
+        [sys.executable, child, str(port), str(pid), str(n_processes),
+         "crash" if pid == crash_rank else "train"],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
         for pid in range(n_processes)]
+
+    def remaining() -> float:
+        return max(0.1, deadline - time.time())
+
     outs = []
     try:
+        if crash_rank is not None:
+            pc = procs[crash_rank]
+            out, err = pc.communicate(timeout=remaining())
+            if pc.returncode != 1 or "MULTIHOST_JOINED" not in out:
+                raise AssertionError(
+                    f"crash child did not die after joining: "
+                    f"rc={pc.returncode}\n{out}\n{err}")
+            # survivors must never complete successfully; hanging in the
+            # collective (until our kill) and erroring out are both
+            # acceptable failure shapes
+            grace = time.time() + 15
+            for i, p in enumerate(procs):
+                if i == crash_rank:
+                    continue
+                try:
+                    o, e = p.communicate(
+                        timeout=max(0.1, grace - time.time()))
+                    if p.returncode == 0:
+                        raise AssertionError(
+                            f"rank {i} completed despite peer death:\n{o}")
+                except subprocess.TimeoutExpired:
+                    pass  # blocked in the collective: expected
+            raise RuntimeError(
+                "rank death confirmed: group did not complete")
         for p in procs:
-            out, err = p.communicate(timeout=timeout)
+            out, err = p.communicate(timeout=remaining())
             if p.returncode != 0:
                 raise RuntimeError(f"multihost child failed:\n{out}\n{err}")
             outs.append(out)
@@ -51,12 +101,17 @@ def spawn_multihost(n_processes: int = 2, devices_per_process: int = 4,
 def main() -> None:
     port, pid = int(sys.argv[1]), int(sys.argv[2])
     n_procs = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+    mode = sys.argv[4] if len(sys.argv) > 4 else "train"
     initialize(CoordinatorConfig(
         address=f"localhost:{port}", num_processes=n_procs, process_id=pid),
         init_timeout=60)
 
     import jax
     assert jax.process_count() == n_procs, jax.process_count()
+    if mode == "crash":
+        # simulate this host dying mid-job, after the group is formed
+        print("MULTIHOST_JOINED", flush=True)
+        sys.exit(1)
 
     from scanner_tpu.models import make_sharded_train_step
     from scanner_tpu.parallel import auto_axes, make_mesh

@@ -219,3 +219,39 @@ def test_ring_attention_pallas_grad_matches_xla():
     gx = jax.grad(lambda q: xla(q, k, v).sum())(q)
     np.testing.assert_allclose(np.asarray(gp), np.asarray(gx), rtol=1e-5,
                                atol=1e-6)
+
+
+@pytest.mark.slow
+def test_multihost_4proc_train_step():
+    """Four OS processes x 2 virtual devices — the process count of a
+    small pod slice.  The global mesh spans all four; every rank must
+    agree on the globally-reduced loss."""
+    from multihost_child import spawn_multihost
+
+    outs = spawn_multihost(n_processes=4, devices_per_process=2,
+                           timeout=300)
+    losses = [float(o.split("MULTIHOST_LOSS")[1].split()[0]) for o in outs]
+    for l in losses[1:]:
+        assert l == pytest.approx(losses[0], rel=1e-6)
+
+
+@pytest.mark.slow
+def test_multihost_failure_then_restart():
+    """A rank dying mid-job must fail the group (never a silent wrong
+    result), and a FRESH group must be startable on the same coordinator
+    port afterwards — the restart path an elastic cluster manager
+    (deploy.py StatefulSets) relies on.  spawn_multihost verifies the
+    crash rank really joined then exit(1)d, and that no surviving rank
+    completes successfully, before raising."""
+    from multihost_child import free_port, spawn_multihost
+
+    port = free_port()
+    with pytest.raises(RuntimeError,
+                       match="rank death confirmed"):
+        spawn_multihost(n_processes=2, devices_per_process=2, timeout=120,
+                        crash_rank=1, port=port)
+    # same port, fresh group: must come up and agree
+    outs = spawn_multihost(n_processes=2, devices_per_process=2,
+                           timeout=300, port=port)
+    losses = [float(o.split("MULTIHOST_LOSS")[1].split()[0]) for o in outs]
+    assert losses[0] == pytest.approx(losses[1], rel=1e-6)
