@@ -25,6 +25,7 @@ EXAMPLES = [
     "07_profiling.py",
     "08_distributed.py",
     "09_native_ops.py",
+    "10_native_source_sink.py",
     "pose_detection.py",
     "reid_features.py",
     "shot_detection.py",
@@ -32,9 +33,11 @@ EXAMPLES = [
     "face_detection.py",
 ]
 
-# examples that synthesize their own scene video and assert recall
-# against ground truth when run with no arguments
-SELF_CONTAINED = {"object_detection.py", "face_detection.py"}
+# examples that run with NO arguments: they build their own inputs
+# (synthesized scene videos with recall assertions, or a packed binary
+# container) and assert results internally
+SELF_CONTAINED = {"object_detection.py", "face_detection.py",
+                  "10_native_source_sink.py"}
 
 
 @pytest.fixture(scope="module")
@@ -52,7 +55,7 @@ def test_example_runs(example, clip, tmp_path):
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     args = [sys.executable, os.path.join(REPO, "examples", example)]
     if example in SELF_CONTAINED:
-        pass  # no args: synthesize scenes + assert recall vs ground truth
+        pass  # no args: builds its own inputs, asserts internally
     elif example == "pose_detection.py":
         args += [clip, "5"]  # stride (it makes its own temp db)
     else:
