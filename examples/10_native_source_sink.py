@@ -80,10 +80,16 @@ int pack_merge(const char* const* item_paths, int64_t n_items,
     if (fread(&n, sizeof(n), 1, f) != 1) { fclose(f); fclose(pf);
                                            free(ends); return -1; }
     int64_t* sizes = (int64_t*)malloc(sizeof(int64_t) * (size_t)n);
-    if (fread(sizes, sizeof(int64_t), (size_t)n, f) != (size_t)n) {
+    if (!sizes || fread(sizes, sizeof(int64_t), (size_t)n, f)
+                      != (size_t)n) {
       free(sizes); fclose(f); fclose(pf); free(ends); return -1;
     }
-    ends = (int64_t*)realloc(ends, sizeof(int64_t) * (size_t)(n_rows + n));
+    int64_t* grown =
+        (int64_t*)realloc(ends, sizeof(int64_t) * (size_t)(n_rows + n));
+    if (!grown) {
+      free(sizes); fclose(f); fclose(pf); free(ends); return -1;
+    }
+    ends = grown;
     char buf[1 << 16];
     for (int64_t i = 0; i < n; ++i) {
       int64_t left = sizes[i];
@@ -140,7 +146,8 @@ int pack_read_rows(const char* pack_path, const char* idx_path,
   int64_t n_rows;
   if (fread(&n_rows, sizeof(n_rows), 1, xf) != 1) { fclose(xf); return -1; }
   int64_t* ends = (int64_t*)malloc(sizeof(int64_t) * (size_t)n_rows);
-  if (fread(ends, sizeof(int64_t), (size_t)n_rows, xf) != (size_t)n_rows) {
+  if (!ends || fread(ends, sizeof(int64_t), (size_t)n_rows, xf)
+                   != (size_t)n_rows) {
     free(ends); fclose(xf); return -1;
   }
   fclose(xf);

@@ -147,20 +147,50 @@ class LocalExecutor:
         # analog, common.py:78-160): target ~64 MB of decoded frames per
         # io packet so tasks neither thrash tiny items nor blow host RAM
         frame_bytes = 0
+        keyint = 0
         for n in info.sources:
             for s in n.extra["streams"]:
                 self._bind_if_unbound(s)
                 if getattr(s, "is_video", False) \
-                        and hasattr(s, "estimate_size"):
+                        and hasattr(s, "estimate_geometry"):
                     # real errors (bad path, storage failure) propagate:
                     # silently mis-sizing a 4K stream as VGA would blow
                     # host RAM far from the actual cause
+                    fb, ki = s.estimate_geometry()
+                    frame_bytes = max(frame_bytes, fb)
+                    keyint = max(keyint, ki)
+                elif getattr(s, "is_video", False) \
+                        and hasattr(s, "estimate_size"):
                     frame_bytes = max(frame_bytes, s.estimate_size())
         if frame_bytes > 0:
             target = 64 << 20
             io = max(16, min(512, target // frame_bytes))
-            work = max(4, min(16, io // 4))
-            io = (io // work) * work
+
+            def best_work(n: int):
+                """Largest divisor of n in [4, 16] (compute batch floor:
+                 1-row work packets drown in scheduling overhead)."""
+                for w in range(min(16, n), 3, -1):
+                    if n % w == 0:
+                        return w
+                return None
+
+            # snap io packets to a multiple of the keyframe interval so
+            # task boundaries land on keyframes: a mid-GOP task start
+            # re-decodes the GOP prefix (up to keyint-1 frames) for
+            # nothing.  The snap is dropped rather than accepted when it
+            # would cross the 16-frame floor (round up instead) or leave
+            # no workable packet divisor.
+            work = None
+            if keyint > 1 and keyint <= 2 * io:
+                snapped = (io // keyint) * keyint
+                if snapped < 16:
+                    snapped += keyint
+                w = best_work(snapped)
+                if w is not None:
+                    io, work = snapped, w
+            if work is None:
+                work = max(4, min(16, io // 4))
+                io = (io // work) * work
             perf.io_packet_size = int(io)
             perf.work_packet_size = int(work)
         else:

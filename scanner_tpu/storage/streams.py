@@ -150,9 +150,32 @@ class NamedVideoStream(StoredStream):
         return super().len()
 
     def estimate_size(self) -> int:
+        return self.estimate_geometry()[0]
+
+    def estimate_keyint(self) -> int:
+        """Typical keyframe spacing in DISPLAY frames (0 = unknown).
+        PerfParams.estimate aligns io packets to this so task boundaries
+        land on keyframes and consecutive tasks never re-decode a GOP
+        prefix."""
+        return self.estimate_geometry()[1]
+
+    def estimate_geometry(self) -> tuple:
+        """(frame_bytes, keyint) from ONE descriptor read — the estimate
+        loop runs over every stream of every job at launch, so metadata
+        I/O here is per-corpus, not per-call."""
         self.ensure_ingested()
         vd = self._video_meta()
-        return int(vd.width * vd.height * 3)
+        frame_bytes = int(vd.width * vd.height * 3)
+        kfs = np.asarray(vd.keyframe_indices)
+        if len(kfs) < 2:
+            return frame_bytes, 0
+        # decode->display: keyframe display positions are the pts ranks
+        pts = np.asarray(vd.sample_pts, np.int64)
+        disp_of_dec = np.empty(len(pts), np.int64)
+        disp_of_dec[np.argsort(pts, kind="stable")] = np.arange(len(pts))
+        gaps = np.diff(np.sort(disp_of_dec[kfs]))
+        keyint = int(np.median(gaps)) if len(gaps) else 0
+        return frame_bytes, keyint
 
     def _video_meta(self) -> md.VideoDescriptor:
         from ..video import load_video_meta

@@ -910,3 +910,26 @@ def test_prestage_pipeline_e2e(tmp_path, monkeypatch):
         assert len(staged_tasks) == 2, staged_tasks
     finally:
         client.stop()
+
+
+def test_estimate_aligns_io_packets_to_keyint(sc, tmp_path):
+    """PerfParams.estimate snaps io packets to the stream's keyframe
+    interval so task boundaries land on keyframes — a mid-GOP task start
+    re-decodes up to keyint-1 frames of GOP prefix for nothing."""
+    # bframes>0 disables scenecut, so GOPs are exactly keyint=12 (the
+    # plain fixture clips get extra scenecut I-frames from x264)
+    vid = str(tmp_path / "gop12.mp4")
+    scv.synthesize_video(vid, num_frames=72, width=W, height=H, fps=24,
+                         keyint=12, bframes=1)
+    sc.ingest_videos([("est_gop12", vid)])
+    vs = NamedVideoStream(sc, "est_gop12")
+    assert vs.estimate_keyint() == 12
+    frames = sc.io.Input([vs])
+    hist = sc.ops.Histogram(frame=frames)
+    out = NamedStream(sc, "est_keyint")
+    p = PerfParams.estimate()
+    sc.run(sc.io.Output(hist, [out]), p,
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    assert p.io_packet_size % 12 == 0, p.io_packet_size
+    assert p.io_packet_size % p.work_packet_size == 0
+    assert len(list(out.load())) == 72

@@ -228,8 +228,10 @@ def test_multihost_4proc_train_step():
     agree on the globally-reduced loss."""
     from multihost_child import spawn_multihost
 
+    # 600s: the deadline bounds the WHOLE launch and 4 concurrent jax
+    # imports + compiles share one core when the full suite runs
     outs = spawn_multihost(n_processes=4, devices_per_process=2,
-                           timeout=300)
+                           timeout=600)
     losses = [float(o.split("MULTIHOST_LOSS")[1].split()[0]) for o in outs]
     for l in losses[1:]:
         assert l == pytest.approx(losses[0], rel=1e-6)
