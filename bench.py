@@ -11,11 +11,16 @@ Configs (BASELINE.md table):
   3 pose           PoseDetect with the shipped trained weights
   4 objdet         ObjectDetect (SSD head + fixed-shape NMS)
   5 face           FaceEmbedding
+  6 corpus         Histogram over a multi-video corpus in ONE bulk run
+                   (BENCH_CORPUS_VIDEOS jobs through the scheduler +
+                   pipeline — the corpus-shaped workload of the north
+                   star, scaled to bench time)
 
 Prints ONE JSON line for the north-star metric (configs 1+3 averaged);
 per-config detail goes to stderr and BENCH_DETAIL.json.  BENCH_CONFIGS
-selects configs ("1,3" default; "all" = 1-5); BENCH_FRAMES /
-BENCH_MODEL_FRAMES size the decode workloads.
+selects configs ("1,3" default; "all" = 1-6 incl. the corpus run);
+BENCH_FRAMES / BENCH_MODEL_FRAMES / BENCH_CORPUS_VIDEOS size the decode
+workloads.
 
 Runs on whatever JAX platform the environment provides (the real TPU chip
 under the driver); a wedged accelerator tunnel is probed in a subprocess
@@ -62,10 +67,14 @@ def _tpu_reachable() -> bool:
         return False
 
 
+N_CORPUS_VIDEOS = int(os.environ.get("BENCH_CORPUS_VIDEOS", "8"))
+N_CORPUS_FRAMES = int(os.environ.get("BENCH_CORPUS_FRAMES", "120"))
+
+
 def _configs():
     sel = os.environ.get("BENCH_CONFIGS", "1,3").strip().lower()
     if sel == "all":
-        return [1, 2, 3, 4, 5]
+        return [1, 2, 3, 4, 5, 6]
     picked = sorted({int(x) for x in sel.split(",") if x})
     if not picked:
         print(f"bench: empty BENCH_CONFIGS={sel!r}; using default 1,3",
@@ -153,7 +162,44 @@ def main():
                 return sc.ops.FaceEmbedding(frame=frames_col, width=8)
             raise ValueError(config)
 
+        def run_corpus() -> dict:
+            """Config 6: one bulk run over a multi-video corpus — jobs
+            stream through the scheduler and the pipeline overlaps
+            decode/eval/save ACROSS jobs (the corpus-shaped workload of
+            the north-star metric, scaled to bench time)."""
+            # one encode, N table names: the corpus shape matters to
+            # the scheduler/pipeline, not the bytes
+            p = os.path.join(root, "corpus.mp4")
+            scv.synthesize_video(p, num_frames=N_CORPUS_FRAMES,
+                                 width=W, height=H, fps=30, keyint=30)
+            names = [(f"corpus_{i}", p) for i in range(N_CORPUS_VIDEOS)]
+            sc.ingest_videos(names)
+
+            def run_once(suffix: str) -> float:
+                streams = [NamedVideoStream(sc, n) for n, _ in names]
+                frames = sc.io.Input(streams)
+                hist = sc.ops.Histogram(frame=frames)
+                outs = [NamedStream(sc, f"c6_{n}_{suffix}")
+                        for n, _ in names]
+                t0 = time.time()
+                sc.run(sc.io.Output(hist, outs), PerfParams.manual(32, 96),
+                       cache_mode=CacheMode.Overwrite, show_progress=False)
+                return time.time() - t0
+
+            t_warm = run_once("w")
+            dt = run_once("m")
+            total = N_CORPUS_VIDEOS * N_CORPUS_FRAMES
+            return {"config": 6, "frames": total,
+                    "videos": N_CORPUS_VIDEOS,
+                    "fps": round(total / dt, 2), "platform": platform,
+                    "warmup_s": round(t_warm, 2),
+                    "measured_s": round(dt, 2), "reps": 1,
+                    "clock": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                    "host_cpus": os.cpu_count()}
+
         def run_config(config: int) -> dict:
+            if config == 6:
+                return run_corpus()
             n = N_FRAMES if config in (1, 2) else min(N_FRAMES,
                                                       N_MODEL_FRAMES)
 
