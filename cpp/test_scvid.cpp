@@ -13,6 +13,7 @@
 #include <string>
 #include <vector>
 #include <algorithm>
+#include <thread>
 
 #include "scvid_api.h"
 
@@ -251,6 +252,49 @@ int main() {
       }
       CHECK(pids_ok, "pts-matched frames carry the right content");
     }
+    // --- concurrent decoders (the engine's loader-thread model) ---------
+    // N loader threads each own a decoder and decode overlapping frame
+    // sets of the SAME stream concurrently — the GIL-free concurrency the
+    // Python engine relies on.  Run under `make tsan` to prove the
+    // library has no data races across handles (thread_local error
+    // state, no shared mutable globals).
+    {
+      const int NT = 4;
+      std::vector<std::thread> threads;
+      std::vector<int> oks(NT, 0);
+      for (int t = 0; t < NT; ++t) {
+        threads.emplace_back([&, t]() {
+          ScvidDecoder* d = scvid_decoder_create(
+              "h264", bidx->extradata, bidx->extradata_size, W, H, 1);
+          if (!d) return;
+          std::vector<uint8_t> out((size_t)N * W * H * 3);
+          std::vector<uint8_t> want(N, 1);
+          int64_t dims[2] = {0, 0};
+          for (int rep = 0; rep < 3; ++rep) {
+            scvid_decoder_reset(d);
+            int64_t got = scvid_decode_run(
+                d, ball.data(), ball_sizes.data(), N, want.data(), N, 1,
+                out.data(), (int64_t)out.size(), dims);
+            if (got != N) { scvid_decoder_destroy(d); return; }
+            int id0 = frame_id(out.data());
+            int idt = frame_id(out.data() +
+                               (size_t)(N - 1) * W * H * 3);
+            if (id0 != (0 * 16 % 224 + 8) / 16 % 14 ||
+                idt != ((N - 1) * 16 % 224 + 8) / 16 % 14) {
+              scvid_decoder_destroy(d);
+              return;
+            }
+          }
+          scvid_decoder_destroy(d);
+          oks[t] = 1;
+        });
+      }
+      for (auto& th : threads) th.join();
+      int total = 0;
+      for (int ok : oks) total += ok;
+      CHECK(total == NT, "4 concurrent decoders on one stream all exact");
+    }
+
     scvid_decoder_destroy(bdec);
     scvid_index_free(bidx);
     remove(bmp4);
