@@ -133,7 +133,7 @@ def main():
         platform = jax.devices()[0].platform
         vid = os.path.join(root, "bench.mp4")
         scv.synthesize_video(vid, num_frames=N_FRAMES, width=W, height=H,
-                             fps=30, keyint=30)
+                             fps=30, keyint=32)
         sc = Client(db_path=os.path.join(root, "db"),
                     num_load_workers=3, num_save_workers=1)
         sc.ingest_videos([("bench", vid)])
@@ -171,7 +171,7 @@ def main():
             # the scheduler/pipeline, not the bytes
             p = os.path.join(root, "corpus.mp4")
             scv.synthesize_video(p, num_frames=N_CORPUS_FRAMES,
-                                 width=W, height=H, fps=30, keyint=30)
+                                 width=W, height=H, fps=30, keyint=32)
             names = [(f"corpus_{i}", p) for i in range(N_CORPUS_VIDEOS)]
             sc.ingest_videos(names)
 
@@ -190,7 +190,7 @@ def main():
             dt = run_once("m")
             total = N_CORPUS_VIDEOS * N_CORPUS_FRAMES
             return {"config": 6, "frames": total,
-                    "videos": N_CORPUS_VIDEOS,
+                    "videos": N_CORPUS_VIDEOS, "keyint": 32,
                     "fps": round(total / dt, 2), "platform": platform,
                     "warmup_s": round(t_warm, 2),
                     "measured_s": round(dt, 2), "reps": 1,
@@ -224,6 +224,7 @@ def main():
             dt = run_once(f"bench_{config}", n)
             d = {"config": config, "frames": n,
                  "fps": round(n / dt, 2), "platform": platform,
+                 "keyint": 32,  # round-3+: packet-aligned GOPs (was 30)
                  "warmup_frames": warm,
                  "warmup_s": round(t_warm, 2), "measured_s": round(dt, 2),
                  "reps": 1, "clock": time.strftime("%Y-%m-%dT%H:%M:%S"),

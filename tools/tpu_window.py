@@ -108,7 +108,7 @@ assert jax.devices()[0].platform == "tpu"
 N, W, H = 600, 640, 480
 vid = os.path.join(root, "bench.mp4")
 scv.synthesize_video(vid, num_frames=N, width=W, height=H, fps=30,
-                     keyint=30)
+                     keyint=32)
 sc = Client(db_path=os.path.join(root, "db"), num_load_workers=3,
             num_save_workers=1)
 sc.ingest_videos([("bench", vid)])
