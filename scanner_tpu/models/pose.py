@@ -99,13 +99,33 @@ def make_train_step(model: VideoPoseNet, optimizer=None):
 
 
 def make_sharded_train_step(mesh: Mesh, clip_shape=(8, 8, 64, 64, 3),
-                            width: int = 32):
+                            width: int = 32,
+                            attn_scheme: Optional[str] = None):
     """Build the full multi-chip training step: dp-sharded batch,
     sp-sharded time (ring attention), tp-sharded params/experts.
-    Returns (jitted_step, params, opt_state, example batch)."""
-    from ..parallel.ring_attention import make_ring_attention
-    attn = make_ring_attention(mesh, axis="sp") \
-        if mesh.shape["sp"] > 1 else None
+    Returns (jitted_step, params, opt_state, example batch).
+
+    attn_scheme selects the sequence-parallel attention: "ring"
+    (default), "pallas" (ring with the fused pallas flash kernel,
+    kernels/pallas_attention.py), or "ulysses" (all-to-all head
+    sharding); None reads SCANNER_TPU_ATTN (same values)."""
+    import os
+
+    attn = None
+    if mesh.shape["sp"] > 1:
+        scheme = attn_scheme or os.environ.get("SCANNER_TPU_ATTN", "ring")
+        if scheme not in ("ring", "pallas", "ulysses"):
+            raise ValueError(
+                f"unknown attention scheme {scheme!r}; expected "
+                "'ring', 'pallas' or 'ulysses'")
+        if scheme == "ulysses":
+            from ..parallel.ulysses import make_ulysses_attention
+            attn = make_ulysses_attention(mesh, axis="sp")
+        else:
+            from ..parallel.ring_attention import make_ring_attention
+            attn = make_ring_attention(
+                mesh, axis="sp",
+                impl="pallas" if scheme == "pallas" else "xla")
     model, params = init_params(
         jax.random.PRNGKey(0),
         clip_shape=(1,) + tuple(clip_shape[1:]), width=width,

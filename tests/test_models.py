@@ -332,3 +332,42 @@ def test_embedding_shipped_weights_recall():
     pred = sim.argmax(1)
     recall = float((pred == np.arange(8)).mean())
     assert recall >= 0.75, f"recall@1 {recall:.2f}"
+
+
+def test_attention_scheme_selection():
+    """attn_scheme (or SCANNER_TPU_ATTN) selects the sequence-parallel
+    attention for the sharded train step; all three schemes (XLA ring,
+    pallas-flash ring, Ulysses all-to-all) train to the SAME losses over
+    TWO steps from the same seed — the second step's loss depends on the
+    first step's gradients, so this pins the backward pass too (incl.
+    the pallas custom_vjp)."""
+    from scanner_tpu.kernels.pallas_attention import HAVE_PALLAS
+    from scanner_tpu.models import make_sharded_train_step
+    from scanner_tpu.parallel import auto_axes, make_mesh
+
+    schemes = ["ring", "ulysses"] + (["pallas"] if HAVE_PALLAS else [])
+    losses = {}
+    for scheme in schemes:
+        mesh = make_mesh(auto_axes(8))
+        step, params, opt_state, (clip, target) = make_sharded_train_step(
+            mesh, clip_shape=(2, 8, 32, 32, 3), width=8,
+            attn_scheme=scheme)
+        params, opt_state, l1 = step(params, opt_state, clip, target)
+        params, opt_state, l2 = step(params, opt_state, clip, target)
+        losses[scheme] = (float(l1), float(l2))
+        assert np.isfinite(losses[scheme]).all(), (scheme, losses[scheme])
+        assert losses[scheme][1] < losses[scheme][0], \
+            f"{scheme}: loss did not decrease {losses[scheme]}"
+    # rel 1e-3: schemes reduce in different orders (ppermute chain vs
+    # all-to-all vs pallas tiles), so f32 losses agree to ~1e-4 but not
+    # bitwise; a broken backward diverges by orders of magnitude more
+    for scheme in schemes[1:]:
+        assert losses[scheme][0] == pytest.approx(losses["ring"][0],
+                                                  rel=1e-3)
+        assert losses[scheme][1] == pytest.approx(losses["ring"][1],
+                                                  rel=1e-3)
+    # unknown scheme fails loudly, not silently-ring
+    with pytest.raises(ValueError, match="unknown attention scheme"):
+        make_sharded_train_step(make_mesh(auto_axes(8)),
+                                clip_shape=(2, 8, 32, 32, 3), width=8,
+                                attn_scheme="flash")
