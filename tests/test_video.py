@@ -465,3 +465,58 @@ def test_open_gop_boundary_bit_exact(tmp_db, oclip):
                         f"frame {r} (recovery point {kf_disp}) not exact"
     finally:
         auto.close()
+
+
+def test_corrupt_packet_fails_gracefully(tmp_db, tmp_path_factory):
+    """Bitstream corruption surfaces as ScannerException (reference
+    software decoder: report, don't crash) — never a hang or a silently
+    wrong frame.  The engine then fails the task; the cluster's 3-strike
+    blacklist isolates the poison stream (test_distributed.py)."""
+    from scanner_tpu.storage import metadata as md
+    from scanner_tpu.video.ingest import load_video_meta
+
+    p = str(tmp_path_factory.mktemp("vids") / "corrupt.mp4")
+    scv.synthesize_video(p, num_frames=48, width=64, height=48, fps=24,
+                         keyint=8, bframes=2)
+    scv.ingest_videos(tmp_db, [("corrupt", p)])
+    vd = load_video_meta(tmp_db, "corrupt")
+    kf = int(vd.keyframe_indices[2])
+    off, sz = int(vd.sample_offsets[kf]), int(vd.sample_sizes[kf])
+    item = md.column_item_path(tmp_db.table_descriptor("corrupt").id,
+                               "frame", 0)
+    blob = bytearray(tmp_db.backend.read(item))
+    blob[off:off + sz] = b"\x00" * sz
+    tmp_db.backend.write(item, bytes(blob))
+
+    idx = VideoIndex(vd)
+    want = int(idx.disp_of_dec[kf]) + 2  # inside the corrupted GOP
+    with pytest.raises(ScannerException):
+        scv.load_frames(tmp_db, "corrupt", [want])
+    # frames before the corrupted GOP still decode exactly
+    f = scv.load_frames(tmp_db, "corrupt", [3])
+    assert scv.frame_pattern_id(f[0]) == expected_id(3, 48, 64)
+
+
+def test_iter_frames_streaming(tmp_db, clip, monkeypatch):
+    """iter_frames yields request-order frames in chunks, reusing ONE
+    decoder handle across chunks (the client-side streaming read, hwang
+    `as_hwang` analogue)."""
+    from scanner_tpu.video import automata as A_
+    from scanner_tpu.video.ingest import iter_frames
+
+    built = []
+    orig_init = A_.DecoderAutomata.__init__
+
+    def counting_init(self, *a, **k):
+        built.append(1)
+        orig_init(self, *a, **k)
+    monkeypatch.setattr(A_.DecoderAutomata, "__init__", counting_init)
+
+    scv.ingest_videos(tmp_db, [("iterclip", clip)])
+    rows = [0, 5, 13, 12, 40, 60, 60, 89]
+    got = list(iter_frames(tmp_db, "iterclip", rows, chunk=3))
+    assert sum(built) == 1, "decoder handle not reused across chunks"
+    assert len(got) == len(rows)
+    for f, r in zip(got, rows):
+        assert scv.frame_pattern_id(f) == expected_id(r, 96, 128), r
+    assert (got[5] == got[6]).all()
