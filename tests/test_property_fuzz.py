@@ -1,0 +1,188 @@
+"""Randomized property test of exact-row scheduling.
+
+SURVEY §7 names the stencil x sampler x state row derivation the
+hardest part of the rebuild ("must be property-tested").  The example
+suite (test_engine.py / test_graph_analysis.py) pins known cases; this
+fuzz runs RANDOM transform chains through the real engine at random
+packet geometries and compares every output row against a pure-Python
+semantic oracle — composition bugs (a sampler stacked on a stencil on a
+state op at an unlucky task boundary) have nowhere to hide.
+"""
+
+import random
+import struct
+from typing import List, Optional, Sequence
+
+import pytest
+
+from scanner_tpu import (CacheMode, Client, Kernel, NamedStream,
+                         NullElement, PerfParams, register_op)
+
+N_SEEDS = 12
+
+
+def pack(v: int) -> bytes:
+    return struct.pack("<q", v)
+
+
+def unpack(b: bytes) -> int:
+    return struct.unpack("<q", b)[0]
+
+
+@register_op(name="_FzStencilSum", stencil=[-1, 0, 1])
+class _FzStencilSum(Kernel):
+    """out[i] = in[i-1] + in[i] + in[i+1] (REPEAT_EDGE at bounds)."""
+
+    def execute(self, x: Sequence[bytes]) -> bytes:
+        return pack(sum(unpack(b) for b in x))
+
+
+@register_op(name="_FzCumSum", unbounded_state=True)
+class _FzCumSum(Kernel):
+    """out[i] = sum(in[0..i]) — unbounded state, prefix recomputed per
+    task with reset at discontinuities."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.reset()
+
+    def reset(self):
+        self.acc = 0
+
+    def execute(self, x: bytes) -> bytes:
+        self.acc += unpack(x)
+        return pack(self.acc)
+
+
+# oracle: each step maps the full upstream value list (ints or None for
+# null rows) to the downstream list, mirroring engine semantics
+def _clamp(i, n):
+    return max(0, min(n - 1, i))
+
+
+def o_stencil(vals):
+    n = len(vals)
+    out = []
+    for i in range(n):
+        win = [vals[_clamp(i + k, n)] for k in (-1, 0, 1)]
+        out.append(None if any(v is None for v in win) else sum(win))
+    return out
+
+
+def o_cumsum(vals):
+    acc, out = 0, []
+    for v in vals:
+        assert v is not None
+        acc += v
+        out.append(acc)
+    return out
+
+
+def gen_chain(rng: random.Random, n0: int):
+    """Random transform chain: list of (kind, arg) + oracle values."""
+    vals: List[Optional[int]] = list(range(100, 100 + n0))
+    steps = []
+    n_ops = 0
+    has_null = False
+    for _ in range(rng.randint(2, 4)):
+        n = len(vals)
+        choices = ["stride", "range", "strided_range", "gather", "repeat"]
+        if not has_null:
+            choices += ["repeat_null"]
+        if n_ops < 2:
+            # stencil after RepeatNull exercises null-window propagation;
+            # only the STATE op is undefined over null rows
+            choices += ["stencil", "stencil"]
+            if n >= 2 and not has_null:
+                choices += ["cumsum"]
+        kind = rng.choice(choices)
+        if kind == "stride":
+            s = rng.randint(2, 4)
+            steps.append(("stride", s))
+            vals = vals[::s]
+        elif kind == "range":
+            a = rng.randint(0, n - 1)
+            b = rng.randint(a + 1, n)
+            steps.append(("range", (a, b)))
+            vals = vals[a:b]
+        elif kind == "strided_range":
+            a = rng.randint(0, n - 1)
+            b = rng.randint(a + 1, n)
+            s = rng.randint(2, 3)
+            steps.append(("strided_range", (a, b, s)))
+            vals = vals[a:b:s]
+        elif kind == "gather":
+            k = rng.randint(1, n)
+            rows = sorted(rng.sample(range(n), k))
+            steps.append(("gather", rows))
+            vals = [vals[r] for r in rows]
+        elif kind == "repeat":
+            k = rng.randint(2, 3)
+            steps.append(("repeat", k))
+            vals = [v for v in vals for _ in range(k)]
+        elif kind == "repeat_null":
+            k = rng.randint(2, 3)
+            steps.append(("repeat_null", k))
+            out: List[Optional[int]] = []
+            for v in vals:
+                out.append(v)
+                out.extend([None] * (k - 1))
+            vals = out
+            has_null = True
+        elif kind == "stencil":
+            steps.append(("stencil", None))
+            vals = o_stencil(vals)
+            n_ops += 1
+        elif kind == "cumsum":
+            steps.append(("cumsum", None))
+            vals = o_cumsum(vals)
+            n_ops += 1
+    return steps, vals
+
+
+def build_graph(sc, src_stream, steps):
+    col = sc.io.Input([src_stream])
+    for kind, arg in steps:
+        if kind == "stride":
+            col = sc.streams.Stride(col, [{"stride": arg}])
+        elif kind == "range":
+            col = sc.streams.Range(col, [arg])
+        elif kind == "strided_range":
+            col = sc.streams.StridedRange(col, [arg])
+        elif kind == "gather":
+            col = sc.streams.Gather(col, [arg])
+        elif kind == "repeat":
+            col = sc.streams.Repeat(col, [arg])
+        elif kind == "repeat_null":
+            col = sc.streams.RepeatNull(col, [arg])
+        elif kind == "stencil":
+            col = sc.ops._FzStencilSum(x=col)
+        elif kind == "cumsum":
+            col = sc.ops._FzCumSum(x=col)
+    return col
+
+
+@pytest.mark.parametrize("seed", range(N_SEEDS))
+def test_random_chain_matches_oracle(tmp_path, seed):
+    rng = random.Random(1000 + seed)
+    n0 = rng.randint(24, 60)
+    steps, expect = gen_chain(rng, n0)
+    w = rng.choice([1, 2, 3, 5])
+    io = w * rng.randint(1, 6)
+
+    sc = Client(db_path=str(tmp_path / "db"))
+    try:
+        sc.new_table("src", ["output"],
+                     [[pack(100 + i)] for i in range(n0)])
+        src = NamedStream(sc, "src")
+        out = NamedStream(sc, "out")
+        sc.run(sc.io.Output(build_graph(sc, src, steps), [out]),
+               PerfParams.manual(w, io), cache_mode=CacheMode.Overwrite,
+               show_progress=False)
+        got = [None if isinstance(r, NullElement) else unpack(r)
+               for r in out.load()]
+        assert got == expect, (
+            f"seed {seed}: chain {steps} w={w} io={io}\n"
+            f"got    {got}\nexpect {expect}")
+    finally:
+        sc.stop()
