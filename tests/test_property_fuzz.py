@@ -140,8 +140,7 @@ def gen_chain(rng: random.Random, n0: int):
     return steps, vals
 
 
-def build_graph(sc, src_stream, steps):
-    col = sc.io.Input([src_stream])
+def apply_steps(sc, col, steps):
     for kind, arg in steps:
         if kind == "stride":
             col = sc.streams.Stride(col, [{"stride": arg}])
@@ -160,6 +159,10 @@ def build_graph(sc, src_stream, steps):
         elif kind == "cumsum":
             col = sc.ops._FzCumSum(x=col)
     return col
+
+
+def build_graph(sc, src_stream, steps):
+    return apply_steps(sc, sc.io.Input([src_stream]), steps)
 
 
 @pytest.mark.parametrize("seed", range(N_SEEDS))
@@ -184,5 +187,69 @@ def test_random_chain_matches_oracle(tmp_path, seed):
         assert got == expect, (
             f"seed {seed}: chain {steps} w={w} io={io}\n"
             f"got    {got}\nexpect {expect}")
+    finally:
+        sc.stop()
+
+
+def gen_inner(rng: random.Random, groups: List[List[int]]):
+    """Random transforms INSIDE a slice: applied independently per group
+    (state resets, stencils clamp at group bounds).  Returns (steps,
+    per-group oracle outputs)."""
+    steps = []
+    n_ops = 0
+    for _ in range(rng.randint(1, 2)):
+        kind = rng.choice((["stencil", "cumsum"] if n_ops < 1 else [])
+                          + ["repeat"])
+        if kind == "stencil":
+            steps.append(("stencil", None))
+            groups = [o_stencil(g) for g in groups]
+            n_ops += 1
+        elif kind == "cumsum":
+            steps.append(("cumsum", None))
+            groups = [o_cumsum(g) for g in groups]
+            n_ops += 1
+        elif kind == "repeat":
+            k = rng.randint(2, 3)
+            steps.append(("repeat", k))
+            groups = [[v for v in g for _ in range(k)] for g in groups]
+    return steps, groups
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_slice_chain_matches_oracle(tmp_path, seed):
+    """Slice -> random per-group transforms -> Unslice: group boundaries
+    must behave as stream boundaries (stencil REPEAT_EDGE clamps at the
+    group edge, unbounded state resets per group), and Unslice must
+    stitch group outputs back in order — at random packet geometries."""
+    rng = random.Random(7000 + seed)
+    n0 = rng.randint(24, 48)
+    vals = list(range(100, 100 + n0))
+    # random contiguous partition of [0, n0) into 2-4 groups
+    n_groups = rng.randint(2, 4)
+    cuts = sorted(rng.sample(range(1, n0), n_groups - 1))
+    bounds = [0] + cuts + [n0]
+    intervals = [(bounds[i], bounds[i + 1]) for i in range(n_groups)]
+    groups = [vals[a:b] for a, b in intervals]
+    steps, groups = gen_inner(rng, groups)
+    expect = [v for g in groups for v in g]
+    w = rng.choice([1, 2, 3])
+    io = w * rng.randint(1, 5)
+
+    sc = Client(db_path=str(tmp_path / "db"))
+    try:
+        sc.new_table("src", ["output"],
+                     [[pack(100 + i)] for i in range(n0)])
+        col = sc.io.Input([NamedStream(sc, "src")])
+        col = sc.streams.Slice(col, partitions=[
+            sc.partitioner.strided_ranges(intervals, 1)])
+        col = apply_steps(sc, col, steps)
+        col = sc.streams.Unslice(col)
+        out = NamedStream(sc, "out")
+        sc.run(sc.io.Output(col, [out]), PerfParams.manual(w, io),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+        got = [unpack(r) for r in out.load()]
+        assert got == expect, (
+            f"seed {seed}: intervals {intervals} steps {steps} "
+            f"w={w} io={io}\ngot    {got}\nexpect {expect}")
     finally:
         sc.stop()
