@@ -665,3 +665,79 @@ def test_progress_task_set_codec():
     enc = Master._encode_task_set(big)
     assert enc == {0: [0, 100000]}
     assert Master._decode_task_set({}) == set()
+
+
+def test_distributed_chain_matches_oracle(cluster):
+    """The cluster path (gRPC master + 2 pull workers) must preserve
+    exact-row semantics on a sampler/stencil/state/slice composition —
+    the same oracle discipline as tests/test_property_fuzz.py, through
+    worker-side DAG re-analysis and out-of-order task completion."""
+    import struct as _struct
+
+    sc, master, workers, db_path, addr = cluster
+    n0 = 40
+
+    def pk(v):
+        return _struct.pack("<q", v)
+
+    def unpk(b):
+        return _struct.unpack("<q", b)[0]
+
+    sc.new_table("chain_src", ["output"],
+                 [[pk(100 + i)] for i in range(n0)])
+
+    # slice into [0,17) [17,40); per group: stencil sum then cumsum
+    intervals = [(0, 17), (17, 40)]
+    col = sc.io.Input([NamedStream(sc, "chain_src")])
+    col = sc.streams.Slice(col, partitions=[
+        sc.partitioner.strided_ranges(intervals, 1)])
+    col = sc.ops._DistStencilSum(x=col)
+    col = sc.ops._DistCumSum(x=col)
+    # (unslice may only feed the output op — reference invariant, so the
+    # composition ends here)
+    col = sc.streams.Unslice(col)
+    out = NamedStream(sc, "chain_out")
+    sc.run(sc.io.Output(col, [out]), PerfParams.manual(2, 4),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+
+    vals = list(range(100, 100 + n0))
+
+    def o_sten(g):
+        n = len(g)
+        return [g[max(0, i - 1)] + g[i] + g[min(n - 1, i + 1)]
+                for i in range(n)]
+
+    def o_cum(g):
+        acc, out_ = 0, []
+        for v in g:
+            acc += v
+            out_.append(acc)
+        return out_
+
+    expect = []
+    for a, b in intervals:
+        expect.extend(o_cum(o_sten(vals[a:b])))
+    got = [unpk(r) for r in out.load()]
+    assert got == expect
+
+
+@register_op(name="_DistStencilSum", stencil=[-1, 0, 1])
+class _DistStencilSum(Kernel):
+    def execute(self, x: Any) -> bytes:
+        import struct as _s
+        return _s.pack("<q", sum(_s.unpack("<q", b)[0] for b in x))
+
+
+@register_op(name="_DistCumSum", unbounded_state=True)
+class _DistCumSum(Kernel):
+    def __init__(self, config):
+        super().__init__(config)
+        self.reset()
+
+    def reset(self):
+        self.acc = 0
+
+    def execute(self, x: bytes) -> bytes:
+        import struct as _s
+        self.acc += _s.unpack("<q", x)[0]
+        return _s.pack("<q", self.acc)
