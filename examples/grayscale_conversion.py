@@ -1,0 +1,54 @@
+"""Walkthrough: stride-sample a video, resize, convert to grayscale with a
+custom per-frame op, and export the result as an mp4.  (Reference:
+examples/apps/walkthroughs/grayscale_conversion.py.)
+
+Usage: python examples/grayscale_conversion.py path/to/video.mp4 [db_path]
+"""
+
+import sys
+
+import numpy as np
+
+from scanner_tpu import (CacheMode, Client, FrameType, NamedStream,
+                         NamedVideoStream, PerfParams, register_op)
+import scanner_tpu.kernels  # registers the stdlib ops (Resize, Grayscale)
+
+
+@register_op()
+def CloneChannels(config, frame: FrameType, replications=3) -> FrameType:
+    """Replicate a (possibly single-channel) frame into N channels —
+    the walkthrough's custom-op teaching point."""
+    f = np.asarray(frame)
+    if f.ndim == 3:
+        f = f[..., 0]
+    return np.dstack([f] * replications)
+
+
+def main():
+    video_path = sys.argv[1]
+    db_path = sys.argv[2] if len(sys.argv) > 2 else "/tmp/scanner_tpu_db"
+    sc = Client(db_path=db_path)
+
+    movie = NamedVideoStream(sc, "walkthrough-clip", path=video_path)
+    frames = sc.io.Input([movie])
+    sampled = sc.streams.Stride(frames, [{"stride": 2}])
+    resized = sc.ops.Resize(frame=sampled, width=[64], height=[48])
+    gray = sc.ops.Grayscale(frame=resized)
+    gray3 = sc.ops.CloneChannels(frame=gray, replications=3)
+
+    out = NamedVideoStream(sc, "walkthrough-grayscale")
+    sc.run(sc.io.Output(gray3, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite)
+
+    mp4_path = db_path.rstrip("/") + "_grayscale.mp4"
+    out.save_mp4(mp4_path)
+    n = out.len()
+    print(f"wrote {n} grayscale frames -> {mp4_path}")
+    rows = list(out.load())
+    assert len(rows) == n
+    # grayscale: all three channels equal
+    assert np.array_equal(rows[0][..., 0], rows[0][..., 1])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""Instance segmentation app: per-frame boxes + masks over a video, using
+the shipped trained segmenter weights.  (Reference: examples/apps/detectron,
+which runs externally-trained Mask R-CNN via Caffe2 kernels; these weights
+come from scanner_tpu.models.seg_train's synthetic shape task.)
+
+Usage: python examples/instance_segmentation.py [path/to/video.mp4] [stride]
+With no video argument a synthetic shape-scene clip is generated and the
+reported masks are scored (mask IoU against the analytic ground truth).
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+from scanner_tpu import (CacheMode, Client, NamedStream, NamedVideoStream,
+                         PerfParams)
+import scanner_tpu.models  # registers InstanceSegment
+from scanner_tpu.models import paste_masks, unpack_instances
+from scanner_tpu.models.detect_train import WIDTH, box_iou
+from scanner_tpu.models.seg_train import (SIZE, full_gt_mask,
+                                          synth_shape_video)
+
+
+def main():
+    video_path = sys.argv[1] if len(sys.argv) > 1 else None
+    stride = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    truth = None
+    size = SIZE
+    if video_path is None:
+        video_path = os.path.join(tempfile.mkdtemp(prefix="seg_ex_"),
+                                  "shapes.mp4")
+        truth = synth_shape_video(video_path, num_frames=12)
+
+    sc = Client(db_path=os.path.join(tempfile.mkdtemp(prefix="seg_db_"),
+                                     "db"))
+    try:
+        movie = NamedVideoStream(sc, "seg_movie", path=video_path)
+        frames = sc.io.Input([movie])
+        sampled = sc.streams.Stride(frames, [{"stride": stride}])
+        # width 8 restores the shipped trained weights by default
+        inst = sc.ops.InstanceSegment(frame=sampled, width=WIDTH,
+                                      score_thresh=0.3)
+        out = NamedStream(sc, "instances")
+        sc.run(sc.io.Output(inst, [out]), PerfParams.estimate(),
+               cache_mode=CacheMode.Overwrite)
+
+        matched = total = 0
+        ious = []
+        for i, row in enumerate(out.load()):
+            r = unpack_instances(row)
+            boxes, scores, masks = r["boxes"], r["scores"], r["masks"]
+            if i < 4:
+                descr = ", ".join(
+                    f"[{b[0]:.2f} {b[1]:.2f} {b[2]:.2f} {b[3]:.2f}]@"
+                    f"{s:.2f} fill={m.mean():.2f}"
+                    for b, s, m in zip(boxes[:3], scores[:3], masks[:3]))
+                print(f"frame {i * stride}: {len(boxes)} instances  {descr}")
+            if truth is None:
+                continue
+            gt_boxes, gt_kinds = truth[i * stride]
+            full = paste_masks(boxes, masks, size, size)
+            for gt_box, gt_kind in zip(gt_boxes, gt_kinds):
+                total += 1
+                cand = [j for j, b in enumerate(boxes)
+                        if box_iou(gt_box, b) >= 0.3]
+                if not cand:
+                    continue
+                matched += 1
+                gm = full_gt_mask(gt_box, int(gt_kind), size, size)
+                best = max((full[j] & gm).sum() / max((full[j] | gm).sum(), 1)
+                           for j in cand)
+                ious.append(best)
+        if truth is not None:
+            mean_iou = float(np.mean(ious)) if ious else 0.0
+            print(f"box recall@IoU0.3: {matched}/{total}  "
+                  f"mean mask IoU of matches: {mean_iou:.2f}")
+            assert matched >= 0.7 * total, \
+                "shipped segmenter failed to localize the synthetic shapes"
+            assert mean_iou >= 0.5, \
+                f"shipped segmenter masks too coarse (IoU {mean_iou:.2f})"
+    finally:
+        sc.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,10 +1,11 @@
 # Importing registers the model ops (PoseDetect, ObjectDetect, FaceDetect,
 # FaceEmbedding) — the analogue of the reference's scannertools model zoo.
-from . import detection, face, pose  # noqa: F401
+from . import detection, face, pose, segmentation  # noqa: F401
 from .detection import unpack_detections
 from .pose import (VideoPoseNet, init_params, make_sharded_train_step,
                    make_train_step)
+from .segmentation import paste_masks, unpack_instances
 
 __all__ = ["VideoPoseNet", "init_params", "make_sharded_train_step",
-           "make_train_step", "detection", "face", "pose",
-           "unpack_detections"]
+           "make_train_step", "detection", "face", "pose", "segmentation",
+           "unpack_detections", "unpack_instances", "paste_masks"]

@@ -114,6 +114,27 @@ def batched_nms(boxes, scores, top_k: int = 32, iou_thresh: float = 0.5):
     return jax.vmap(one_image)(boxes, scores)
 
 
+def pack_detections(cls, deltas, anchors, score_thresh: float,
+                    top_k: int = 32):
+    """The shared post-head decode contract: class logits + box deltas ->
+    packed (B, top_k, 6) rows [y1, x1, y2, x2, score, valid] plus the
+    selected boxes (B, top_k, 4).  Every detection-family kernel
+    (ObjectDetect, FaceDetect, InstanceSegment) packs through here so the
+    row layout and NMS policy cannot diverge between them."""
+    probs = jax.nn.softmax(cls, axis=-1)[..., 1:]  # drop background
+    scores = probs.max(axis=-1)
+    boxes = decode_boxes(anchors, deltas)
+    idx, ssc = batched_nms(boxes, scores, top_k=top_k)
+    sel = jnp.take_along_axis(boxes, jnp.maximum(idx, 0)[..., None],
+                              axis=1)
+    valid = ((idx >= 0) & (ssc > score_thresh)).astype(jnp.float32)
+    # packed fixed shape end to end so results stay on device
+    # (variable-length filtering happens at the consumer)
+    packed = jnp.concatenate([sel, ssc[..., None], valid[..., None]],
+                             axis=-1)
+    return packed, sel
+
+
 def unpack_detections(row) -> Dict[str, np.ndarray]:
     """Unpack one stored ObjectDetect/FaceDetect row — a (top_k, 6) array
     [y1, x1, y2, x2, score, valid] — into the classic
@@ -163,18 +184,8 @@ class ObjectDetect(Kernel):
         @jax.jit
         def infer(params, images, anchors):
             cls, deltas = self.model.apply(params, images)
-            probs = jax.nn.softmax(cls, axis=-1)[..., 1:]  # drop background
-            scores = probs.max(axis=-1)
-            boxes = decode_boxes(anchors, deltas)
-            idx, ssc = batched_nms(boxes, scores)
-            sel = jnp.take_along_axis(boxes, jnp.maximum(idx, 0)[..., None],
-                                      axis=1)
-            valid = ((idx >= 0) & (ssc > thresh)).astype(jnp.float32)
-            # packed (B, top_k, 6) [y1,x1,y2,x2,score,valid]: fixed shape
-            # end to end so results stay on device (variable-length
-            # filtering happens at the consumer via unpack_detections)
-            return jnp.concatenate(
-                [sel, ssc[..., None], valid[..., None]], axis=-1)
+            packed, _sel = pack_detections(cls, deltas, anchors, thresh)
+            return packed
 
         self._infer = infer
 

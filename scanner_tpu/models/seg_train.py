@@ -1,0 +1,249 @@
+"""Train the instance segmenter on a synthetic shape task and ship
+weights.
+
+The reference detectron app loads externally-trained Mask R-CNN weights
+(examples/apps/detectron/main.py); this framework trains its own with
+reproducible provenance, like the other model families
+(models/detect_train.py).  Task: 1..3 bright shapes — axis-aligned
+rectangles or inscribed ellipses — on a noisy dark background; the
+detector must find the boxes and the mask head must recover each shape's
+silhouette (a rectangle fills its box, an ellipse does not — the mask
+head has to actually read the pixels).
+
+Ground-truth masks are analytic: for a box and a shape kind the roi-grid
+mask is computed in closed form (`roi_gt_mask`), and full-frame masks for
+evaluation come from `full_gt_mask` — no rasterize/crop/resample chain to
+introduce label noise.
+
+`python -m scanner_tpu.models.seg_train <out_dir>` trains and exports a
+portable .npz (models/weights/seg_w8.npz ships it).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .detect_train import SIZE, WIDTH, match_anchors
+
+KIND_RECT = 0
+KIND_ELLIPSE = 1
+TRAIN_ROIS = 4          # fixed gt-roi budget per training frame
+
+
+def render_shape_scene(rng: np.random.RandomState, size: int = SIZE,
+                       max_objects: int = 3
+                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Noisy dark frame with 1..max_objects bright shapes.  Returns
+    (frame uint8 (S,S,3), boxes (N,4) unit [y1,x1,y2,x2], kinds (N,)
+    int32 — KIND_RECT or KIND_ELLIPSE)."""
+    frame = rng.randint(0, 40, (size, size, 3)).astype(np.uint8)
+    ys, xs = np.mgrid[0:size, 0:size]
+    n = rng.randint(1, max_objects + 1)
+    boxes, kinds = [], []
+    for _ in range(n):
+        h = rng.randint(12, 28)
+        w = rng.randint(12, 28)
+        y = rng.randint(0, size - h)
+        x = rng.randint(0, size - w)
+        color = rng.randint(170, 255, 3)
+        kind = int(rng.randint(0, 2))
+        if kind == KIND_RECT:
+            frame[y:y + h, x:x + w] = color
+        else:
+            cy, cx = y + h / 2, x + w / 2
+            inside = (((ys - cy) / (h / 2)) ** 2 +
+                      ((xs - cx) / (w / 2)) ** 2) <= 1.0
+            frame[inside] = color
+        boxes.append([y / size, x / size, (y + h) / size, (x + w) / size])
+        kinds.append(kind)
+    return frame, np.asarray(boxes, np.float32), np.asarray(kinds, np.int32)
+
+
+def roi_gt_mask(box: np.ndarray, kind: int, roi: np.ndarray,
+                mask_size: int) -> np.ndarray:
+    """Analytic (M, M) binary mask of a shape (gt `box` + `kind`) sampled
+    on the grid of an arbitrary `roi` (both unit corners).  Sampling on
+    the roi grid rather than the box grid lets training jitter its rois —
+    the mask head then learns the shape's actual boundary instead of
+    "fill the roi"."""
+    M = mask_size
+    c = (np.arange(M, dtype=np.float32) + 0.5) / M
+    yu = roi[0] + (roi[2] - roi[0]) * c
+    xu = roi[1] + (roi[3] - roi[1]) * c
+    y1, x1, y2, x2 = box
+    if kind == KIND_RECT:
+        iny = (yu >= y1) & (yu < y2)
+        inx = (xu >= x1) & (xu < x2)
+        return (iny[:, None] & inx[None, :]).astype(np.float32)
+    cy, cx = (y1 + y2) / 2, (x1 + x2) / 2
+    ry, rx = max((y2 - y1) / 2, 1e-6), max((x2 - x1) / 2, 1e-6)
+    dy = ((yu - cy) / ry) ** 2
+    dx = ((xu - cx) / rx) ** 2
+    return ((dy[:, None] + dx[None, :]) <= 1.0).astype(np.float32)
+
+
+def jitter_box(rng: np.random.RandomState, box: np.ndarray,
+               frac: float = 0.12) -> np.ndarray:
+    """Shift/scale a unit-coordinate box by up to ±frac of its size —
+    the training-time stand-in for imperfect detector boxes."""
+    y1, x1, y2, x2 = box
+    h, w = y2 - y1, x2 - x1
+    dy1, dy2 = rng.uniform(-frac, frac, 2) * h
+    dx1, dx2 = rng.uniform(-frac, frac, 2) * w
+    out = np.asarray([y1 + dy1, x1 + dx1, y2 + dy2, x2 + dx2], np.float32)
+    out[2] = max(out[2], out[0] + 1e-3)
+    out[3] = max(out[3], out[1] + 1e-3)
+    return np.clip(out, 0.0, 1.0)
+
+
+def full_gt_mask(box: np.ndarray, kind: int, height: int,
+                 width: int) -> np.ndarray:
+    """Full-frame (H, W) boolean mask of one ground-truth shape."""
+    y1, x1, y2, x2 = box
+    ys, xs = np.mgrid[0:height, 0:width]
+    yu = (ys + 0.5) / height
+    xu = (xs + 0.5) / width
+    in_box = (yu >= y1) & (yu < y2) & (xu >= x1) & (xu < x2)
+    if kind == KIND_RECT:
+        return in_box
+    cy, cx = (y1 + y2) / 2, (x1 + x2) / 2
+    ry, rx = (y2 - y1) / 2, (x2 - x1) / 2
+    return (((yu - cy) / max(ry, 1e-6)) ** 2 +
+            ((xu - cx) / max(rx, 1e-6)) ** 2) <= 1.0
+
+
+def synth_shape_video(path: str, num_frames: int = 16, size: int = SIZE,
+                      fps: float = 24.0, seed: int = 17):
+    """Encode a clip of independent shape scenes; returns the per-frame
+    (boxes, kinds) ground truth (crf 14 keeps silhouettes crisp)."""
+    from ..video.ingest import encode_frames_mp4
+
+    rng = np.random.RandomState(seed)
+    frames, gts = [], []
+    for _ in range(num_frames):
+        f, boxes, kinds = render_shape_scene(rng, size)
+        frames.append(f)
+        gts.append((boxes, kinds))
+    encode_frames_mp4(path, frames, size, size, fps=fps, keyint=8, crf=14)
+    return gts
+
+
+def seg_batch(rng: np.random.RandomState, batch: int, anchors: np.ndarray,
+              mask_size: int, size: int = SIZE):
+    """One training batch: (frames (B,S,S,3) u8, cls (B,N) i32,
+    deltas (B,N,4) f32, rois (B,K,4) f32, roi_masks (B,K,M,M) f32,
+    roi_valid (B,K) f32) — rois are JITTERED ground-truth boxes (the
+    Mask R-CNN training-time roi source, with detector-noise
+    augmentation), zero-padded to K=TRAIN_ROIS; mask targets are the
+    shapes resampled on each jittered roi's grid."""
+    N = anchors.shape[0]
+    K, M = TRAIN_ROIS, mask_size
+    frames = np.zeros((batch, size, size, 3), np.uint8)
+    cls = np.zeros((batch, N), np.int32)
+    deltas = np.zeros((batch, N, 4), np.float32)
+    rois = np.zeros((batch, K, 4), np.float32)
+    roi_masks = np.zeros((batch, K, M, M), np.float32)
+    roi_valid = np.zeros((batch, K), np.float32)
+    for b in range(batch):
+        frames[b], boxes, kinds = render_shape_scene(rng, size)
+        cls[b], deltas[b] = match_anchors(anchors, boxes)
+        for k in range(min(len(boxes), K)):
+            roi = jitter_box(rng, boxes[k])
+            rois[b, k] = roi
+            roi_masks[b, k] = roi_gt_mask(boxes[k], int(kinds[k]), roi, M)
+            roi_valid[b, k] = 1.0
+    return frames, cls, deltas, rois, roi_masks, roi_valid
+
+
+def train_segmenter(checkpoint_dir: str, steps: int = 400, batch: int = 4,
+                    size: int = SIZE, width: int = WIDTH, seed: int = 3,
+                    export_npz: Optional[str] = None,
+                    log_every: int = 50) -> float:
+    """Train InstanceSegmentor: SSD detection loss + per-roi mask BCE on
+    ground-truth rois.  Orbax checkpoint + optional portable .npz export;
+    returns the final loss."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ..util.log import get_logger
+    from .checkpoint import TrainCheckpointer, export_params_npz
+    from .detection import make_anchors
+    from .segmentation import MASK_SIZE, InstanceSegmentor
+
+    log = get_logger("train")
+    fh = fw = -(-size // 16)
+    anchors_np = make_anchors(fh, fw)
+
+    model = InstanceSegmentor(num_classes=2, width=width)
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, size, size, 3), jnp.uint8))
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    def loss_fn(p, frames, cls_t, box_t, rois, masks_t, roi_valid):
+        logits, deltas, mask_logits = model.apply(p, frames, rois)
+        valid = (cls_t >= 0)
+        pos = (cls_t == 1)
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits, jnp.maximum(cls_t, 0))
+        w = jnp.where(pos, 10.0, 1.0) * valid
+        cls_loss = (ce * w).sum() / jnp.maximum(w.sum(), 1.0)
+        hub = optax.huber_loss(deltas, box_t).sum(-1)
+        box_loss = (hub * pos).sum() / jnp.maximum(pos.sum(), 1.0)
+        bce = optax.sigmoid_binary_cross_entropy(
+            mask_logits, masks_t).mean(axis=(-2, -1))
+        mask_loss = (bce * roi_valid).sum() / \
+            jnp.maximum(roi_valid.sum(), 1.0)
+        # masks are the op's raison d'etre — keep their gradient from
+        # being drowned by the dense anchor losses
+        return cls_loss + box_loss + 2.0 * mask_loss
+
+    @jax.jit
+    def step_fn(p, s, *batch_args):
+        loss, grads = jax.value_and_grad(loss_fn)(p, *batch_args)
+        updates, s = opt.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    rng = np.random.RandomState(seed)
+    loss = float("nan")
+    for i in range(steps):
+        args = seg_batch(rng, batch, anchors_np, MASK_SIZE, size)
+        params, opt_state, loss = step_fn(params, opt_state, *args)
+        if log_every and (i + 1) % log_every == 0:
+            log.info("seg_train step %d/%d loss=%.5f", i + 1, steps,
+                     float(loss))
+    ckpt = TrainCheckpointer(checkpoint_dir)
+    try:
+        ckpt.save(steps, params, opt_state)
+    finally:
+        ckpt.close()
+    if export_npz:
+        export_params_npz(params, export_npz)
+    return float(loss)
+
+
+def main(argv: Optional[list] = None) -> None:
+    import argparse
+    import os
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("out_dir")
+    ap.add_argument("--steps", type=int, default=400)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend before first backend use")
+    args = ap.parse_args(argv)
+    if args.cpu:
+        from ..util.jaxenv import force_cpu_platform
+        force_cpu_platform()
+    os.makedirs(args.out_dir, exist_ok=True)
+    loss = train_segmenter(
+        os.path.join(args.out_dir, "seg_ckpt"), steps=args.steps,
+        export_npz=os.path.join(args.out_dir, f"seg_w{WIDTH}.npz"))
+    print(f"seg: final loss {loss:.5f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -31,12 +31,15 @@ EXAMPLES = [
     "shot_detection.py",
     "object_detection.py",
     "face_detection.py",
+    "instance_segmentation.py",
+    "grayscale_conversion.py",
 ]
 
 # examples that run with NO arguments: they build their own inputs
 # (synthesized scene videos with recall assertions, or a packed binary
 # container) and assert results internally
 SELF_CONTAINED = {"object_detection.py", "face_detection.py",
+                  "instance_segmentation.py",
                   "10_native_source_sink.py"}
 
 

@@ -145,6 +145,79 @@ print("TRACE_SUMMARY " + json.dumps(summary))
 sc.stop()
 """
 
+# Config 3 (pose) ran at 11 fps on capture 2 — far below what the chip's
+# matmul rate predicts.  This step attributes its wall per stage AND
+# isolates the on-device model cost (forced completion) so the next
+# healthy window answers whether the gap is decode, h2d, dispatch
+# granularity, or the model itself.
+_TRACE_POSE = r"""
+import json, os, shutil, tempfile, time
+import atexit
+import numpy as np
+root = tempfile.mkdtemp(prefix="scpose_")
+atexit.register(lambda: shutil.rmtree(root, ignore_errors=True))
+from scanner_tpu import (CacheMode, Client, NamedStream, NamedVideoStream,
+                         PerfParams)
+import scanner_tpu.models
+from scanner_tpu import video as scv
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform == "tpu"
+summary = {}
+
+# on-device model microbench: PoseDetect width-8 infer on resident frames
+from scanner_tpu.graph.ops import registry, KernelConfig
+from scanner_tpu.common import DeviceType
+cfg = KernelConfig(device=DeviceType.TPU, devices=[jax.devices()[0]])
+kern = registry.get("PoseDetect").kernel_factory(cfg, width=8)
+imgs = jax.device_put(np.random.randint(0, 255, (16, 480, 640, 3),
+                                        dtype=np.uint8))
+out = kern.execute(imgs)
+_ = np.asarray(jax.device_get(jnp.sum(jnp.asarray(out))))
+t0 = time.time()
+reps = 10
+acc = None
+for _i in range(reps):
+    r = jnp.sum(jnp.asarray(kern.execute(imgs)))
+    acc = r if acc is None else acc + r
+_ = float(jax.device_get(acc))
+dt = (time.time() - t0) / reps
+summary["model_fps_resident"] = round(16 / dt, 1)
+
+N, W, H = 128, 640, 480
+vid = os.path.join(root, "bench.mp4")
+scv.synthesize_video(vid, num_frames=N, width=W, height=H, fps=30,
+                     keyint=32)
+sc = Client(db_path=os.path.join(root, "db"), num_load_workers=3,
+            num_save_workers=1)
+sc.ingest_videos([("bench", vid)])
+
+def run(name):
+    frames = sc.io.Input([NamedVideoStream(sc, "bench")])
+    ranged = sc.streams.Range(frames, [(0, N)])
+    out = NamedStream(sc, name)
+    t0 = time.time()
+    job = sc.run(sc.io.Output(sc.ops.PoseDetect(frame=ranged, width=8),
+                              [out]),
+                 PerfParams.manual(32, 96), cache_mode=CacheMode.Overwrite,
+                 show_progress=False)
+    return job, time.time() - t0
+
+run("warm")
+job, dt = run("meas")
+prof = sc.get_profile(job)
+prof.write_trace("PERF_TRACE_POSE_TPU.json")
+stats = prof.statistics()
+summary.update({
+    "fps": round(N / dt, 1), "wall_s": round(dt, 2),
+    "load_total_s": round(stats.get("load", {}).get("total_s", 0.0), 2),
+    "evaluate_total_s": round(
+        stats.get("evaluate", {}).get("total_s", 0.0), 2),
+    "save_total_s": round(stats.get("save", {}).get("total_s", 0.0), 2),
+})
+print("POSE_TRACE " + json.dumps(summary))
+sc.stop()
+"""
+
 
 def tunnel_up() -> bool:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -187,6 +260,9 @@ def main() -> int:
     results["overlap_trace"] = run_step(
         "profiled pipeline trace", code=_TRACE_RUN,
         timeout=900, marker="TRACE_SUMMARY ")
+    results["pose_trace"] = run_step(
+        "pose config stage attribution", code=_TRACE_POSE,
+        timeout=900, marker="POSE_TRACE ")
     results["finished_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
     history = []
     if os.path.exists(OUT):
