@@ -27,6 +27,47 @@ from .nets import Backbone, DeconvHead, TemporalBlock
 NUM_KEYPOINTS = 17
 
 
+class PipelinedTemporalStack(nn.Module):
+    """The temporal trunk as an in-program pipeline: one TemporalBlock's
+    parameter structure repeated `num_stages` times, stacked on a leading
+    axis sharded over the mesh's 'pp' ranks, executed with the GPipe
+    microbatch schedule (parallel/pp.py).  Each pp rank holds exactly one
+    stage's weights — the HBM-scaling path when the trunk outgrows a
+    chip.  Stages are collective-free, so sp must be 1 (dp/tp compose)."""
+
+    mesh: Any
+    num_stages: int
+    num_microbatches: int = 2
+    dtype: Any = jnp.bfloat16
+    # forwarded to every stage's TemporalBlock; must be collective-free
+    # (stages run inside shard_map — a mesh-collective attention like
+    # ring/ulysses cannot nest here, which is why pp requires sp == 1)
+    attn_fn: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, tokens):
+        from ..parallel.pp import make_pipeline, stack_stage_params
+
+        blk = TemporalBlock(dtype=self.dtype, attn_fn=self.attn_fn)
+
+        def init_stages(rng):
+            keys = jax.random.split(rng, self.num_stages)
+            return stack_stage_params(
+                [blk.init(k, tokens[:1]) for k in keys])
+
+        stacked = self.param("stages", init_stages)
+        if self.is_initializing():
+            # init only creates params; the schedule needs the real
+            # (dp-sharded, microbatchable) batch geometry — run one stage
+            # unpipelined for output shape/dtype
+            return blk.apply(
+                jax.tree_util.tree_map(lambda a: a[0], stacked), tokens)
+        pipe = make_pipeline(self.mesh,
+                             lambda p, x: blk.apply(p, x),
+                             num_microbatches=self.num_microbatches)
+        return pipe(stacked, tokens)
+
+
 class VideoPoseNet(nn.Module):
     """(B, T, H, W, 3) uint8 clip -> (B, T, H/4, W/4, K) heatmaps."""
 
@@ -35,6 +76,10 @@ class VideoPoseNet(nn.Module):
     keypoints: int = NUM_KEYPOINTS
     dtype: Any = jnp.bfloat16
     attn_fn: Optional[Any] = None
+    # a mesh with a 'pp' axis pipelines the temporal trunk over its
+    # stages (PipelinedTemporalStack); None keeps the in-module stack
+    pipeline_mesh: Optional[Any] = None
+    pipeline_microbatches: int = 2
 
     @nn.compact
     def __call__(self, clip):
@@ -44,9 +89,16 @@ class VideoPoseNet(nn.Module):
         _, fh, fw, C = feat.shape
         # clip-level context: GAP tokens mixed across time
         tokens = feat.mean(axis=(1, 2)).reshape(B, T, C)
-        for _ in range(self.temporal_layers):
-            tokens = TemporalBlock(dtype=self.dtype,
-                                   attn_fn=self.attn_fn)(tokens)
+        if self.pipeline_mesh is not None:
+            tokens = PipelinedTemporalStack(
+                mesh=self.pipeline_mesh,
+                num_stages=self.temporal_layers,
+                num_microbatches=self.pipeline_microbatches,
+                dtype=self.dtype, attn_fn=self.attn_fn)(tokens)
+        else:
+            for _ in range(self.temporal_layers):
+                tokens = TemporalBlock(dtype=self.dtype,
+                                       attn_fn=self.attn_fn)(tokens)
         # FiLM-style broadcast of temporal context back onto spatial maps
         scale = nn.Dense(C, dtype=self.dtype, name="film")(tokens)
         feat = feat.reshape(B, T, fh, fw, C)
@@ -65,11 +117,17 @@ def init_params(rng, clip_shape=(1, 4, 128, 128, 3), **kw):
 
 def param_shardings(params, mesh: Mesh):
     """tp-shard the big tensors: dense/conv kernels on their output
-    channel, MoE expert tensors on the expert dim; everything else
-    replicated.  GSPMD propagates the rest."""
+    channel, MoE expert tensors on the expert dim; pipelined stage stacks
+    on 'pp'; everything else replicated.  GSPMD propagates the rest."""
+    has_pp = "pp" in mesh.axis_names and mesh.shape["pp"] > 1
+
     def spec_for(path, x):
         name = "/".join(str(p.key) for p in path
                         if hasattr(p, "key"))
+        if has_pp and "stages" in name:
+            # pipeline stages: each pp rank holds its own stage's weights
+            return NamedSharding(
+                mesh, P(*(("pp",) + (None,) * (x.ndim - 1))))
         if ("w1" in name or "w2" in name) and x.ndim == 3:
             # MoE experts: expert-parallel over 'tp'
             return NamedSharding(mesh, P("tp", None, None))
@@ -108,10 +166,20 @@ def make_sharded_train_step(mesh: Mesh, clip_shape=(8, 8, 64, 64, 3),
     attn_scheme selects the sequence-parallel attention: "ring"
     (default), "pallas" (ring with the fused pallas flash kernel,
     kernels/pallas_attention.py), or "ulysses" (all-to-all head
-    sharding); None reads SCANNER_TPU_ATTN (same values)."""
+    sharding); None reads SCANNER_TPU_ATTN (same values).
+
+    A mesh with a 'pp' axis > 1 pipelines the temporal trunk over its
+    stages (PipelinedTemporalStack / parallel/pp.py).  Pipeline stages
+    are collective-free, so pp requires sp == 1 (dp and tp compose)."""
     import os
 
     attn = None
+    pp = int(mesh.shape.get("pp", 1)) if "pp" in mesh.axis_names else 1
+    if pp > 1 and mesh.shape["sp"] > 1:
+        raise ValueError(
+            "pp > 1 requires sp == 1: pipeline stages are "
+            "collective-free, so sequence-parallel attention cannot run "
+            "inside a stage")
     if mesh.shape["sp"] > 1:
         scheme = attn_scheme or os.environ.get("SCANNER_TPU_ATTN", "ring")
         if scheme not in ("ring", "pallas", "ulysses"):
@@ -126,10 +194,13 @@ def make_sharded_train_step(mesh: Mesh, clip_shape=(8, 8, 64, 64, 3),
             attn = make_ring_attention(
                 mesh, axis="sp",
                 impl="pallas" if scheme == "pallas" else "xla")
+    kw = {}
+    if pp > 1:
+        kw = {"pipeline_mesh": mesh, "temporal_layers": pp}
     model, params = init_params(
         jax.random.PRNGKey(0),
         clip_shape=(1,) + tuple(clip_shape[1:]), width=width,
-        attn_fn=attn)
+        attn_fn=attn, **kw)
     opt, step = make_train_step(model)
     p_shard = param_shardings(params, mesh)
     params = jax.device_put(params, p_shard)

@@ -1,5 +1,6 @@
 from .mesh import AXIS_ORDER, auto_axes, make_mesh, shard_batch, sharding
 from .halo import sharded_stencil_map, temporal_diff
+from .pp import make_pipeline, stack_stage_params
 from .ring_attention import make_ring_attention, reference_attention
 from .ulysses import make_ulysses_attention
 from .distributed import (CoordinatorConfig, host_local_array,
@@ -7,7 +8,8 @@ from .distributed import (CoordinatorConfig, host_local_array,
 
 __all__ = [
     "AXIS_ORDER", "auto_axes", "make_mesh", "shard_batch", "sharding",
-    "sharded_stencil_map", "temporal_diff", "make_ring_attention",
+    "sharded_stencil_map", "temporal_diff", "make_pipeline",
+    "stack_stage_params", "make_ring_attention",
     "make_ulysses_attention", "reference_attention",
     "CoordinatorConfig", "host_local_array", "initialize",
     "is_initialized", "replicate_to_global",

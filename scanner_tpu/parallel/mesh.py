@@ -7,8 +7,10 @@ inserting ICI collectives.  These helpers standardize mesh construction and
 axis conventions across the framework:
 
     dp — data/batch parallel        sp — sequence/context parallel
-    tp — tensor/model parallel      (pp is intentionally absent: the
-                                     engine's task pipeline plays that role)
+    tp — tensor/model parallel      pp — in-program pipeline parallel
+                                         (parallel/pp.py; the engine's
+                                         task pipeline covers the
+                                         inter-node case)
 """
 
 from __future__ import annotations
@@ -27,15 +29,18 @@ def make_mesh(axes: Optional[Dict[str, int]] = None,
               devices: Optional[Sequence] = None) -> Mesh:
     """Build a Mesh over `devices` (default: all) with the given axis
     sizes; missing axes get size 1, and a single unconstrained axis absorbs
-    the remaining device count."""
+    the remaining device count.  A 'pp' axis (pipeline stages,
+    parallel/pp.py) is appended only when requested so existing dp/sp/tp
+    meshes keep their rank."""
     if devices is None:
         devices = jax.devices()
     axes = dict(axes or {})
-    unknown = set(axes) - set(AXIS_ORDER)
+    order = AXIS_ORDER + ("pp",) if "pp" in axes else AXIS_ORDER
+    unknown = set(axes) - set(order)
     if unknown:
         raise ValueError(
-            f"unknown mesh axes {sorted(unknown)}; valid: {AXIS_ORDER}")
-    sizes = [axes.get(a, 0) for a in AXIS_ORDER]
+            f"unknown mesh axes {sorted(unknown)}; valid: {order}")
+    sizes = [axes.get(a, 0) for a in order]
     known = [s for s in sizes if s > 0]
     prod = math.prod(known) if known else 1
     if 0 not in sizes and prod <= len(devices):
@@ -61,10 +66,10 @@ def make_mesh(axes: Optional[Dict[str, int]] = None,
         sizes = fixed
     if math.prod(sizes) != n:
         raise ValueError(
-            f"mesh axes {dict(zip(AXIS_ORDER, sizes))} need "
+            f"mesh axes {dict(zip(order, sizes))} need "
             f"{math.prod(sizes)} devices, have {n}")
     dev_array = np.asarray(devices).reshape(sizes)
-    return Mesh(dev_array, AXIS_ORDER)
+    return Mesh(dev_array, order)
 
 
 def auto_axes(n: int) -> Dict[str, int]:
