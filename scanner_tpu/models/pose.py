@@ -117,9 +117,14 @@ def init_params(rng, clip_shape=(1, 4, 128, 128, 3), **kw):
 
 def param_shardings(params, mesh: Mesh):
     """tp-shard the big tensors: dense/conv kernels on their output
-    channel, MoE expert tensors on the expert dim; pipelined stage stacks
-    on 'pp'; everything else replicated.  GSPMD propagates the rest."""
+    channel, MoE expert tensors on the expert dim — over a dedicated
+    'ep' axis when the mesh has one, else folded onto 'tp'; pipelined
+    stage stacks on 'pp'; everything else replicated.  GSPMD propagates
+    the rest (per-expert matmuls shard with their weights; the routed
+    sum over experts becomes an all-reduce over the expert axis)."""
     has_pp = "pp" in mesh.axis_names and mesh.shape["pp"] > 1
+    expert_axis = "ep" if ("ep" in mesh.axis_names
+                           and mesh.shape["ep"] > 1) else "tp"
 
     def spec_for(path, x):
         name = "/".join(str(p.key) for p in path
@@ -128,9 +133,9 @@ def param_shardings(params, mesh: Mesh):
             # pipeline stages: each pp rank holds its own stage's weights
             return NamedSharding(
                 mesh, P(*(("pp",) + (None,) * (x.ndim - 1))))
-        if ("w1" in name or "w2" in name) and x.ndim == 3:
-            # MoE experts: expert-parallel over 'tp'
-            return NamedSharding(mesh, P("tp", None, None))
+        if ("w1" in name or "w2" in name) and x.ndim == 3 \
+                and x.shape[0] % mesh.shape[expert_axis] == 0:
+            return NamedSharding(mesh, P(expert_axis, None, None))
         if x.ndim == 2 and x.shape[1] % mesh.shape["tp"] == 0:
             return NamedSharding(mesh, P(None, "tp"))
         if x.ndim == 4 and x.shape[3] % mesh.shape["tp"] == 0:

@@ -29,13 +29,15 @@ def make_mesh(axes: Optional[Dict[str, int]] = None,
               devices: Optional[Sequence] = None) -> Mesh:
     """Build a Mesh over `devices` (default: all) with the given axis
     sizes; missing axes get size 1, and a single unconstrained axis absorbs
-    the remaining device count.  A 'pp' axis (pipeline stages,
-    parallel/pp.py) is appended only when requested so existing dp/sp/tp
-    meshes keep their rank."""
+    the remaining device count.  Optional axes — 'pp' (pipeline stages,
+    parallel/pp.py) and 'ep' (MoE expert parallelism; expert tensors and
+    their per-expert compute shard over it, models/pose.py
+    param_shardings) — are appended only when requested so existing
+    dp/sp/tp meshes keep their rank."""
     if devices is None:
         devices = jax.devices()
     axes = dict(axes or {})
-    order = AXIS_ORDER + ("pp",) if "pp" in axes else AXIS_ORDER
+    order = AXIS_ORDER + tuple(a for a in ("pp", "ep") if a in axes)
     unknown = set(axes) - set(order)
     if unknown:
         raise ValueError(
