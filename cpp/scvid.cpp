@@ -537,6 +537,16 @@ SCVID_API ScvidEncoder* scvid_encoder_create(int32_t width, int32_t height,
     }
     if (!params.empty())
       av_opt_set(ctx->priv_data, "x264-params", params.c_str(), 0);
+  } else if (strcmp(codec_name, "libx265") == 0) {
+    av_opt_set(ctx->priv_data, "preset", "veryfast", 0);
+    // mirror the x264 knob semantics so fixtures behave the same across
+    // codecs: crf honored, open_gop explicit, deterministic B pattern
+    std::string params = "log-level=error";
+    if (bitrate <= 0)
+      params += ":crf=" + std::to_string(crf > 0 ? crf : 23);
+    params += open_gop ? ":open-gop=1" : ":open-gop=0";
+    if (bframes > 0) params += ":b-adapt=0:scenecut=0";
+    av_opt_set(ctx->priv_data, "x265-params", params.c_str(), 0);
   }
   int err = avcodec_open2(ctx, codec, nullptr);
   if (err < 0) {
@@ -573,6 +583,14 @@ SCVID_API int64_t scvid_encoder_extradata(ScvidEncoder* e, uint8_t* buf,
   if (buf && bufsize >= e->ctx->extradata_size)
     memcpy(buf, e->ctx->extradata, e->ctx->extradata_size);
   return e->ctx->extradata_size;
+}
+
+// The container-level codec descriptor of this encoder's output ("h264",
+// "hevc", ...) — the authoritative name for scvid_mp4_write / the ingest
+// index, so callers never maintain an encoder-name -> descriptor map.
+SCVID_API const char* scvid_encoder_descriptor(ScvidEncoder* e) {
+  const AVCodecDescriptor* d = avcodec_descriptor_get(e->ctx->codec_id);
+  return d ? d->name : "";
 }
 
 namespace {

@@ -58,6 +58,7 @@ ScvidEncoder* scvid_encoder_create(int32_t width, int32_t height,
 void scvid_encoder_destroy(ScvidEncoder* e);
 int64_t scvid_encoder_extradata(ScvidEncoder* e, uint8_t* buf,
                                 int64_t bufsize);
+const char* scvid_encoder_descriptor(ScvidEncoder* e);
 int32_t scvid_encoder_feed(ScvidEncoder* e, const uint8_t* rgb,
                            int64_t n_frames);
 int32_t scvid_encoder_feed_pts(ScvidEncoder* e, const uint8_t* rgb,

@@ -285,7 +285,7 @@ def encode_frames_mp4(path: str, frames, width: int, height: int,
                       fps: float = 24.0, keyint: int = 12,
                       crf: int = 18, bframes: int = 0,
                       open_gop: bool = False,
-                      frame_pts=None) -> None:
+                      frame_pts=None, codec: str = "libx264") -> None:
     """Encode an iterable of (H, W, 3) uint8 frames to an .mp4.
 
     bframes>0 produces a reordered (pts!=dts) stream like real-world
@@ -293,9 +293,14 @@ def encode_frames_mp4(path: str, frames, width: int, height: int,
     keyframes (leading B frames reference across GOP boundaries);
     frame_pts (iterable of int, 1/fps ticks, strictly increasing)
     produces a variable-frame-rate stream — the three fixture knobs for
-    real-world-stream decode tests."""
+    real-world-stream decode tests.  `codec` is any libavcodec encoder
+    name (libx264 default; libx265/mpeg4/... produce fixtures for the
+    codec-agnostic ingest/decode path — the container records the
+    encoder's own descriptor, so unmapped names cannot mislabel the
+    stream).  crf and open_gop are honored for libx264 and libx265;
+    other encoders use their libavcodec defaults."""
     enc = lib.Encoder(width, height, fps=fps, keyint=keyint, crf=crf,
-                      bframes=bframes, open_gop=open_gop)
+                      bframes=bframes, open_gop=open_gop, codec=codec)
     if frame_pts is None:
         for frame in frames:
             enc.feed(frame)
@@ -304,8 +309,8 @@ def encode_frames_mp4(path: str, frames, width: int, height: int,
             enc.feed(frame, pts=np.asarray([p], np.int64))
     enc.flush()
     data, sizes, keys, pts, dts = enc.take_packets()
-    lib.write_mp4(path, width, height, fps, "h264", enc.extradata, data,
-                  sizes, keys, pts, dts)
+    lib.write_mp4(path, width, height, fps, enc.descriptor, enc.extradata,
+                  data, sizes, keys, pts, dts)
     enc.close()
 
 

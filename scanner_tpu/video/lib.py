@@ -99,6 +99,8 @@ def get_lib():
         lib.scvid_encoder_extradata.restype = C.c_int64
         lib.scvid_encoder_extradata.argtypes = [C.c_void_p, C.c_void_p,
                                                 C.c_int64]
+        lib.scvid_encoder_descriptor.restype = C.c_char_p
+        lib.scvid_encoder_descriptor.argtypes = [C.c_void_p]
         lib.scvid_encoder_feed.restype = C.c_int32
         lib.scvid_encoder_feed.argtypes = [C.c_void_p, C.c_void_p, C.c_int64]
         lib.scvid_encoder_feed_pts.restype = C.c_int32
@@ -279,6 +281,13 @@ class Encoder:
         buf = C.create_string_buffer(n)
         self._lib.scvid_encoder_extradata(self._h, buf, n)
         return buf.raw
+
+    @property
+    def descriptor(self) -> str:
+        """Container-level codec descriptor of this encoder's output
+        ("h264", "hevc", ...) — the name write_mp4 and the ingest index
+        agree on, straight from libavcodec (no name mapping)."""
+        return self._lib.scvid_encoder_descriptor(self._h).decode()
 
     def feed(self, frames: np.ndarray,
              pts: Optional[np.ndarray] = None) -> None:
