@@ -15,10 +15,12 @@ Configs (BASELINE.md table):
                    (BENCH_CORPUS_VIDEOS jobs through the scheduler +
                    pipeline — the corpus-shaped workload of the north
                    star, scaled to bench time)
+  7 segment        InstanceSegment (detection + per-roi masks — the
+                   detectron-app analog)
 
 Prints ONE JSON line for the north-star metric (configs 1+3 averaged);
 per-config detail goes to stderr and BENCH_DETAIL.json.  BENCH_CONFIGS
-selects configs ("1,3" default; "all" = 1-6 incl. the corpus run);
+selects configs ("1,3" default; "all" = 1-7 incl. the corpus run);
 BENCH_FRAMES / BENCH_MODEL_FRAMES / BENCH_CORPUS_VIDEOS size the decode
 workloads.
 
@@ -74,7 +76,7 @@ N_CORPUS_FRAMES = int(os.environ.get("BENCH_CORPUS_FRAMES", "120"))
 def _configs():
     sel = os.environ.get("BENCH_CONFIGS", "1,3").strip().lower()
     if sel == "all":
-        return [1, 2, 3, 4, 5, 6]
+        return [1, 2, 3, 4, 5, 6, 7]
     picked = sorted({int(x) for x in sel.split(",") if x})
     if not picked:
         print(f"bench: empty BENCH_CONFIGS={sel!r}; using default 1,3",
@@ -160,6 +162,8 @@ def main():
                 return sc.ops.ObjectDetect(frame=frames_col, width=8)
             if config == 5:
                 return sc.ops.FaceEmbedding(frame=frames_col, width=8)
+            if config == 7:
+                return sc.ops.InstanceSegment(frame=frames_col, width=8)
             raise ValueError(config)
 
         def run_corpus() -> dict:
