@@ -1,0 +1,59 @@
+"""Reverse image search app: find where a query frame appears in a video
+by comparing per-frame color histograms.  (Reference:
+examples/apps/reverse_image_search.)
+
+Usage: python examples/reverse_image_search.py path/to/video.mp4 [db_path]
+With no query image the clip's middle frame is used as the query and the
+app asserts it finds itself (and its temporal neighborhood) first.
+"""
+
+import sys
+
+import numpy as np
+
+from scanner_tpu import (CacheMode, Client, NamedStream, NamedVideoStream,
+                         PerfParams)
+import scanner_tpu.kernels  # registers Histogram
+
+
+def hist_of_image(img: np.ndarray) -> np.ndarray:
+    """(H, W, 3) uint8 -> (3, 16) per-channel histogram, matching the
+    Histogram op's binning."""
+    return np.stack([
+        np.bincount((img[..., c].ravel() >> 4), minlength=16)
+        for c in range(3)]).astype(np.int32)
+
+
+def main():
+    video_path = sys.argv[1]
+    db_path = sys.argv[2] if len(sys.argv) > 2 else "/tmp/scanner_tpu_db"
+    sc = Client(db_path=db_path)
+
+    movie = NamedVideoStream(sc, "search-clip", path=video_path)
+    frames = sc.io.Input([movie])
+    hists = sc.ops.Histogram(frame=frames)
+    out = NamedStream(sc, "search-hists")
+    sc.run(sc.io.Output(hists, [out]), PerfParams.estimate(),
+           cache_mode=CacheMode.Overwrite)
+    table = np.stack(list(out.load())).astype(np.float64)  # (N, 3, 16)
+
+    # query: the middle frame, read back through the client frame reader
+    n = len(table)
+    query_idx = n // 2
+    query = sc.load_frames("search-clip", [query_idx])[0]
+    qh = hist_of_image(query).astype(np.float64)
+
+    # chi-squared distance, smaller = more similar
+    denom = table + qh[None] + 1e-9
+    dist = ((table - qh[None]) ** 2 / denom).sum(axis=(1, 2))
+    ranked = np.argsort(dist)
+    top = ranked[:5]
+    print("query frame:", query_idx)
+    print("best matches:", top.tolist(), "distances:",
+          [round(float(dist[i]), 2) for i in top])
+    assert top[0] == query_idx, \
+        f"query frame should match itself first (got {top[0]})"
+
+
+if __name__ == "__main__":
+    main()

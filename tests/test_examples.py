@@ -33,6 +33,9 @@ EXAMPLES = [
     "face_detection.py",
     "instance_segmentation.py",
     "grayscale_conversion.py",
+    "optical_flow.py",
+    "reverse_image_search.py",
+    "hyperlapse.py",
 ]
 
 # examples that run with NO arguments: they build their own inputs
