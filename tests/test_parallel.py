@@ -259,17 +259,22 @@ def test_multihost_failure_then_restart():
     assert losses[0] == pytest.approx(losses[1], rel=1e-6)
 
 
-def test_pipeline_matches_sequential():
+@pytest.mark.parametrize("dp,S,M,B", [
+    (2, 4, 4, 8),   # canonical: 2-way dp, 4 stages, 4 microbatches
+    (1, 2, 1, 4),   # single microbatch: schedule is all bubbles but two
+    (1, 8, 3, 6),   # deep pipeline, microbatches not a power of two
+])
+def test_pipeline_matches_sequential(dp, S, M, B):
     """The GPipe microbatch schedule (parallel/pp.py) is semantically the
     sequential stage composition: forward AND gradients agree with the
     unpipelined loop to f32 precision (bubble steps are masked, so their
-    cotangents vanish)."""
+    cotangents vanish) — across schedule shapes."""
     import jax.numpy as jnp
     from scanner_tpu.parallel import (make_mesh, make_pipeline,
                                       stack_stage_params)
 
-    mesh = make_mesh({"dp": 2, "sp": 1, "tp": 1, "pp": 4})
-    S, M, B, T, C = 4, 4, 8, 6, 16
+    mesh = make_mesh({"dp": dp, "sp": 1, "tp": 1, "pp": S})
+    T, C = 6, 16
     rng = np.random.RandomState(0)
     stage_params = [{"w": rng.randn(C, C).astype(np.float32) * 0.1,
                      "b": rng.randn(C).astype(np.float32) * 0.1}
