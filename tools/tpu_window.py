@@ -8,6 +8,9 @@ banks ALL pending hardware evidence the moment a window opens:
   3. a profiled config-1 pipeline run: Chrome trace artifact
      (PERF_TRACE_TPU.json) + stage-overlap summary — the measured
      proof that decode (load stage) overlaps device compute
+  4. pose-config stage attribution (model-resident fps + per-stage wall)
+  5. per-op device/host A/B over the kernel stdlib + model zoo
+     (tools/op_bench.py -> OP_BENCH.json)
 
 Results are appended to TPU_WINDOW.json; the trace artifact and the A/B
 numbers feed PERF.md.  Run: python tools/tpu_window.py
@@ -263,6 +266,9 @@ def main() -> int:
     results["pose_trace"] = run_step(
         "pose config stage attribution", code=_TRACE_POSE,
         timeout=900, marker="POSE_TRACE ")
+    results["op_bench"] = run_step(
+        "per-op device/host A/B (tools/op_bench.py -> OP_BENCH.json)",
+        argv=[sys.executable, "tools/op_bench.py"], timeout=1200)
     results["finished_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
     history = []
     if os.path.exists(OUT):
