@@ -400,7 +400,18 @@ class LocalExecutor:
         the pipeline continues (cluster); False/None = abort (local).
         evaluator_factory(idx, skip_fetch) -> TaskEvaluator: override to
         reuse evaluators across pipeline entries (cluster worker).
-        Returns the number of tasks fully saved."""
+        Returns the number of tasks fully saved.
+
+        SCANNER_TPU_NO_PIPELINING=1 (reference worker.cpp:140 NO_PIPELINING)
+        degrades to a single-threaded sequential loop — same semantics,
+        clean stack traces for debugging."""
+        import os
+        if os.environ.get("SCANNER_TPU_NO_PIPELINING", "0") not in \
+                ("0", "", "false"):
+            return self._run_serial(info, source, on_start, on_done,
+                                    on_eval_done, on_task_error,
+                                    evaluator_factory, close_evaluators,
+                                    show_progress, total)
         qsize = queue_size or 4
         eval_q: "queue.Queue" = queue.Queue(maxsize=qsize)
         save_q: "queue.Queue" = queue.Queue(maxsize=qsize)
@@ -562,6 +573,60 @@ class LocalExecutor:
         if errors:
             raise errors[0]
         return done_count[0]
+
+    def _run_serial(self, info: A.GraphInfo, source, on_start, on_done,
+                    on_eval_done, on_task_error, evaluator_factory,
+                    close_evaluators: bool, show_progress: bool,
+                    total: int) -> int:
+        """The NO_PIPELINING path: every stage inline on this thread."""
+        import types
+        tls = types.SimpleNamespace()
+        if evaluator_factory is not None:
+            te = evaluator_factory(0, False)
+        else:
+            te = TaskEvaluator(info, self.profiler)
+        done = 0
+        try:
+            while True:
+                w = source()
+                if w is None:
+                    break
+                if w == "wait":
+                    time.sleep(0.2)
+                    continue
+                try:
+                    self.load_task(info, w, tls)
+                    if on_start is not None and on_start(w) is False:
+                        continue  # revoked attempt
+                    with self.profiler.span("evaluate", level=0,
+                                            task=w.task_idx,
+                                            job=w.job.job_idx):
+                        w.results = te.execute_task(w.job.jr, w.plan,
+                                                    w.elements)
+                    w.elements = None
+                    if on_eval_done is not None:
+                        on_eval_done(w)
+                    with self.profiler.span("save", level=0,
+                                            task=w.task_idx,
+                                            job=w.job.job_idx):
+                        self._save_task(info, w)
+                    if on_done is not None:
+                        on_done(w)
+                except Exception as e:  # noqa: BLE001
+                    if on_task_error is not None and on_task_error(w, e):
+                        continue
+                    raise
+                done += 1
+                if show_progress:
+                    print(f"\rtasks {done}/{total}", end="", flush=True)
+        finally:
+            for auto in getattr(tls, "automata", {}).values():
+                auto.close()
+            if close_evaluators:
+                te.close()
+        if show_progress:
+            print()
+        return done
 
     # ------------------------------------------------------------------
 

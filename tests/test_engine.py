@@ -933,3 +933,27 @@ def test_estimate_aligns_io_packets_to_keyint(sc, tmp_path):
     assert p.io_packet_size % 12 == 0, p.io_packet_size
     assert p.io_packet_size % p.work_packet_size == 0
     assert len(list(out.load())) == 72
+
+
+def test_no_pipelining_env(sc, monkeypatch):
+    """SCANNER_TPU_NO_PIPELINING=1 (reference worker.cpp NO_PIPELINING)
+    serializes the pipeline onto one thread with identical results."""
+    import numpy as np
+
+    from scanner_tpu import CacheMode, NamedStream, NamedVideoStream, PerfParams
+
+    def run(name):
+        frames = sc.io.Input([NamedVideoStream(sc, "test1")])
+        hists = sc.ops.Histogram(frame=frames)
+        out = NamedStream(sc, name)
+        sc.run(sc.io.Output(hists, [out]), PerfParams.manual(8, 16),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+        return [np.asarray(r) for r in out.load()]
+
+    monkeypatch.delenv("SCANNER_TPU_NO_PIPELINING", raising=False)
+    piped = run("np_piped")
+    monkeypatch.setenv("SCANNER_TPU_NO_PIPELINING", "1")
+    serial = run("np_serial")
+    assert len(piped) == len(serial)
+    for a, b in zip(piped, serial):
+        np.testing.assert_array_equal(a, b)
