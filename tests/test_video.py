@@ -563,3 +563,61 @@ def test_non_h264_codec_ingest_and_exact_decode(tmp_path, codec, kw):
         assert err < 5.0, f"decode drifted from source ({err:.1f})"
     finally:
         auto.close()
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_decode_fuzz_random_streams_and_gathers(tmp_db, tmp_path, seed):
+    """Randomized decode-exactness fuzz: random stream shapes (GOP
+    length x B-frame depth x open-GOP x VFR x codec) against random
+    gather patterns (unsorted, with duplicates), every delivered frame
+    checked against the source pixels (codec drift bound + pattern id)
+    and gathers for identity with the sequential decode.  The
+    fixed-combo tests pin known-hard shapes; this composes them randomly
+    so GOP-boundary/reorder bugs at unlucky combinations have nowhere to
+    hide."""
+    from scanner_tpu.video.ingest import (encode_frames_mp4, frame_pattern,
+                                          frame_pattern_id, ingest_videos,
+                                          open_automata)
+
+    rng = np.random.RandomState(100 + seed)
+    n = int(rng.randint(20, 70))
+    keyint = int(rng.choice([4, 8, 12, 25]))
+    bframes = int(rng.choice([0, 1, 2, 3]))
+    open_gop = bool(rng.randint(0, 2)) and bframes > 0
+    codec = "libx265" if rng.randint(0, 2) else "libx264"
+    frame_pts = None
+    if rng.randint(0, 2):
+        # VFR: strictly increasing, irregular gaps
+        frame_pts = np.cumsum(rng.randint(1, 4, n)).tolist()
+
+    W_, H_ = 96, 64
+    frames = [frame_pattern(i, H_, W_) for i in range(n)]
+    path = str(tmp_path / "fuzz.mp4")
+    encode_frames_mp4(path, frames, W_, H_, keyint=keyint, crf=14,
+                      bframes=bframes, open_gop=open_gop,
+                      frame_pts=frame_pts, codec=codec)
+    ingest_videos(tmp_db, [("fuzz", path)])
+    auto = open_automata(tmp_db, "fuzz")
+    try:
+        seq = auto.get_frames(list(range(n)))
+        for i in range(n):
+            shape = (f"seed {seed} (keyint={keyint} b={bframes} "
+                     f"og={open_gop} vfr={frame_pts is not None} {codec})")
+            assert frame_pattern_id(seq[i]) == i % 14, (
+                f"{shape}: sequential frame {i} has wrong content")
+            # pixel-level drift bound vs the SOURCE frame: catches an
+            # off-by-full-period misdelivery the mod-14 id cannot
+            err = np.abs(seq[i].astype(int) - frames[i].astype(int)).mean()
+            assert err < 8.0, (
+                f"{shape}: frame {i} drifted {err:.1f} from source")
+        for _ in range(4):
+            rows = rng.randint(0, n, size=int(rng.randint(1, 9))).tolist()
+            got = auto.get_frames(rows)
+            for j, r in enumerate(rows):
+                np.testing.assert_array_equal(
+                    got[j], seq[r],
+                    err_msg=(f"seed {seed} gather {rows} row {r} "
+                             f"(keyint={keyint} b={bframes} og={open_gop} "
+                             f"vfr={frame_pts is not None} {codec})"))
+    finally:
+        auto.close()
