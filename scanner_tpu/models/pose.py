@@ -43,6 +43,9 @@ class PipelinedTemporalStack(nn.Module):
     # (stages run inside shard_map — a mesh-collective attention like
     # ring/ulysses cannot nest here, which is why pp requires sp == 1)
     attn_fn: Optional[Any] = None
+    # jax.checkpoint each stage call (pp is the HBM-constrained case, so
+    # the trunk must honor remat like the in-module stack does)
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens):
@@ -62,8 +65,10 @@ class PipelinedTemporalStack(nn.Module):
             # unpipelined for output shape/dtype
             return blk.apply(
                 jax.tree_util.tree_map(lambda a: a[0], stacked), tokens)
-        pipe = make_pipeline(self.mesh,
-                             lambda p, x: blk.apply(p, x),
+        stage = lambda p, x: blk.apply(p, x)  # noqa: E731
+        if self.remat:
+            stage = jax.checkpoint(stage)
+        pipe = make_pipeline(self.mesh, stage,
                              num_microbatches=self.num_microbatches)
         return pipe(stacked, tokens)
 
@@ -80,12 +85,21 @@ class VideoPoseNet(nn.Module):
     # stages (PipelinedTemporalStack); None keeps the in-module stack
     pipeline_mesh: Optional[Any] = None
     pipeline_microbatches: int = 2
+    # rematerialize the backbone + temporal blocks on the backward pass
+    # (jax.checkpoint): activations of the deepest trunk are recomputed
+    # instead of stored — the HBM/FLOPs trade for long clips at high
+    # resolution.  Same math: losses/grads match the unremat'd model.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, clip):
         B, T, H, W, _ = clip.shape
         frames = clip.reshape(B * T, H, W, 3)
-        feat = Backbone(width=self.width, dtype=self.dtype)(frames)
+        # explicit names pin the param tree to the unremat'd layout, so
+        # remat toggles freely over the same weights (incl. shipped .npz)
+        BackboneM = nn.remat(Backbone) if self.remat else Backbone
+        feat = BackboneM(width=self.width, dtype=self.dtype,
+                         name="Backbone_0")(frames)
         _, fh, fw, C = feat.shape
         # clip-level context: GAP tokens mixed across time
         tokens = feat.mean(axis=(1, 2)).reshape(B, T, C)
@@ -94,11 +108,14 @@ class VideoPoseNet(nn.Module):
                 mesh=self.pipeline_mesh,
                 num_stages=self.temporal_layers,
                 num_microbatches=self.pipeline_microbatches,
-                dtype=self.dtype, attn_fn=self.attn_fn)(tokens)
+                dtype=self.dtype, attn_fn=self.attn_fn,
+                remat=self.remat)(tokens)
         else:
-            for _ in range(self.temporal_layers):
-                tokens = TemporalBlock(dtype=self.dtype,
-                                       attn_fn=self.attn_fn)(tokens)
+            BlockM = nn.remat(TemporalBlock) if self.remat \
+                else TemporalBlock
+            for li in range(self.temporal_layers):
+                tokens = BlockM(dtype=self.dtype, attn_fn=self.attn_fn,
+                                name=f"TemporalBlock_{li}")(tokens)
         # FiLM-style broadcast of temporal context back onto spatial maps
         scale = nn.Dense(C, dtype=self.dtype, name="film")(tokens)
         feat = feat.reshape(B, T, fh, fw, C)
@@ -163,7 +180,8 @@ def make_train_step(model: VideoPoseNet, optimizer=None):
 
 def make_sharded_train_step(mesh: Mesh, clip_shape=(8, 8, 64, 64, 3),
                             width: int = 32,
-                            attn_scheme: Optional[str] = None):
+                            attn_scheme: Optional[str] = None,
+                            remat: bool = False):
     """Build the full multi-chip training step: dp-sharded batch,
     sp-sharded time (ring attention), tp-sharded params/experts.
     Returns (jitted_step, params, opt_state, example batch).
@@ -199,9 +217,9 @@ def make_sharded_train_step(mesh: Mesh, clip_shape=(8, 8, 64, 64, 3),
             attn = make_ring_attention(
                 mesh, axis="sp",
                 impl="pallas" if scheme == "pallas" else "xla")
-    kw = {}
+    kw = {"remat": remat}
     if pp > 1:
-        kw = {"pipeline_mesh": mesh, "temporal_layers": pp}
+        kw.update(pipeline_mesh=mesh, temporal_layers=pp)
     model, params = init_params(
         jax.random.PRNGKey(0),
         clip_shape=(1,) + tuple(clip_shape[1:]), width=width,
