@@ -181,7 +181,8 @@ def make_train_step(model: VideoPoseNet, optimizer=None):
 def make_sharded_train_step(mesh: Mesh, clip_shape=(8, 8, 64, 64, 3),
                             width: int = 32,
                             attn_scheme: Optional[str] = None,
-                            remat: bool = False):
+                            remat: bool = False,
+                            pipeline_microbatches: int = 2):
     """Build the full multi-chip training step: dp-sharded batch,
     sp-sharded time (ring attention), tp-sharded params/experts.
     Returns (jitted_step, params, opt_state, example batch).
@@ -193,7 +194,11 @@ def make_sharded_train_step(mesh: Mesh, clip_shape=(8, 8, 64, 64, 3),
 
     A mesh with a 'pp' axis > 1 pipelines the temporal trunk over its
     stages (PipelinedTemporalStack / parallel/pp.py).  Pipeline stages
-    are collective-free, so pp requires sp == 1 (dp and tp compose)."""
+    are collective-free, so pp requires sp == 1 (dp and tp compose).
+    `pipeline_microbatches` (M) sets the schedule's bubble fraction
+    (S-1)/(M+S-1); the per-dp-shard batch must divide by M.  remat=True
+    wraps backbone + temporal blocks (incl. pipeline stages) in
+    jax.checkpoint — recompute activations instead of storing them."""
     import os
 
     attn = None
@@ -219,7 +224,8 @@ def make_sharded_train_step(mesh: Mesh, clip_shape=(8, 8, 64, 64, 3),
                 impl="pallas" if scheme == "pallas" else "xla")
     kw = {"remat": remat}
     if pp > 1:
-        kw.update(pipeline_mesh=mesh, temporal_layers=pp)
+        kw.update(pipeline_mesh=mesh, temporal_layers=pp,
+                  pipeline_microbatches=pipeline_microbatches)
     model, params = init_params(
         jax.random.PRNGKey(0),
         clip_shape=(1,) + tuple(clip_shape[1:]), width=width,
