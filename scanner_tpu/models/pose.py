@@ -10,7 +10,6 @@ over one jax Mesh; XLA inserts all collectives.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import flax.linen as nn
