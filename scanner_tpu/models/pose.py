@@ -131,6 +131,43 @@ def init_params(rng, clip_shape=(1, 4, 128, 128, 3), **kw):
     return model, model.init(rng, clip)
 
 
+def pp_params_to_plain(params):
+    """Convert a pipeline-mesh VideoPoseNet param tree (stacked stages
+    under PipelinedTemporalStack_0/stages) to the plain serving layout
+    (TemporalBlock_i) — train with pp, serve with the engine kernels.
+    The schedule is exactly the sequential composition (parallel/pp.py),
+    so converted params produce identical outputs."""
+    p = dict(params["params"])
+    if "PipelinedTemporalStack_0" not in p:
+        return params  # already plain
+    stacked = p.pop("PipelinedTemporalStack_0")["stages"]["params"]
+    leaves = jax.tree_util.tree_leaves(stacked)
+    S = int(leaves[0].shape[0])
+    for i in range(S):
+        p[f"TemporalBlock_{i}"] = jax.tree_util.tree_map(
+            lambda a, i=i: np.asarray(a[i]), stacked)
+    return {"params": p}
+
+
+def plain_params_to_pp(params):
+    """Inverse of pp_params_to_plain: stack TemporalBlock_0..S-1 (count
+    derived from the tree) into the pipeline layout so plain-trained (or
+    shipped) weights can continue training on a pp mesh."""
+    from ..parallel.pp import stack_stage_params
+
+    p = dict(params["params"])
+    if "PipelinedTemporalStack_0" in p:
+        return params  # already pipelined
+    blocks = []
+    while f"TemporalBlock_{len(blocks)}" in p:
+        blocks.append(p.pop(f"TemporalBlock_{len(blocks)}"))
+    if not blocks:
+        raise ValueError("no TemporalBlock_i entries to stack")
+    p["PipelinedTemporalStack_0"] = {
+        "stages": {"params": stack_stage_params(blocks)}}
+    return {"params": p}
+
+
 def param_shardings(params, mesh: Mesh):
     """tp-shard the big tensors: dense/conv kernels on their output
     channel, MoE expert tensors on the expert dim — over a dedicated

@@ -501,3 +501,37 @@ def test_remat_train_step_matches():
     # accumulation order differs slightly (measured ~4e-4 rel); a broken
     # remat (wrong params/rng threading) diverges by orders more
     assert losses[True][1] == pytest.approx(losses[False][1], rel=1e-2)
+
+
+def test_pp_params_convert_to_plain_serving():
+    """Params trained on a pipeline mesh convert to the plain serving
+    layout (and back) with BIT-IDENTICAL outputs in f32 — train with pp,
+    serve with the engine kernels (pp_params_to_plain), or continue
+    training shipped plain weights on a pp mesh (plain_params_to_pp)."""
+    from scanner_tpu.models.pose import (VideoPoseNet, init_params,
+                                         pp_params_to_plain,
+                                         plain_params_to_pp)
+    from scanner_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"dp": 2, "sp": 1, "tp": 2, "pp": 2})
+    pp_model, pp_params = init_params(
+        jax.random.PRNGKey(3), clip_shape=(1, 4, 32, 32, 3), width=8,
+        pipeline_mesh=mesh, temporal_layers=2, dtype=jnp.float32)
+    clip = (np.arange(np.prod((4, 4, 32, 32, 3))) % 251) \
+        .astype(np.uint8).reshape(4, 4, 32, 32, 3)
+    pp_out = np.asarray(jax.jit(pp_model.apply)(pp_params, clip))
+
+    plain_model = VideoPoseNet(width=8, temporal_layers=2,
+                               dtype=jnp.float32)
+    plain_params = pp_params_to_plain(pp_params)
+    plain_out = np.asarray(jax.jit(plain_model.apply)(plain_params, clip))
+    np.testing.assert_array_equal(pp_out, plain_out)
+
+    back = plain_params_to_pp(plain_params)
+    back_out = np.asarray(jax.jit(pp_model.apply)(back, clip))
+    np.testing.assert_array_equal(back_out, plain_out)
+    # conversion is lossless both ways on the leaves too
+    again = pp_params_to_plain(back)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(a, b), again,
+        plain_params)
