@@ -62,3 +62,35 @@ def test_model_zoo_ops_registered():
                "ObjectDetect", "FaceDetect", "FaceEmbedding",
                "InstanceSegment"):
         registry.get(op)  # raises if unregistered
+
+
+def test_parallel_layer_surface():
+    """The TPU-native parallel layer the docs promise: mesh axes,
+    attention schemes, pipeline + halo helpers, multi-host wiring."""
+    from scanner_tpu import parallel as par
+
+    for name in ("make_mesh", "auto_axes", "shard_batch", "sharding",
+                 "make_pipeline", "stack_stage_params",
+                 "make_ring_attention", "make_ulysses_attention",
+                 "reference_attention", "sharded_stencil_map",
+                 "temporal_diff", "CoordinatorConfig", "host_local_array",
+                 "initialize", "is_initialized", "replicate_to_global"):
+        assert hasattr(par, name), f"missing parallel.{name}"
+    assert par.AXIS_ORDER == ("dp", "sp", "tp")
+
+
+def test_model_weight_utilities_surface():
+    """Weight-path utilities the guide names: shipped weights, portable
+    npz export/import, orbax checkpointing, pp layout converters."""
+    from scanner_tpu.models import checkpoint as ck
+    from scanner_tpu.models.pose import (pp_params_to_plain,
+                                         plain_params_to_pp)
+
+    for name in ("TrainCheckpointer", "load_params", "init_or_restore",
+                 "shipped_weights", "export_params_npz",
+                 "import_params_npz"):
+        assert hasattr(ck, name), f"missing checkpoint.{name}"
+    assert callable(pp_params_to_plain) and callable(plain_params_to_pp)
+    for w in ("pose_blobnet_w8.npz", "detect_ssd_w8.npz",
+              "face_ssd_w8.npz", "embed_w8.npz", "seg_w8.npz"):
+        assert ck.shipped_weights(w), f"shipped weight file missing: {w}"
