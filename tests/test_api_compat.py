@@ -2,7 +2,6 @@
 reference user ports to must exist with the documented shape.  This is
 the migration guide's executable contract."""
 
-import inspect
 
 import scanner_tpu as sp
 
