@@ -3,9 +3,10 @@
 from . import detection, face, pose, segmentation  # noqa: F401
 from .detection import unpack_detections
 from .pose import (VideoPoseNet, init_params, make_sharded_train_step,
-                   make_train_step)
+                   make_train_step, plain_params_to_pp, pp_params_to_plain)
 from .segmentation import paste_masks, unpack_instances
 
 __all__ = ["VideoPoseNet", "init_params", "make_sharded_train_step",
            "make_train_step", "detection", "face", "pose", "segmentation",
-           "unpack_detections", "unpack_instances", "paste_masks"]
+           "unpack_detections", "unpack_instances", "paste_masks",
+           "pp_params_to_plain", "plain_params_to_pp"]
