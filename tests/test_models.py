@@ -535,3 +535,31 @@ def test_pp_params_convert_to_plain_serving():
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_array_equal(a, b), again,
         plain_params)
+
+
+@pytest.mark.slow
+def test_pp_trained_weights_serve_through_engine(tmp_path, sc):
+    """The full pp workflow: one training step on a pipeline mesh ->
+    convert the stacked stages to the plain layout -> export portable
+    .npz -> PoseDetect(checkpoint_dir=...) serves it through the engine.
+    Pins that pipeline-trained weights are first-class citizens of the
+    kernel weight path."""
+    from scanner_tpu.models import (make_sharded_train_step,
+                                    pp_params_to_plain)
+    from scanner_tpu.models.checkpoint import export_params_npz
+
+    mesh = make_mesh({"dp": 2, "sp": 1, "tp": 2, "pp": 2})
+    step, params, opt_state, (clip, target) = make_sharded_train_step(
+        mesh, clip_shape=(4, 4, 64, 64, 3), width=8)
+    params, opt_state, loss = step(params, opt_state, clip, target)
+    assert np.isfinite(float(loss))
+
+    npz = str(tmp_path / "pp_trained_w8.npz")
+    export_params_npz(pp_params_to_plain(params), npz)
+
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    sampled = sc.streams.Range(frame, [(0, 4)])
+    pose = sc.ops.PoseDetect(frame=sampled, width=8, checkpoint_dir=npz)
+    rows = _run(sc, pose, "pp_pose_out")
+    assert len(rows) == 4 and rows[0].shape == (17, 3)
+    assert all(np.isfinite(np.asarray(r)).all() for r in rows)
