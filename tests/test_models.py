@@ -544,8 +544,7 @@ def test_pp_trained_weights_serve_through_engine(tmp_path, sc):
     .npz -> PoseDetect(checkpoint_dir=...) serves it through the engine.
     Pins that pipeline-trained weights are first-class citizens of the
     kernel weight path."""
-    from scanner_tpu.models import (make_sharded_train_step,
-                                    pp_params_to_plain)
+    from scanner_tpu.models import pp_params_to_plain
     from scanner_tpu.models.checkpoint import export_params_npz
 
     mesh = make_mesh({"dp": 2, "sp": 1, "tp": 2, "pp": 2})
