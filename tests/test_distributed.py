@@ -741,3 +741,29 @@ class _DistCumSum(Kernel):
         import struct as _s
         self.acc += _s.unpack("<q", x)[0]
         return _s.pack("<q", self.acc)
+
+
+def test_distributed_model_op(cluster):
+    """A model-zoo kernel (InstanceSegment, shipped trained weights)
+    through the CLUSTER path: the cloudpickled graph must carry the
+    flax kernel, workers must restore weights and pack device results,
+    and the packed rows must unpack on the client side."""
+    import numpy as np
+
+    import scanner_tpu.models  # registers InstanceSegment
+    from scanner_tpu.models import unpack_instances
+    from scanner_tpu.models.segmentation import MASK_SIZE, TOP_K
+
+    sc, master, workers, _dbp, _addr = cluster
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    ranged = sc.streams.Range(frame, [(0, 4)])
+    inst = sc.ops.InstanceSegment(frame=ranged, width=8)
+    out = NamedStream(sc, "dist_inst")
+    sc.run(sc.io.Output(inst, [out]), PerfParams.manual(2, 4),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    rows = list(out.load())
+    assert len(rows) == 4
+    a = np.asarray(rows[0])
+    assert a.shape == (TOP_K, 6 + MASK_SIZE * MASK_SIZE)
+    r = unpack_instances(rows[0])
+    assert r["masks"].dtype == bool
