@@ -748,7 +748,6 @@ def test_distributed_model_op(cluster):
     through the CLUSTER path: the cloudpickled graph must carry the
     flax kernel, workers must restore weights and pack device results,
     and the packed rows must unpack on the client side."""
-    import numpy as np
 
     import scanner_tpu.models  # registers InstanceSegment
     from scanner_tpu.models import unpack_instances
