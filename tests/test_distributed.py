@@ -766,3 +766,19 @@ def test_distributed_model_op(cluster):
     assert a.shape == (TOP_K, 6 + MASK_SIZE * MASK_SIZE)
     r = unpack_instances(rows[0])
     assert r["masks"].dtype == bool
+
+
+def test_distributed_no_pipelining(cluster, monkeypatch):
+    """SCANNER_TPU_NO_PIPELINING on a cluster worker: the serial path
+    must route the same hooks (StartedWork / EvalDone / FinishedWork) as
+    the threaded pipeline, so master bookkeeping and results match."""
+    sc, master, workers, _dbp, _addr = cluster
+    monkeypatch.setenv("SCANNER_TPU_NO_PIPELINING", "1")
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    h = sc.ops.DistHist(frame=frame)
+    out = NamedStream(sc, "dist_hist_serial")
+    sc.run(sc.io.Output(h, [out]), PerfParams.manual(4, 8),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    rows = list(out.load())
+    assert len(rows) == N_FRAMES
+    assert rows[0].shape == (3,)
