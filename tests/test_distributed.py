@@ -45,7 +45,14 @@ class DistFail(Kernel):
 
 @register_op(name="DistHist")
 class DistHist(Kernel):
+    # thread names that ran execute(), keyed for the pipelining tests:
+    # threaded pipelines run kernels on "eval-<i>" threads, the serial
+    # debug mode runs them inline on the worker's job thread
+    executed_on = []
+
     def execute(self, frame: FrameType) -> Any:
+        import threading
+        DistHist.executed_on.append(threading.current_thread().name)
         return np.asarray(frame).mean(axis=(0, 1))
 
 
@@ -69,8 +76,18 @@ def cluster(tmp_path):
     master.stop()
 
 
-def test_distributed_histogram(cluster):
+@pytest.mark.parametrize("no_pipelining", [False, True])
+def test_distributed_histogram(cluster, monkeypatch, no_pipelining):
+    """The bulk path with the threaded pipeline AND the serial debug
+    mode (SCANNER_TPU_NO_PIPELINING): identical results and master
+    bookkeeping, and the kernel-recorded thread names prove which
+    execution path actually ran."""
     sc, master, workers, _dbp, _addr = cluster
+    if no_pipelining:
+        monkeypatch.setenv("SCANNER_TPU_NO_PIPELINING", "1")
+    else:
+        monkeypatch.delenv("SCANNER_TPU_NO_PIPELINING", raising=False)
+    DistHist.executed_on.clear()
     frame = sc.io.Input([NamedVideoStream(sc, "test1")])
     h = sc.ops.DistHist(frame=frame)
     out = NamedStream(sc, "dist_hist")
@@ -81,6 +98,12 @@ def test_distributed_histogram(cluster):
     assert rows[0].shape == (3,)
     # content correct (mean R of frame 0 is 0)
     assert rows[0][0] < 3
+    assert DistHist.executed_on, "kernel never ran in-process"
+    on_eval_threads = [t.startswith("eval-") for t in DistHist.executed_on]
+    if no_pipelining:
+        assert not any(on_eval_threads), DistHist.executed_on
+    else:
+        assert all(on_eval_threads), DistHist.executed_on
 
 
 def test_distributed_multiworker_progress(cluster):
@@ -766,19 +789,3 @@ def test_distributed_model_op(cluster):
     assert a.shape == (TOP_K, 6 + MASK_SIZE * MASK_SIZE)
     r = unpack_instances(rows[0])
     assert r["masks"].dtype == bool
-
-
-def test_distributed_no_pipelining(cluster, monkeypatch):
-    """SCANNER_TPU_NO_PIPELINING on a cluster worker: the serial path
-    must route the same hooks (StartedWork / EvalDone / FinishedWork) as
-    the threaded pipeline, so master bookkeeping and results match."""
-    sc, master, workers, _dbp, _addr = cluster
-    monkeypatch.setenv("SCANNER_TPU_NO_PIPELINING", "1")
-    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
-    h = sc.ops.DistHist(frame=frame)
-    out = NamedStream(sc, "dist_hist_serial")
-    sc.run(sc.io.Output(h, [out]), PerfParams.manual(4, 8),
-           cache_mode=CacheMode.Overwrite, show_progress=False)
-    rows = list(out.load())
-    assert len(rows) == N_FRAMES
-    assert rows[0].shape == (3,)
