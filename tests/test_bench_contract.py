@@ -3,10 +3,8 @@ with metric/value/unit/vs_baseline, config selection via BENCH_CONFIGS,
 and the capture-replay path when the tunnel is down."""
 
 import importlib.util
-import io
 import json
 import os
-import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
