@@ -387,3 +387,22 @@ def test_ep_axis_train_step():
     params, opt_state, l1 = step(params, opt_state, clip, target)
     params, opt_state, l2 = step(params, opt_state, clip, target)
     assert np.isfinite(float(l1)) and float(l2) < float(l1)
+
+
+@pytest.mark.slow
+def test_pp_and_ep_axes_coexist():
+    """A mesh carrying BOTH optional axes (pp pipeline stages + ep
+    experts) compiles and optimizes: stacked stage weights take the
+    'pp' sharding (experts inside a stage ride along), and the 'ep'
+    axis idles harmlessly for the pipelined trunk while remaining
+    available to non-pipelined parts."""
+    from scanner_tpu.models import make_sharded_train_step
+    from scanner_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"dp": 2, "sp": 1, "tp": 1, "pp": 2, "ep": 2})
+    assert mesh.axis_names == ("dp", "sp", "tp", "pp", "ep")
+    step, params, opt_state, (clip, target) = make_sharded_train_step(
+        mesh, clip_shape=(4, 4, 64, 64, 3), width=16)
+    params, opt_state, l1 = step(params, opt_state, clip, target)
+    params, opt_state, l2 = step(params, opt_state, clip, target)
+    assert np.isfinite(float(l1)) and float(l2) < float(l1)
