@@ -562,3 +562,29 @@ def test_pp_trained_weights_serve_through_engine(tmp_path, sc):
     rows = _run(sc, pose, "pp_pose_out")
     assert len(rows) == 4 and rows[0].shape == (17, 3)
     assert all(np.isfinite(np.asarray(r)).all() for r in rows)
+
+
+def test_unpack_and_paste_edge_cases():
+    """Host-side mask utilities on degenerate inputs: all-invalid rows
+    unpack to empty arrays, zero boxes paste to an empty stack, and a
+    sub-pixel box still paints at least one pixel without crashing."""
+    from scanner_tpu.models import paste_masks, unpack_instances
+    from scanner_tpu.models.segmentation import MASK_SIZE, TOP_K
+
+    row = np.zeros((TOP_K, 6 + MASK_SIZE * MASK_SIZE), np.float32)
+    r = unpack_instances(row)  # every valid flag is 0
+    assert r["boxes"].shape == (0, 4)
+    assert r["scores"].shape == (0,)
+    assert r["masks"].shape == (0, MASK_SIZE, MASK_SIZE)
+
+    empty = paste_masks(r["boxes"], r["masks"], 32, 32)
+    assert empty.shape == (0, 32, 32)
+
+    boxes = np.asarray([[0.5, 0.5, 0.5001, 0.5001],   # sub-pixel
+                        [-0.2, -0.2, 1.4, 1.4]],      # out of range
+                       np.float32)
+    masks = np.ones((2, MASK_SIZE, MASK_SIZE), bool)
+    full = paste_masks(boxes, masks, 32, 32)
+    assert full.shape == (2, 32, 32)
+    assert full[0].sum() >= 1          # degenerate box still paints
+    assert full[1].all()               # clipped full-frame box covers all
