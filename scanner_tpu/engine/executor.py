@@ -594,6 +594,12 @@ class LocalExecutor:
                 if w == "wait":
                     time.sleep(0.2)
                     continue
+                # Error routing mirrors the threaded path stage by stage:
+                # load / evaluate(+on_start) / save(+on_done) failures are
+                # task failures (on_task_error may absorb them), while an
+                # on_eval_done failure — cluster bookkeeping RPC, not task
+                # work — is a pipeline error and propagates (the threaded
+                # evaluator calls it outside its per-task try).
                 try:
                     self.load_task(info, w, tls)
                     if on_start is not None and on_start(w) is False:
@@ -604,8 +610,13 @@ class LocalExecutor:
                         w.results = te.execute_task(w.job.jr, w.plan,
                                                     w.elements)
                     w.elements = None
-                    if on_eval_done is not None:
-                        on_eval_done(w)
+                except Exception as e:  # noqa: BLE001
+                    if on_task_error is not None and on_task_error(w, e):
+                        continue
+                    raise
+                if on_eval_done is not None:
+                    on_eval_done(w)
+                try:
                     with self.profiler.span("save", level=0,
                                             task=w.task_idx,
                                             job=w.job.job_idx):

@@ -218,7 +218,8 @@ def make_sharded_train_step(mesh: Mesh, clip_shape=(8, 8, 64, 64, 3),
                             width: int = 32,
                             attn_scheme: Optional[str] = None,
                             remat: bool = False,
-                            pipeline_microbatches: int = 2):
+                            pipeline_microbatches: int = 2,
+                            temporal_layers: Optional[int] = None):
     """Build the full multi-chip training step: dp-sharded batch,
     sp-sharded time (ring attention), tp-sharded params/experts.
     Returns (jitted_step, params, opt_state, example batch).
@@ -234,7 +235,12 @@ def make_sharded_train_step(mesh: Mesh, clip_shape=(8, 8, 64, 64, 3),
     `pipeline_microbatches` (M) sets the schedule's bubble fraction
     (S-1)/(M+S-1); the per-dp-shard batch must divide by M.  remat=True
     wraps backbone + temporal blocks (incl. pipeline stages) in
-    jax.checkpoint — recompute activations instead of storing them."""
+    jax.checkpoint — recompute activations instead of storing them.
+
+    On a pp mesh the temporal-trunk depth IS the pipeline depth: one
+    temporal block per stage.  Pass `temporal_layers` to assert the
+    depth you expect — a mismatch with the pp axis size raises instead
+    of silently changing the architecture with the mesh."""
     import os
 
     attn = None
@@ -260,8 +266,16 @@ def make_sharded_train_step(mesh: Mesh, clip_shape=(8, 8, 64, 64, 3),
                 impl="pallas" if scheme == "pallas" else "xla")
     kw = {"remat": remat}
     if pp > 1:
+        if temporal_layers is not None and temporal_layers != pp:
+            raise ValueError(
+                f"temporal_layers={temporal_layers} but the mesh's pp axis "
+                f"has {pp} stages; the pipelined trunk runs exactly one "
+                "temporal block per stage, so the two must be equal "
+                "(resize the pp axis or drop the argument)")
         kw.update(pipeline_mesh=mesh, temporal_layers=pp,
                   pipeline_microbatches=pipeline_microbatches)
+    elif temporal_layers is not None:
+        kw.update(temporal_layers=temporal_layers)
     model, params = init_params(
         jax.random.PRNGKey(0),
         clip_shape=(1,) + tuple(clip_shape[1:]), width=width,

@@ -346,6 +346,14 @@ def test_pp_train_step_full_model():
                                            "pp": 2}),
                                 clip_shape=(4, 4, 64, 64, 3), width=16)
 
+    # the pipelined trunk depth IS the pp size; an explicit mismatching
+    # temporal_layers must raise, not silently reshape the architecture
+    with pytest.raises(ValueError, match="temporal_layers=3"):
+        make_sharded_train_step(make_mesh({"dp": 2, "sp": 1, "tp": 2,
+                                           "pp": 2}),
+                                clip_shape=(4, 4, 64, 64, 3), width=16,
+                                temporal_layers=3)
+
 
 def test_pipeline_rejects_stage_count_mismatch():
     """A stacked stage count that differs from the pp axis size must be a

@@ -150,13 +150,33 @@ def main() -> int:
         got = row.pop("_device_out", None)
         if ref is not None and got is not None:
             import jax
-            diffs = [float(np.abs(np.asarray(a, np.float32) -
-                                  np.asarray(b, np.float32)).max())
-                     for a, b in zip(jax.tree_util.tree_leaves(ref),
-                                     jax.tree_util.tree_leaves(got))]
-            row["max_abs_diff"] = max(diffs) if diffs else 0.0
-            if name in ATOL:
-                row["agrees"] = bool(row["max_abs_diff"] <= ATOL[name])
+            ref_leaves = jax.tree_util.tree_leaves(ref)
+            got_leaves = jax.tree_util.tree_leaves(got)
+            if len(ref_leaves) != len(got_leaves):
+                # zip() would truncate and silently under-report the diff
+                row["max_abs_diff"] = (
+                    f"STRUCTURE MISMATCH: {len(ref_leaves)} host leaves "
+                    f"vs {len(got_leaves)} device leaves")
+                if name in ATOL:
+                    row["agrees"] = False
+            else:
+                shapes = [(np.shape(a), np.shape(b))
+                          for a, b in zip(ref_leaves, got_leaves)]
+                bad = [s for s in shapes if s[0] != s[1]]
+                if bad:
+                    row["max_abs_diff"] = (
+                        f"SHAPE MISMATCH: host {bad[0][0]} vs device "
+                        f"{bad[0][1]}")
+                    if name in ATOL:
+                        row["agrees"] = False
+                else:
+                    diffs = [float(np.abs(np.asarray(a, np.float32) -
+                                          np.asarray(b, np.float32)).max())
+                             for a, b in zip(ref_leaves, got_leaves)]
+                    row["max_abs_diff"] = max(diffs) if diffs else 0.0
+                    if name in ATOL:
+                        row["agrees"] = bool(
+                            row["max_abs_diff"] <= ATOL[name])
         if "host_fps" in row and "device_fps" in row:
             row["speedup"] = round(
                 row["device_fps"] / max(row["host_fps"], 1e-9), 1)

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Probe the accelerator tunnel every ~10 min; on the first healthy window,
+# run the full banked program (tools/tpu_window.py) and exit 0 so the
+# caller is notified.  Exits 3 when the deadline passes with no window.
+# Usage: tools/tpu_watch.sh [deadline_seconds]  (default 10h)
+DEADLINE=${1:-36000}
+START=$(date +%s)
+LOG=${TPU_WATCH_LOG:-/tmp/tpu_watch.log}
+cd "$(dirname "$0")/.."
+while true; do
+  NOW=$(date +%s)
+  if [ $((NOW - START)) -gt "$DEADLINE" ]; then
+    echo "$(date -Is) deadline reached, no healthy window" >> "$LOG"
+    exit 3
+  fi
+  if timeout 120 python -c "import jax; d=jax.devices()[0]; assert d.platform=='tpu'" 2>/dev/null; then
+    echo "$(date -Is) tunnel healthy — running window program" >> "$LOG"
+    python tools/tpu_window.py >> "$LOG" 2>&1
+    RC=$?
+    echo "$(date -Is) window program rc=$RC" >> "$LOG"
+    exit $RC
+  fi
+  echo "$(date -Is) tunnel down" >> "$LOG"
+  sleep 600
+done
