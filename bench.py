@@ -138,7 +138,8 @@ def main():
                              fps=30, keyint=32)
         sc = Client(db_path=os.path.join(root, "db"),
                     num_load_workers=3, num_save_workers=1)
-        sc.ingest_videos([("bench", vid)])
+        _, _ing_failed = sc.ingest_videos([("bench", vid)])
+        assert not _ing_failed, _ing_failed
 
         def pipeline(config: int, frames_col):
             if config == 1:
@@ -177,7 +178,8 @@ def main():
             scv.synthesize_video(p, num_frames=N_CORPUS_FRAMES,
                                  width=W, height=H, fps=30, keyint=32)
             names = [(f"corpus_{i}", p) for i in range(N_CORPUS_VIDEOS)]
-            sc.ingest_videos(names)
+            _, _ing_failed = sc.ingest_videos(names)
+            assert not _ing_failed, _ing_failed
 
             def run_once(suffix: str) -> float:
                 streams = [NamedVideoStream(sc, n) for n, _ in names]

@@ -138,9 +138,15 @@ class Client:
 
     # -- data management ----------------------------------------------------
 
-    def ingest_videos(self, named_paths: Sequence, inplace: bool = False):
+    def ingest_videos(self, named_paths: Sequence, inplace: bool = False,
+                      force: bool = False):
+        """Ingest videos as tables; returns (descriptors, failures) where
+        failures is [(path, reason)] — a corrupt file is reported, not
+        raised, so it cannot abort a corpus ingest (reference
+        client.py:965 / ingest.cpp:872 failed_videos)."""
         from ..video import ingest_videos
-        return ingest_videos(self._db, named_paths, inplace=inplace)
+        return ingest_videos(self._db, named_paths, inplace=inplace,
+                             force=force)
 
     def ingest_images(self, name: str, paths: Sequence[str]):
         from ..video.ingest import ingest_images

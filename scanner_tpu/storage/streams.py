@@ -141,9 +141,14 @@ class NamedVideoStream(StoredStream):
 
     def ensure_ingested(self) -> None:
         if self._path is not None and not self.exists():
+            from ..common import ScannerException
             from ..video import ingest_videos
-            ingest_videos(self.db, [(self.name, self._path)],
-                          inplace=self._inplace)
+            _, failed = ingest_videos(self.db, [(self.name, self._path)],
+                                      inplace=self._inplace)
+            if failed:
+                # single-stream auto-ingest: a failure here IS fatal
+                raise ScannerException(
+                    f"ingest of {failed[0][0]} failed: {failed[0][1]}")
 
     def len(self) -> int:
         self.ensure_ingested()

@@ -27,14 +27,48 @@ from . import lib
 from .automata import DecoderAutomata
 
 
-def ingest_videos(db: Database, named_paths: Sequence[Tuple[str, str]],
-                  inplace: bool = False) -> List[md.TableDescriptor]:
-    """Ingest videos as named tables. inplace=True indexes the original file
-    without copying packet data (reference ingest.cpp:382)."""
-    out = []
+def ingest_videos(
+        db: Database, named_paths: Sequence[Tuple[str, str]],
+        inplace: bool = False, force: bool = False,
+) -> Tuple[List[md.TableDescriptor], List[Tuple[str, str]]]:
+    """Ingest videos as named tables; returns (descriptors, failures).
+
+    One corrupt file must not abort a corpus ingest: per-video failures
+    are collected as (path, reason) and returned alongside the tables
+    that did ingest (reference ingest.cpp:872-978 failed_videos and
+    client.py:965 ingest_videos -> (tables, failures)).  A failed video
+    leaves no table behind.  inplace=True indexes the original file
+    without copying packet data (reference ingest.cpp:382); force=True
+    deletes an existing table of the same name first.
+    """
+    if not named_paths:
+        raise ScannerException("must ingest at least one video")
+    # a name collision (with an existing table, or within the list) is a
+    # caller error, not a per-video decode failure: raise up front like
+    # the reference (client.py:1005), before any work or deletion
+    names = [name for name, _ in named_paths]
+    if len(set(names)) != len(names):
+        dup = sorted({n for n in names if names.count(n) > 1})
+        raise ScannerException(f"duplicate table names in ingest: {dup}")
+    if not force:
+        for name in names:
+            if db.has_table(name):
+                raise ScannerException(f"table already exists: {name}")
+    out: List[md.TableDescriptor] = []
+    failures: List[Tuple[str, str]] = []
     for name, path in named_paths:
-        out.append(_ingest_one(db, name, path, inplace))
-    return out
+        # with force=, delete a colliding table only immediately before
+        # its own ingest attempt — never up front for the whole list, so
+        # an abort partway cannot leave later tables deleted-but-never-
+        # re-ingested.  (A failed forced re-ingest still loses the old
+        # table: create-then-rename would be needed to avoid that.)
+        if force and db.has_table(name):
+            db.delete_table(name)
+        try:
+            out.append(_ingest_one(db, name, path, inplace))
+        except ScannerException as e:
+            failures.append((path, str(e)))
+    return out, failures
 
 
 def _ingest_one(db: Database, name: str, path: str,

@@ -52,6 +52,36 @@ def test_ingest_and_exact_decode(tmp_db, clip):
     assert (frames[3] == frames[4]).all()
 
 
+def test_corpus_ingest_collects_per_video_failures(tmp_db, clip, tmp_path):
+    """A corrupt file mid-list is reported in the failures list, not
+    raised — the rest of the corpus still ingests (reference
+    ingest.cpp:872-978 failed_videos)."""
+    bad = str(tmp_path / "corrupt.mp4")
+    with open(bad, "wb") as f:
+        f.write(b"\x00\x01not a video at all" * 64)
+    other = str(tmp_path / "other.mp4")
+    scv.synthesize_video(other, num_frames=24, width=128, height=96)
+
+    descs, failed = scv.ingest_videos(
+        tmp_db, [("good1", clip), ("badv", bad), ("good2", other)])
+    assert [d.name for d in descs] == ["good1", "good2"]
+    assert len(failed) == 1
+    assert failed[0][0] == bad and "ingest failed" in failed[0][1]
+    # the failed video left no table behind; the good ones are committed
+    assert not tmp_db.has_table("badv")
+    assert tmp_db.table_is_committed("good1")
+    assert tmp_db.table_descriptor("good2").num_rows == 24
+
+    # a name collision is a caller error: raised up front, unless force=
+    with pytest.raises(ScannerException, match="already exists"):
+        scv.ingest_videos(tmp_db, [("good1", other)])
+    with pytest.raises(ScannerException, match="duplicate table names"):
+        scv.ingest_videos(tmp_db, [("dup", clip), ("dup", other)])
+    descs2, failed2 = scv.ingest_videos(tmp_db, [("good1", other)],
+                                        force=True)
+    assert not failed2 and tmp_db.table_descriptor("good1").num_rows == 24
+
+
 def test_inplace_ingest_decode(tmp_db, clip):
     scv.ingest_videos(tmp_db, [("clip_inplace", clip)], inplace=True)
     frames = scv.load_frames(tmp_db, "clip_inplace", [5, 60])

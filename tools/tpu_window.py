@@ -114,7 +114,8 @@ scv.synthesize_video(vid, num_frames=N, width=W, height=H, fps=30,
                      keyint=32)
 sc = Client(db_path=os.path.join(root, "db"), num_load_workers=3,
             num_save_workers=1)
-sc.ingest_videos([("bench", vid)])
+_, _ing_failed = sc.ingest_videos([("bench", vid)])
+assert not _ing_failed, _ing_failed
 
 def run(name):
     frames = sc.io.Input([NamedVideoStream(sc, "bench")])
@@ -192,7 +193,8 @@ scv.synthesize_video(vid, num_frames=N, width=W, height=H, fps=30,
                      keyint=32)
 sc = Client(db_path=os.path.join(root, "db"), num_load_workers=3,
             num_save_workers=1)
-sc.ingest_videos([("bench", vid)])
+_, _ing_failed = sc.ingest_videos([("bench", vid)])
+assert not _ing_failed, _ing_failed
 
 def run(name):
     frames = sc.io.Input([NamedVideoStream(sc, "bench")])
