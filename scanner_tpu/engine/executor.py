@@ -344,8 +344,13 @@ class LocalExecutor:
         _log.info("job set prepared: %d jobs (%d skipped), %d tasks",
                   len(jobs), sum(1 for j in jobs if j.skipped), len(work))
         if work:
-            self._run_pipeline(info, work, show_progress,
-                               queue_size=int(perf.queue_size_per_pipeline))
+            # level >= 2: capture the XLA device timeline around the job
+            # (SURVEY §5 tracing; merged into Profile.write_trace output)
+            from ..util.jaxprof import device_trace
+            with device_trace(self.profiler):
+                self._run_pipeline(
+                    info, work, show_progress,
+                    queue_size=int(perf.queue_size_per_pipeline))
         for job in jobs:
             if job.skipped:
                 continue

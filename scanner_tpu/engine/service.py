@@ -993,11 +993,16 @@ class Worker:
                     self._evaluators[idx] = te
                 return te
 
-        self.executor.run_pipeline(
-            self._info, source, on_start=on_start, on_done=on_done,
-            on_eval_done=on_eval_done, on_task_error=on_task_error,
-            evaluator_factory=evaluator_factory, close_evaluators=False,
-            queue_size=self._queue_size)
+        # level >= 2: capture this node's XLA device timeline for the
+        # bulk; the trace dir ships in the profile (PostProfile) and
+        # Profile.write_trace merges it when readable from that host
+        from ..util.jaxprof import device_trace
+        with device_trace(self.profiler):
+            self.executor.run_pipeline(
+                self._info, source, on_start=on_start, on_done=on_done,
+                on_eval_done=on_eval_done, on_task_error=on_task_error,
+                evaluator_factory=evaluator_factory, close_evaluators=False,
+                queue_size=self._queue_size)
 
     def wait_for_shutdown(self) -> None:
         while not self._shutdown.is_set():

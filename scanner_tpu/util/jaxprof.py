@@ -1,0 +1,140 @@
+"""Device-side (XLA/JAX) trace capture for the engine profiler.
+
+SURVEY §5 tracing row: the reference records host-side interval spans
+(scanner/util/profiler.cpp); the TPU equivalent must also see the DEVICE
+timeline — XLA op execution, h2d/d2h transfers, compilation — or claims
+like "h2d rides under decode" stay inferences from wall clocks.  At
+``profiler_level >= 2`` the engine wraps a job's execution in
+``jax.profiler.start_trace``/``stop_trace`` and records the trace
+directory on the host profiler; ``Profile.write_trace`` then merges the
+device timeline into the same Chrome-trace JSON so host stage spans and
+device op execution land in ONE perfetto view.
+
+Alignment: the XLA trace's ``ts`` values are microseconds relative to
+``start_trace``, so events are shifted by the host wall-clock captured at
+start (``t0``).  Device processes are offset into a distinct pid range so
+they can never collide with the host profiler's node pids.
+
+JAX allows one active trace per process; concurrent jobs (e.g. several
+in-process workers in tests) serialize on a module lock — the first job
+gets the device trace, the rest run untraced rather than erroring.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import glob
+import gzip
+import json
+import logging
+import os
+import shutil
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_log = logging.getLogger("scanner_tpu.jaxprof")
+
+# one active jax.profiler trace per process
+_ACTIVE = threading.Lock()
+
+# Trace dumps are tens-to-hundreds of MB; auto-created dirs (no explicit
+# out_dir) are deleted when this process exits so a long session of
+# level-2 jobs cannot fill /tmp.  Callers who want to keep a capture
+# (e.g. to open in TensorBoard/XProf) pass out_dir.
+_AUTO_DIRS: List[str] = []
+
+
+def _cleanup_auto_dirs() -> None:
+    for d in _AUTO_DIRS:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+atexit.register(_cleanup_auto_dirs)
+
+# pid offset for merged device processes (host profiler pids are 1..N)
+DEVICE_PID_BASE = 1000
+
+
+@contextlib.contextmanager
+def device_trace(profiler, out_dir: Optional[str] = None):
+    """Capture the XLA device trace around a job when the profiler runs
+    at level >= 2; no-op otherwise (and on any profiler failure — a
+    broken tracer must never take down the job)."""
+    if getattr(profiler, "level", 1) < 2:
+        yield
+        return
+    if not _ACTIVE.acquire(blocking=False):
+        _log.info("device trace already active in this process; "
+                  "running untraced")
+        yield
+        return
+    try:
+        trace_dir = None
+        auto = out_dir is None
+        try:
+            import jax
+            trace_dir = out_dir or tempfile.mkdtemp(prefix="sc_devtrace_")
+            t0 = time.time()
+            jax.profiler.start_trace(trace_dir)
+            if auto:
+                _AUTO_DIRS.append(trace_dir)
+        except Exception as e:  # noqa: BLE001
+            _log.warning("jax.profiler.start_trace failed: %s", e)
+            if auto and trace_dir is not None:
+                shutil.rmtree(trace_dir, ignore_errors=True)
+            yield
+            return
+        try:
+            yield
+        finally:
+            try:
+                jax.profiler.stop_trace()
+                profiler.device_traces.append(
+                    {"dir": trace_dir, "t0": t0})
+            except Exception as e:  # noqa: BLE001
+                _log.warning("jax.profiler.stop_trace failed: %s", e)
+    finally:
+        _ACTIVE.release()
+
+
+def load_device_events(rec: Dict[str, Any],
+                       pid_base: int = DEVICE_PID_BASE,
+                       include_python: bool = False
+                       ) -> List[Dict[str, Any]]:
+    """Load one recorded device trace as Chrome trace events, shifted to
+    the host wall clock and into the device pid range.
+
+    ``rec`` is a ``{"dir": ..., "t0": ...}`` entry from
+    ``Profiler.device_traces``.  Returns [] when the directory is gone
+    (e.g. a profile shipped from another host) — the host-side trace
+    must still be writable.  The profiler's Python-call spans (names
+    prefixed ``$``, tens of thousands per job) drown the device lanes
+    and duplicate what the host profiler already records; they are
+    dropped unless ``include_python=True``."""
+    files = sorted(glob.glob(
+        os.path.join(rec["dir"], "**", "*.trace.json.gz"), recursive=True))
+    if not files:
+        return []
+    shift_us = rec["t0"] * 1e6
+    out: List[Dict[str, Any]] = []
+    for path in files:
+        try:
+            with gzip.open(path) as f:
+                doc = json.load(f)
+        except Exception as e:  # noqa: BLE001
+            _log.warning("unreadable device trace %s: %s", path, e)
+            continue
+        for ev in doc.get("traceEvents", []):
+            if not include_python and \
+                    str(ev.get("name", "")).startswith("$"):
+                continue
+            ev = dict(ev)
+            if "pid" in ev:
+                ev["pid"] = pid_base + int(ev["pid"])
+            if "ts" in ev and ev.get("ph") != "M":
+                ev["ts"] = float(ev["ts"]) + shift_us
+            out.append(ev)
+    return out

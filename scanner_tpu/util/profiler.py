@@ -43,6 +43,9 @@ class Profiler:
         self.base_time = base_time if base_time is not None else time.time()
         self.level = level
         self.max_intervals = max_intervals
+        # XLA device-trace captures recorded around jobs at level >= 2
+        # ({"dir": trace_dir, "t0": host_start}; util/jaxprof.py)
+        self.device_traces: List[Dict[str, Any]] = []
         self._local = threading.local()
         self._all_lists: List[List[Interval]] = []
         self._counters: Dict[str, int] = defaultdict(int)
@@ -99,6 +102,7 @@ class Profiler:
             "node": self.node,
             "base_time": self.base_time,
             "counters": self.counters,
+            "device_traces": list(self.device_traces),
             "intervals": [
                 {"name": iv.name, "start": iv.start, "end": iv.end,
                  "thread": iv.thread, "args": iv.args}
@@ -108,6 +112,7 @@ class Profiler:
     @classmethod
     def from_dict(cls, d: dict) -> "Profiler":
         p = cls(node=d["node"], base_time=d["base_time"])
+        p.device_traces = list(d.get("device_traces", []))
         lst = p._list()
         for iv in d["intervals"]:
             lst.append(Interval(iv["name"], iv["start"], iv["end"],
@@ -158,8 +163,13 @@ class Profile:
     def __init__(self, profilers: List[Profiler]):
         self.profilers = profilers
 
-    def write_trace(self, path: str) -> None:
-        """Emit Chrome trace JSON (chrome://tracing, perfetto)."""
+    def write_trace(self, path: str, merge_device: bool = True) -> None:
+        """Emit Chrome trace JSON (chrome://tracing, perfetto).
+
+        Device traces captured at profiler_level >= 2 (util/jaxprof.py)
+        are merged into the same file — host stage spans and the XLA
+        device timeline in one view — unless merge_device=False or the
+        trace directory is not readable from this host."""
         events = []
         pids = {}
         for p in self.profilers:
@@ -177,6 +187,16 @@ class Profile:
             for thread, tid in tids.items():
                 events.append({"name": "thread_name", "ph": "M", "pid": pid,
                                "tid": tid, "args": {"name": thread}})
+        if merge_device:
+            from .jaxprof import DEVICE_PID_BASE, load_device_events
+            base = DEVICE_PID_BASE
+            for p in self.profilers:
+                for rec in getattr(p, "device_traces", []):
+                    got = load_device_events(rec, pid_base=base)
+                    events.extend(got)
+                    if got:
+                        # disjoint pid block per capture
+                        base += 1000
         with open(path, "w") as f:
             json.dump({"traceEvents": events}, f)
 
