@@ -53,6 +53,11 @@ SCVID_API const char* scvid_last_error() { return g_error.c_str(); }
 
 SCVID_API void scvid_set_log_level(int level) { av_log_set_level(level); }
 
+// Bumped whenever the exported symbol set or struct layouts change; the
+// Python loader (video/lib.py) refuses a mismatched prebuilt .so with a
+// clear "rebuild" error instead of a late AttributeError.
+SCVID_API int32_t scvid_api_version() { return 2; }
+
 // ---------------------------------------------------------------------------
 // Ingest: demux a container, write the packet stream, return the index.
 // ---------------------------------------------------------------------------
@@ -193,6 +198,14 @@ struct ScvidDecoder {
   AVFrame* frame = nullptr;
   int width = 0;
   int height = 0;
+  int sws_src_fmt = -1;  // source pixel format the sws context was built for
+  int sws_for_fmt = -1;  // out_fmt the sws context was built for
+  int sws_src_range = -1;  // source color range the sws context assumes
+  // 0 = packed RGB24 (3 B/px, host-converted); 1 = planar YUV420 (I420,
+  // 1.5 B/px) for pipelines that convert to RGB on the accelerator —
+  // halving the host->device bytes is the point (the reference shipped
+  // NV12 and converted on-GPU for the same reason, util/image.cu:22)
+  int out_fmt = 0;
   int64_t emitted = 0;  // display-order frames emitted since last reset
 };
 
@@ -246,29 +259,98 @@ SCVID_API void scvid_decoder_reset(ScvidDecoder* d) {
 
 namespace {
 
-// Convert the decoder's current frame to RGB24 into dst (h*w*3 bytes).
-int convert_to_rgb(ScvidDecoder* d, uint8_t* dst) {
-  AVFrame* f = d->frame;
-  if (!d->sws || d->width != f->width || d->height != f->height) {
-    if (d->sws) sws_freeContext(d->sws);
-    d->sws = sws_getContext(f->width, f->height, (AVPixelFormat)f->format,
-                            f->width, f->height, AV_PIX_FMT_RGB24,
-                            SWS_BILINEAR, nullptr, nullptr, nullptr);
-    d->width = f->width;
-    d->height = f->height;
-    if (!d->sws) {
-      set_error("sws_getContext failed");
-      return -1;
-    }
+// Output bytes per frame for the decoder's configured format.
+int64_t frame_out_bytes(const ScvidDecoder* d, int64_t h, int64_t w) {
+  if (d->out_fmt == 1) {
+    int64_t ch = (h + 1) / 2, cw = (w + 1) / 2;
+    return h * w + 2 * ch * cw;
   }
+  return h * w * 3;
+}
+
+// (Re)build the cached sws context for the current frame -> dst_fmt.
+int ensure_sws(ScvidDecoder* d, const AVFrame* f, AVPixelFormat dst_fmt) {
+  int src_range = f->color_range == AVCOL_RANGE_JPEG ? 1 : 0;
+  if (d->sws && d->width == f->width && d->height == f->height &&
+      d->sws_src_fmt == f->format && d->sws_for_fmt == d->out_fmt &&
+      d->sws_src_range == src_range)
+    return 0;
+  if (d->sws) sws_freeContext(d->sws);
+  d->sws = sws_getContext(f->width, f->height, (AVPixelFormat)f->format,
+                          f->width, f->height, dst_fmt, SWS_BILINEAR,
+                          nullptr, nullptr, nullptr);
+  d->width = f->width;
+  d->height = f->height;
+  d->sws_src_fmt = f->format;
+  d->sws_for_fmt = d->out_fmt;
+  d->sws_src_range = src_range;
+  if (!d->sws) {
+    set_error("sws_getContext failed");
+    return -1;
+  }
+  if (src_range) {
+    // Full range signaled via color_range on a non-J pixel format (e.g.
+    // full-range HEVC decodes to yuv420p + AVCOL_RANGE_JPEG): swscale
+    // infers ranges from the pixel formats alone, so tell it explicitly
+    // — the I420 wire (and the RGB24 matrix) are limited-range.
+    int *inv_table, *table, src_r, dst_r, b, c, s;
+    if (sws_getColorspaceDetails(d->sws, &inv_table, &src_r, &table,
+                                 &dst_r, &b, &c, &s) >= 0)
+      sws_setColorspaceDetails(d->sws, inv_table, 1, table, 0, b, c, s);
+  }
+  return 0;
+}
+
+// Convert the decoder's current frame into dst:
+//   out_fmt 0 — packed RGB24 (h*w*3 bytes, swscale)
+//   out_fmt 1 — planar I420 (Y[h*w] U[ch*cw] V[ch*cw]); a straight
+//               linesize-aware plane copy when the codec already decoded
+//               LIMITED-RANGE 8-bit 4:2:0 (the overwhelmingly common
+//               case for h264/hevc/mpeg4).  Full-range streams
+//               (yuvj420p / color_range=JPEG, e.g. mjpeg) and uncommon
+//               formats (10-bit, 4:2:2, ...) go through swscale, which
+//               compresses to the limited range the on-device converter
+//               (kernels/color.py, BT.601 studio swing) expects.
+int convert_frame(ScvidDecoder* d, uint8_t* dst) {
+  AVFrame* f = d->frame;
+  const int64_t h = f->height, w = f->width;
+  if (d->out_fmt == 1) {
+    const int64_t ch = (h + 1) / 2, cw = (w + 1) / 2;
+    uint8_t* dst_y = dst;
+    uint8_t* dst_u = dst + h * w;
+    uint8_t* dst_v = dst_u + ch * cw;
+    if (f->format == AV_PIX_FMT_YUV420P &&
+        f->color_range != AVCOL_RANGE_JPEG) {
+      for (int64_t r = 0; r < h; ++r)
+        memcpy(dst_y + r * w, f->data[0] + r * f->linesize[0], w);
+      for (int64_t r = 0; r < ch; ++r) {
+        memcpy(dst_u + r * cw, f->data[1] + r * f->linesize[1], cw);
+        memcpy(dst_v + r * cw, f->data[2] + r * f->linesize[2], cw);
+      }
+      return 0;
+    }
+    if (ensure_sws(d, f, AV_PIX_FMT_YUV420P) < 0) return -1;
+    uint8_t* dst_planes[4] = {dst_y, dst_u, dst_v, nullptr};
+    int dst_stride[4] = {(int)w, (int)cw, (int)cw, 0};
+    sws_scale(d->sws, f->data, f->linesize, 0, h, dst_planes, dst_stride);
+    return 0;
+  }
+  if (ensure_sws(d, f, AV_PIX_FMT_RGB24) < 0) return -1;
   uint8_t* dst_planes[4] = {dst, nullptr, nullptr, nullptr};
-  int dst_stride[4] = {3 * f->width, 0, 0, 0};
-  sws_scale(d->sws, f->data, f->linesize, 0, f->height, dst_planes,
-            dst_stride);
+  int dst_stride[4] = {3 * (int)w, 0, 0, 0};
+  sws_scale(d->sws, f->data, f->linesize, 0, h, dst_planes, dst_stride);
   return 0;
 }
 
 }  // namespace
+
+// Select the decoder's output pixel layout: 0 = RGB24 (default),
+// 1 = planar YUV420 (I420).  Takes effect for subsequent decode runs;
+// callers size output buffers accordingly (h*w*3 vs h*w*3/2 rounded up).
+SCVID_API void scvid_decoder_set_output_format(ScvidDecoder* d,
+                                               int32_t fmt) {
+  d->out_fmt = fmt == 1 ? 1 : 0;
+}
 
 // Decode a run of packets and write selected output frames.
 //
@@ -309,7 +391,7 @@ SCVID_API int64_t scvid_decode_run(ScvidDecoder* d, const uint8_t* packets,
       if (frame_bytes == 0) {
         out_dims[0] = d->frame->height;
         out_dims[1] = d->frame->width;
-        frame_bytes = (int64_t)d->frame->height * d->frame->width * 3;
+        frame_bytes = frame_out_bytes(d, d->frame->height, d->frame->width);
       } else if (d->frame->height != out_dims[0] ||
                  d->frame->width != out_dims[1]) {
         // mid-stream geometry change (new SPS): frames of differing size
@@ -325,7 +407,7 @@ SCVID_API int64_t scvid_decode_run(ScvidDecoder* d, const uint8_t* packets,
                     "mismatch with index?)");
           return -1;
         }
-        if (convert_to_rgb(d, out + written * frame_bytes) < 0) return -1;
+        if (convert_frame(d, out + written * frame_bytes) < 0) return -1;
         written++;
       }
       av_frame_unref(d->frame);
@@ -410,7 +492,7 @@ SCVID_API int64_t scvid_decode_run_pts(
       if (frame_bytes == 0) {
         out_dims[0] = d->frame->height;
         out_dims[1] = d->frame->width;
-        frame_bytes = (int64_t)d->frame->height * d->frame->width * 3;
+        frame_bytes = frame_out_bytes(d, d->frame->height, d->frame->width);
       } else if (d->frame->height != out_dims[0] ||
                  d->frame->width != out_dims[1]) {
         set_error("frame geometry changed mid-run (mid-stream SPS change?)");
@@ -428,7 +510,7 @@ SCVID_API int64_t scvid_decode_run_pts(
                     "mismatch with index?)");
           return -1;
         }
-        if (convert_to_rgb(d, out + written * frame_bytes) < 0) return -1;
+        if (convert_frame(d, out + written * frame_bytes) < 0) return -1;
         deliv[cursor] = 1;
         cursor++;
         written++;
@@ -506,7 +588,24 @@ SCVID_API ScvidEncoder* scvid_encoder_create(int32_t width, int32_t height,
   ctx->height = height;
   ctx->time_base = {fps_den, fps_num};
   ctx->framerate = {fps_num, fps_den};
-  ctx->pix_fmt = AV_PIX_FMT_YUV420P;
+  // pick the first 8-bit 4:2:0 format in the codec's own preference
+  // order: yuv420p for x264/x265/mpeg4, yuvj420p for mjpeg (which lists
+  // yuv420p too but rejects limited range at default strictness); fall
+  // back to the codec's first advertised format
+  AVPixelFormat enc_fmt = AV_PIX_FMT_YUV420P;
+  if (codec->pix_fmts) {
+    enc_fmt = codec->pix_fmts[0];
+    for (const AVPixelFormat* p = codec->pix_fmts;
+         *p != AV_PIX_FMT_NONE; ++p)
+      if (*p == AV_PIX_FMT_YUV420P || *p == AV_PIX_FMT_YUVJ420P) {
+        enc_fmt = *p;
+        break;
+      }
+  }
+  ctx->pix_fmt = enc_fmt;
+  if (enc_fmt == AV_PIX_FMT_YUVJ420P || enc_fmt == AV_PIX_FMT_YUVJ422P ||
+      enc_fmt == AV_PIX_FMT_YUVJ444P)
+    ctx->color_range = AVCOL_RANGE_JPEG;
   ctx->gop_size = keyint > 0 ? keyint : 16;
   // bframes=0 (the sink default) keeps exact-seek trivial on our own
   // outputs; >0 produces pts!=dts reordered streams — how real-world
@@ -557,13 +656,13 @@ SCVID_API ScvidEncoder* scvid_encoder_create(int32_t width, int32_t height,
   ScvidEncoder* e = new ScvidEncoder();
   e->ctx = ctx;
   e->frame = av_frame_alloc();
-  e->frame->format = AV_PIX_FMT_YUV420P;
+  e->frame->format = enc_fmt;
   e->frame->width = width;
   e->frame->height = height;
   av_frame_get_buffer(e->frame, 0);
   e->pkt = av_packet_alloc();
   e->sws = sws_getContext(width, height, AV_PIX_FMT_RGB24, width, height,
-                          AV_PIX_FMT_YUV420P, SWS_BILINEAR, nullptr, nullptr,
+                          enc_fmt, SWS_BILINEAR, nullptr, nullptr,
                           nullptr);
   return e;
 }

@@ -27,6 +27,7 @@ struct ScvidEncoder;
 
 const char* scvid_last_error();
 void scvid_set_log_level(int level);
+int32_t scvid_api_version();
 
 ScvidIndex* scvid_ingest(const char* in_path, const char* out_packets_path);
 void scvid_index_free(ScvidIndex* idx);
@@ -37,6 +38,7 @@ ScvidDecoder* scvid_decoder_create(const char* codec_name,
                                    int32_t height, int32_t n_threads);
 void scvid_decoder_destroy(ScvidDecoder* d);
 void scvid_decoder_reset(ScvidDecoder* d);
+void scvid_decoder_set_output_format(ScvidDecoder* d, int32_t fmt);
 int64_t scvid_decode_run(ScvidDecoder* d, const uint8_t* packets,
                          const uint64_t* pkt_sizes, int64_t n_packets,
                          const uint8_t* wanted, int64_t n_wanted,

@@ -40,15 +40,26 @@ def is_array_data(data) -> bool:
 
 
 class ColumnBatch:
-    """One column of one task: row ids + batched data (+ null mask)."""
+    """One column of one task: row ids + batched data (+ null mask).
 
-    __slots__ = ("rows", "data", "nulls", "_row_pos")
+    ``convert`` marks data stored in a pre-conversion wire format:
+    ``("yuv420", h, w)`` means rows are flat planar I420 frames staged at
+    1.5 B/px; ``converted()`` turns them into (n, h, w, 3) RGB where the
+    data lives (device op for jax arrays, numpy for host).  Row-axis
+    transforms (take/relabel/concat) preserve the mark — builtin gathers
+    never look inside a frame — and any per-row host materialization
+    converts transparently so no consumer can observe raw YUV bytes.
+    """
+
+    __slots__ = ("rows", "data", "nulls", "convert", "_row_pos")
 
     def __init__(self, rows: np.ndarray, data,
-                 nulls: Optional[np.ndarray] = None):
+                 nulls: Optional[np.ndarray] = None,
+                 convert: Optional[tuple] = None):
         self.rows = np.asarray(rows, np.int64)
         self.data = data
         self.nulls = nulls if nulls is None or nulls.any() else None
+        self.convert = convert
         self._row_pos = None
         if not is_array_data(data) and len(data) != len(self.rows):
             raise ValueError(
@@ -121,7 +132,7 @@ class ColumnBatch:
         else:
             data = [NullElement() if neg[i] else self.data[int(p)]
                     for i, p in enumerate(safe)]
-        return ColumnBatch(new_rows, data, nulls)
+        return ColumnBatch(new_rows, data, nulls, convert=self.convert)
 
     def take_rows(self, rows: np.ndarray,
                   new_rows: Optional[np.ndarray] = None) -> "ColumnBatch":
@@ -130,23 +141,46 @@ class ColumnBatch:
 
     def relabel(self, new_rows: np.ndarray) -> "ColumnBatch":
         """Same data, new row ids (slice/unslice row renumbering)."""
-        return ColumnBatch(new_rows, self.data, self.nulls)
+        return ColumnBatch(new_rows, self.data, self.nulls,
+                           convert=self.convert)
 
     # -- device movement ------------------------------------------------
 
     def to_device(self) -> "ColumnBatch":
-        """Host -> default device, one async transfer for the whole batch."""
+        """Host -> default device, one async transfer for the whole batch.
+        A convert-marked batch ships its WIRE format (that is the point:
+        1.5 B/px over the link, convert on device via converted())."""
         if isinstance(self.data, np.ndarray):
             import jax
             return ColumnBatch(self.rows, jax.device_put(self.data),
-                               self.nulls)
+                               self.nulls, convert=self.convert)
         return self
 
     def to_host(self) -> "ColumnBatch":
         """Materialize device data on host (the single sink-side fetch)."""
         if _is_jax(self.data):
-            return ColumnBatch(self.rows, np.asarray(self.data), self.nulls)
+            return ColumnBatch(self.rows, np.asarray(self.data), self.nulls,
+                               convert=self.convert)
         return self
+
+    def converted(self) -> "ColumnBatch":
+        """Resolve a pending wire-format conversion (no-op otherwise).
+        jax data converts with the jit device op, host arrays with the
+        bit-identical numpy flavor (kernels/color.py)."""
+        if self.convert is None:
+            return self
+        kind, h, w = self.convert
+        if kind != "yuv420":
+            raise ValueError(f"unknown convert mark {self.convert!r}")
+        from ..kernels.color import yuv420_to_rgb_device, yuv420_to_rgb_host
+        if _is_jax(self.data):
+            data = yuv420_to_rgb_device(self.data, h, w)
+        elif isinstance(self.data, np.ndarray):
+            data = yuv420_to_rgb_host(self.data, h, w)
+        else:
+            raise ValueError(
+                "convert-marked batch holds non-array data")
+        return ColumnBatch(self.rows, data, self.nulls)
 
     # -- per-row access (host materialization boundary) -----------------
 
@@ -157,9 +191,16 @@ class ColumnBatch:
         return self.nulls is not None and bool(self.nulls[pos])
 
     def element_at(self, pos: int) -> Elem:
-        """Element at position `pos` (a view for host arrays)."""
+        """Element at position `pos` (a view for host arrays; a
+        convert-marked batch yields the CONVERTED row — raw wire bytes
+        are never observable per-row)."""
         if self.is_null_pos(pos):
             return NullElement()
+        if self.convert is not None:
+            from ..kernels.color import yuv420_to_rgb_host
+            _kind, h, w = self.convert
+            row = np.asarray(self.data[pos])
+            return yuv420_to_rgb_host(row, h, w)
         if _is_jax(self.data):
             return np.asarray(self.data[pos])
         return self.data[pos]
@@ -178,6 +219,12 @@ def concat_batches(parts: List[ColumnBatch]) -> ColumnBatch:
     """Concatenate row-disjoint batches (already in row order)."""
     if len(parts) == 1:
         return parts[0]
+    converts = {p.convert for p in parts}
+    if len(converts) > 1:
+        # mixed wire formats (shouldn't happen within one column; be safe)
+        parts = [p.converted() for p in parts]
+        converts = {None}
+    convert = next(iter(converts))
     rows = np.concatenate([p.rows for p in parts])
     nulls = None
     if any(p.nulls is not None for p in parts):
@@ -187,11 +234,13 @@ def concat_batches(parts: List[ColumnBatch]) -> ColumnBatch:
     datas = [p.data for p in parts]
     if all(isinstance(d, np.ndarray) for d in datas) and \
             len({(d.shape[1:], d.dtype) for d in datas}) == 1:
-        return ColumnBatch(rows, np.concatenate(datas), nulls)
+        return ColumnBatch(rows, np.concatenate(datas), nulls,
+                           convert=convert)
     if all(_is_jax(d) for d in datas):
         import jax.numpy as jnp
         if len({(tuple(d.shape[1:]), d.dtype) for d in datas}) == 1:
-            return ColumnBatch(rows, jnp.concatenate(datas), nulls)
+            return ColumnBatch(rows, jnp.concatenate(datas), nulls,
+                               convert=convert)
     # mixed / ragged: fall back to object list
     elems: List[Elem] = []
     for p in parts:

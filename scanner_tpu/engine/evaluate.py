@@ -265,6 +265,12 @@ class TaskEvaluator:
                 b = b.to_device()
             elif not is_device_kernel:
                 b = b.to_host()
+            # resolve a pending wire-format conversion (YUV420 staged at
+            # 1.5 B/px) exactly once, where the data now lives: a jit
+            # device op for device kernels — XLA fuses it ahead of the
+            # kernel — or the bit-identical numpy flavor on host
+            if b.convert is not None:
+                b = b.converted()
             in_batches[i] = b
             store[(c.op.id, c.column)] = b
 

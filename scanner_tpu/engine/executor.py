@@ -655,7 +655,7 @@ class LocalExecutor:
             w.plan = A.derive_task_streams(
                 info, w.job.jr, w.output_range,
                 job_idx=w.job.job_idx, task_idx=w.task_idx)
-            w.elements = self._load_sources(w, tls)
+            w.elements = self._load_sources(info, w, tls)
             self._prestage_device_columns(info, w)
         return w
 
@@ -677,6 +677,21 @@ class LocalExecutor:
                     and isinstance(b.data, np.ndarray) \
                     and b.data.dtype != object:
                 w.elements[nid] = b.to_device()
+
+    def _yuv_device_wire(self, info: A.GraphInfo, node_id: int) -> bool:
+        """Should this video column decode to YUV420 wire format?  Yes
+        when every first non-builtin consumer is a device kernel (so the
+        conversion runs once, on the accelerator) and the backend is an
+        accelerator.  SCANNER_TPU_YUV_DEVICE=0 opts out; =force engages
+        it on the CPU backend too (tests exercise the full path there)."""
+        import os
+        flag = os.environ.get("SCANNER_TPU_YUV_DEVICE", "1")
+        if flag in ("0", "false"):
+            return False
+        from .evaluate import _accel_backend
+        if flag != "force" and not _accel_backend():
+            return False
+        return self._column_device_bound(info, node_id)
 
     def _column_device_bound(self, info: A.GraphInfo, node_id: int) -> bool:
         with self._device_bound_lock:
@@ -710,7 +725,8 @@ class LocalExecutor:
                 self._device_bound_cache[node_id] = res
         return res
 
-    def _load_sources(self, w: TaskItem, tls) -> Dict[int, ColumnBatch]:
+    def _load_sources(self, info: A.GraphInfo, w: TaskItem,
+                      tls) -> Dict[int, ColumnBatch]:
         """Read/decode exactly the rows the task needs.  Video sources
         arrive as ONE contiguous (N, H, W, 3) batch straight from the
         decoder — the zero-copy head of the batched data path."""
@@ -726,6 +742,14 @@ class LocalExecutor:
                 # rows are global; multi-item video tables (job outputs)
                 # hold one independently-decodable item per task
                 desc = si["table"]
+                # Device-bound frame columns decode to planar YUV420 and
+                # convert to RGB ON the accelerator (kernels/color.py):
+                # 1.5 B/px instead of 3 B/px over the host->device link,
+                # the first-order term of device pipelines (PERF.md §1;
+                # the reference shipped NV12 and converted on-GPU,
+                # util/image.cu:22).  SCANNER_TPU_YUV_DEVICE=0 opts out.
+                fmt = ("yuv420" if self._yuv_device_wire(info, node_id)
+                       else "rgb24")
                 by_item: Dict[int, List[int]] = {}
                 for r in rows_l:
                     it = desc.item_of_row(r)
@@ -734,10 +758,17 @@ class LocalExecutor:
                 parts: List[ColumnBatch] = []
                 for it, local in sorted(by_item.items()):
                     start, _ = desc.item_bounds(it)
-                    auto = self._automata(tls, w.job, node_id, si, it)
+                    auto = self._automata(tls, w.job, node_id, si, it,
+                                          output_format=fmt)
                     frames = auto.get_frames(local)
+                    # convert mark carries THIS item's geometry (items of
+                    # one table may differ); mixed-geometry concat falls
+                    # back to host conversion in concat_batches
+                    convert = (("yuv420", auto.vd.height, auto.vd.width)
+                               if fmt == "yuv420" else None)
                     parts.append(ColumnBatch(
-                        np.asarray(local, np.int64) + start, frames))
+                        np.asarray(local, np.int64) + start, frames,
+                        convert=convert))
                 out[node_id] = concat_batches(parts)
             else:
                 from ..storage.streams import decode_element
@@ -751,12 +782,12 @@ class LocalExecutor:
         return out
 
     def _automata(self, tls, job: JobContext, node_id: int, si,
-                  item: int = 0):
+                  item: int = 0, output_format: str = "rgb24"):
         cache = getattr(tls, "automata", None)
         if cache is None:
             cache = {}
             tls.automata = cache
-        key = (job.job_idx, node_id, item)
+        key = (job.job_idx, node_id, item, output_format)
         if key not in cache:
             from ..video.automata import DecoderAutomata
             desc = si["table"]
@@ -768,7 +799,8 @@ class LocalExecutor:
             cache[key] = DecoderAutomata(
                 self.db.backend, vd,
                 md.column_item_path(desc.id, si["column"], item),
-                n_threads=self.decoder_threads)
+                n_threads=self.decoder_threads,
+                output_format=output_format)
         return cache[key]
 
     def _save_task(self, info: A.GraphInfo, w: TaskItem) -> None:

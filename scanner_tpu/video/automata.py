@@ -106,19 +106,31 @@ class DecoderAutomata:
     packet data."""
 
     def __init__(self, backend: StorageBackend, vd: VideoDescriptor,
-                 data_path: str, n_threads: int = 1):
+                 data_path: str, n_threads: int = 1,
+                 output_format: str = "rgb24"):
         self.backend = backend
         self.vd = vd
         self.index = VideoIndex(vd)
         # in-place ingested streams read from the original container file
         self.data_path = vd.data_path or data_path
         self._external = bool(vd.data_path)
+        # "rgb24": (n, h, w, 3) frames; "yuv420": (n, frame_bytes) planar
+        # I420 rows at 1.5 B/px for device-side conversion
+        # (kernels/color.py) — half the host->device bytes
+        self.output_format = output_format
         self.decoder = Decoder(vd.codec, vd.extradata, vd.width, vd.height,
-                               n_threads)
+                               n_threads, output_format=output_format)
         # reused decode scratch (grown geometrically) — avoids a fresh
         # multi-MB allocation per decode run (reference keeps pooled
         # buffers for the same reason, util/memory.cpp BlockAllocator)
         self._scratch = np.empty(0, np.uint8)
+
+    @property
+    def frame_bytes(self) -> int:
+        from .lib import yuv420_frame_bytes
+        if self.output_format == "yuv420":
+            return yuv420_frame_bytes(self.vd.height, self.vd.width)
+        return self.vd.height * self.vd.width * 3
 
     def _scratch_buf(self, nbytes: int) -> np.ndarray:
         if self._scratch.nbytes < nbytes:
@@ -195,16 +207,21 @@ class DecoderAutomata:
     def get_frames(self, rows: Sequence[int]) -> np.ndarray:
         """Decode exactly the given display-order frame indices.
 
-        Returns uint8 array (len(rows), h, w, 3) in *request order* —
-        duplicates and arbitrary order allowed (Gather semantics).
+        Returns uint8 array in *request order* — duplicates and arbitrary
+        order allowed (Gather semantics).  Shape is
+        (len(rows), h, w, 3) for "rgb24" output, or
+        (len(rows), frame_bytes) planar I420 rows for "yuv420".
         """
         rows_arr = np.asarray(list(rows), np.int64)
-        if len(rows_arr) == 0:
-            return np.zeros((0, self.vd.height, self.vd.width, 3), np.uint8)
-        runs = self.index.plan(rows_arr)
         h, w = self.vd.height, self.vd.width
-        frame_bytes = h * w * 3
-        result = np.empty((len(rows_arr), h, w, 3), np.uint8)
+        frame_bytes = self.frame_bytes
+        shape = ((len(rows_arr), h, w, 3)
+                 if self.output_format == "rgb24"
+                 else (len(rows_arr), frame_bytes))
+        if len(rows_arr) == 0:
+            return np.zeros(shape, np.uint8)
+        runs = self.index.plan(rows_arr)
+        result = np.empty(shape, np.uint8)
         if len(runs) == 1 and np.array_equal(
                 np.asarray(runs[0].out_disp, np.int64), rows_arr):
             # fast path: the run emits exactly the requested rows in
@@ -221,7 +238,7 @@ class DecoderAutomata:
             scratch = self._scratch_buf(n_out * frame_bytes)
             out = scratch[:n_out * frame_bytes]
             self._decode_run_pts(run, out)
-            out = out.reshape(n_out, h, w, 3)
+            out = out.reshape((n_out,) + shape[1:])
             for i, d in enumerate(run.out_disp):
                 for pos in positions.get(int(d), ()):
                     result[pos] = out[i]

@@ -19,6 +19,12 @@ from ..storage.metadata import VideoDescriptor
 
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "libscvid.so")
 
+# Must match scvid_api_version() in cpp/scvid.cpp.  Bumped together with
+# any exported-symbol or struct-layout change so a stale prebuilt .so is
+# refused with a clear "rebuild" error instead of a late AttributeError
+# on a missing symbol (advisor round-4 finding).
+_API_VERSION = 2
+
 
 class _Index(C.Structure):
     _fields_ = [
@@ -42,32 +48,83 @@ class _Index(C.Structure):
 _lib = None
 
 
-def get_lib():
-    global _lib
-    if _lib is None:
-        if not os.path.exists(_LIB_PATH):
-            # binaries aren't committed — build on first use when the
-            # source tree + toolchain are present (setup.py does the same).
-            # flock serializes concurrent worker processes so none of them
-            # CDLLs a partially-linked .so.
-            cpp_dir = os.path.join(
-                os.path.dirname(__file__), "..", "..", "cpp")
-            build_err = ""
-            if os.path.exists(os.path.join(cpp_dir, "Makefile")):
-                import fcntl
-                import subprocess
-                with open(os.path.join(cpp_dir, ".build.lock"), "w") as lk:
-                    fcntl.flock(lk, fcntl.LOCK_EX)
-                    if not os.path.exists(_LIB_PATH):
-                        r = subprocess.run(["make", "-C", cpp_dir],
-                                           capture_output=True, text=True)
-                        if r.returncode != 0:
-                            build_err = f"\nbuild failed:\n{r.stderr}"
+def _needs_rebuild(cpp_dir: str) -> bool:
+    """True when the checked-out C sources are newer than the built .so
+    (a stale prebuilt library would be missing newly added symbols)."""
+    if not os.path.exists(_LIB_PATH):
+        return True
+    so_mtime = os.path.getmtime(_LIB_PATH)
+    for src in ("scvid.cpp", "scvid_api.h", "Makefile"):
+        p = os.path.join(cpp_dir, src)
+        if os.path.exists(p) and os.path.getmtime(p) > so_mtime:
+            return True
+    return False
+
+
+def _lib_version(handle) -> int:
+    try:
+        handle.scvid_api_version.restype = C.c_int32
+        return int(handle.scvid_api_version())
+    except AttributeError:
+        return -1
+
+
+def _load_checked():
+    """Build/refresh libscvid as needed and CDLL it, verifying the API
+    version.  Raises with a clear message when no good library can be
+    produced.
+
+    When the source tree is present, the WHOLE sequence — staleness
+    check, make, dlopen, version check, version-triggered rebuild — runs
+    under one flock, so a concurrent process can never dlopen a
+    partially-linked .so (and the unlink before the version-triggered
+    rebuild forces a fresh inode: dlopen of the same inode would hand
+    back the already-mapped stale library)."""
+    cpp_dir = os.path.join(os.path.dirname(__file__), "..", "..", "cpp")
+    has_make = os.path.exists(os.path.join(cpp_dir, "Makefile"))
+    build_err = ""
+
+    def _make() -> str:
+        import subprocess
+        r = subprocess.run(["make", "-C", cpp_dir],
+                           capture_output=True, text=True)
+        return "" if r.returncode == 0 else f"\nbuild failed:\n{r.stderr}"
+
+    def _open():
+        nonlocal build_err
+        if has_make and _needs_rebuild(cpp_dir):
+            build_err = _make()
         if not os.path.exists(_LIB_PATH):
             raise ScannerException(
                 f"libscvid.so not built; run `make -C cpp` (expected at "
                 f"{_LIB_PATH}){build_err}")
         lib = C.CDLL(_LIB_PATH)
+        if _lib_version(lib) != _API_VERSION and has_make:
+            # version-stale .so with a fresh mtime (e.g. copied in from
+            # another checkout): force the rebuild the mtime check missed
+            os.unlink(_LIB_PATH)
+            build_err = _make()
+            if os.path.exists(_LIB_PATH):
+                lib = C.CDLL(_LIB_PATH)
+        got = _lib_version(lib)
+        if got != _API_VERSION:
+            raise ScannerException(
+                f"stale libscvid.so (API version {got}, need "
+                f"{_API_VERSION}); rebuild with `make -C cpp`{build_err}")
+        return lib
+
+    if not has_make:
+        return _open()
+    import fcntl
+    with open(os.path.join(cpp_dir, ".build.lock"), "w") as lk:
+        fcntl.flock(lk, fcntl.LOCK_EX)
+        return _open()
+
+
+def get_lib():
+    global _lib
+    if _lib is None:
+        lib = _load_checked()
         lib.scvid_last_error.restype = C.c_char_p
         lib.scvid_set_log_level.argtypes = [C.c_int]
         lib.scvid_ingest.restype = C.POINTER(_Index)
@@ -78,6 +135,8 @@ def get_lib():
             C.c_char_p, C.c_char_p, C.c_int64, C.c_int32, C.c_int32, C.c_int32]
         lib.scvid_decoder_destroy.argtypes = [C.c_void_p]
         lib.scvid_decoder_reset.argtypes = [C.c_void_p]
+        lib.scvid_decoder_set_output_format.argtypes = [C.c_void_p,
+                                                        C.c_int32]
         lib.scvid_decode_run.restype = C.c_int64
         lib.scvid_decode_run.argtypes = [
             C.c_void_p, C.c_char_p, C.POINTER(C.c_uint64), C.c_int64,
@@ -170,18 +229,40 @@ def ingest_file(path: str, out_packets_path: Optional[str]
     return vd
 
 
+def yuv420_frame_bytes(height: int, width: int) -> int:
+    """Bytes per planar I420 frame (Y + quarter-res U and V planes)."""
+    ch, cw = (height + 1) // 2, (width + 1) // 2
+    return height * width + 2 * ch * cw
+
+
 class Decoder:
     """One hardware-thread decode pipeline. Not thread-safe per-instance;
-    use one per worker thread."""
+    use one per worker thread.
+
+    output_format selects the decoded pixel layout:
+      - "rgb24"  (default): packed (h, w, 3) — host conversion via swscale
+      - "yuv420": planar I420, yuv420_frame_bytes(h, w) per frame — for
+        pipelines that ship 1.5 B/px to an accelerator and convert there
+        (kernels/color.py; the reference shipped NV12 and converted
+        on-GPU for the same halving, util/image.cu:22)
+    """
 
     def __init__(self, codec: str, extradata: bytes, width: int, height: int,
-                 n_threads: int = 1):
+                 n_threads: int = 1, output_format: str = "rgb24"):
         self._lib = get_lib()
         self._h = self._lib.scvid_decoder_create(
             codec.encode(), extradata, len(extradata), width, height,
             n_threads)
         if not self._h:
             raise ScannerException(f"decoder create failed: {_err()}")
+        if output_format not in ("rgb24", "yuv420"):
+            self._lib.scvid_decoder_destroy(self._h)
+            self._h = None
+            raise ScannerException(
+                f"unknown decoder output_format {output_format!r}")
+        self.output_format = output_format
+        if output_format == "yuv420":
+            self._lib.scvid_decoder_set_output_format(self._h, 1)
 
     def close(self):
         if self._h:
