@@ -93,6 +93,16 @@ class PerfParams:
     # only, 1 = per-task detail (default), 2 = verbose (reference
     # rpc.proto:270-275 profiler_level)
     profiler_level: int = 1
+    # Opt-in task affinity for unbounded-state ops: consecutive tasks of
+    # a job carry kernel state forward instead of recomputing rows
+    # 0..end per task — O(n) total work instead of O(n^2/io_packet) on
+    # long un-sliced streams (the reference pins a job's packets to one
+    # worker, worker.cpp:373-415 save_coordinator).  Evaluation of such
+    # a job serializes onto one pipeline instance (and, in a cluster,
+    # one worker per job); any break in the chain — reordering, a
+    # failed task, worker death — falls back to the self-contained
+    # recompute, so results never depend on the affinity holding.
+    stateful_task_affinity: bool = False
 
     # reference-compat kwargs that are meaningless on TPU and accepted but
     # ignored (XLA owns device/host memory pooling; there is no CUDA pool

@@ -47,6 +47,14 @@ def _accel_backend() -> bool:
     return _BACKEND != "cpu"
 
 
+class StateCarryMiss(Exception):
+    """A carry plan's premise failed: the kernel instance's state is not
+    positioned at the plan's watermark (task reordering, a failed
+    predecessor, or a different instance).  The executor catches this and
+    re-runs the task with a self-contained plan — affinity is a pure
+    optimization, never a correctness dependency."""
+
+
 class KernelInstance:
     """One live kernel with its stream/state bookkeeping."""
 
@@ -279,6 +287,23 @@ class TaskEvaluator:
         valid_out = np.asarray(ts.valid_output_rows, np.int64)
         valid_set = set(valid_out.tolist())
 
+        # A carry plan (unbounded-state node whose recompute starts past
+        # row 0) is only sound if THIS kernel instance's state sits
+        # exactly at the preceding row of the same stream; anything else
+        # (reordered tasks, a failed predecessor, another instance) and
+        # maybe_reset would silently reset mid-stream — wrong results.
+        # Fail to the self-contained fallback instead.
+        # (bind_stream above already rebound+reset on any stream change,
+        # nulling _last_row — so the position check alone covers foreign
+        # streams, reordering, and failed predecessors)
+        if n.spec.unbounded_state and len(compute) and int(compute[0]) > 0:
+            if ki._last_row != int(compute[0]) - 1:
+                raise StateCarryMiss(
+                    f"{n.name}: carry plan expects state at row "
+                    f"{int(compute[0]) - 1} of stream "
+                    f"({plan.job_idx}, {plan.slice_group}); instance is "
+                    f"at {ki._last_row}")
+
         # window positions per compute row per input column (REPEAT_EDGE)
         sten = np.asarray(stencil, np.int64)
         win_rows = np.clip(compute[:, None] + sten[None, :], 0, max_in - 1)
@@ -380,36 +405,50 @@ class TaskEvaluator:
                         args.append([b.data[int(j)] for j in p[:, 0]])
             return args
 
-        with self.profiler.span("evaluate:" + n.name, rows=len(compute)):
-            for lo, hi in run_bounds:
-                ki.maybe_reset(int(compute[lo]))
-                ki._last_row = int(compute[hi - 1])
-                i = lo
-                while i < hi:
-                    j = min(i + batch, hi)
-                    sel = np.arange(i, j)
-                    live = sel[~null_in[sel]]
-                    dead = sel[null_in[sel]]
-                    if len(dead):
-                        null_rows(compute[dead])
-                    if not len(live):
+        try:
+            with self.profiler.span("evaluate:" + n.name,
+                                    rows=len(compute)):
+                for lo, hi in run_bounds:
+                    ki.maybe_reset(int(compute[lo]))
+                    ki._last_row = int(compute[hi - 1])
+                    i = lo
+                    while i < hi:
+                        j = min(i + batch, hi)
+                        sel = np.arange(i, j)
+                        live = sel[~null_in[sel]]
+                        dead = sel[null_in[sel]]
+                        if len(dead):
+                            null_rows(compute[dead])
+                        if not len(live):
+                            i = j
+                            continue
+                        if batched_call:
+                            args = call_args_for(live)
+                            res = ki.kernel.execute(*args)
+                            emit_result(compute[live], res)
+                        else:
+                            args = call_args_for(live)
+                            row_args = []
+                            for a in args:
+                                e = a[0]
+                                if has_stencil and is_array_data(a):
+                                    e = list(a[0])
+                                row_args.append(e)
+                            res = ki.kernel.execute(*row_args)
+                            emit_result(compute[live], _single(res, n, out_cols))
                         i = j
-                        continue
-                    if batched_call:
-                        args = call_args_for(live)
-                        res = ki.kernel.execute(*args)
-                        emit_result(compute[live], res)
-                    else:
-                        args = call_args_for(live)
-                        row_args = []
-                        for a in args:
-                            e = a[0]
-                            if has_stencil and is_array_data(a):
-                                e = list(a[0])
-                            row_args.append(e)
-                        res = ki.kernel.execute(*row_args)
-                        emit_result(compute[live], _single(res, n, out_cols))
-                    i = j
+        except BaseException:
+            # the kernel died mid-run: its internal state is partial and
+            # _last_row may already claim the run's end.  Reset both so a
+            # subsequent carry plan MISSES (fallback) instead of silently
+            # continuing from half-advanced state, and a self-contained
+            # re-run starts from a clean reset.
+            if ki.spec.is_stateful:
+                try:
+                    ki.kernel.reset()
+                finally:
+                    ki._last_row = None
+            raise
 
         # assemble output columns in row order; null-propagated rows (rare)
         # interleave with kernel results, so columns containing them fall

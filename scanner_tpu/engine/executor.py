@@ -80,6 +80,59 @@ class TaskItem:
     attempt: int = 0
 
 
+class _StatefulChain:
+    """Per-job planning chain for stateful task affinity
+    (PerfParams.stateful_task_affinity; reference save_coordinator
+    worker.cpp:373-415 packet pinning).
+
+    Loaders plan a chained job's tasks in task order: `gate_plan` waits
+    (briefly) until the preceding task was planned, then hands back the
+    watermark map — the row each unbounded-state kernel's state will
+    have advanced through — so analysis derives an incremental plan.
+    The gate orders only the cheap PLAN step; decode still runs on all
+    loader threads concurrently.  A timeout (a failed or reordered
+    predecessor) degrades that one task to the self-contained plan;
+    the chain then continues from its watermarks.  Correctness never
+    depends on any of this: the evaluator re-verifies the premise
+    against actual kernel state (StateCarryMiss -> self-contained
+    re-run)."""
+
+    GATE_TIMEOUT = 5.0
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.last_planned: Optional[int] = None
+        # (unbounded node id, slice group) -> last row planned through
+        self.water: Dict[Tuple[int, int], int] = {}
+
+    def gate_plan(self, task_idx: int) -> Optional[Dict[Tuple[int, int],
+                                                        int]]:
+        """Block until `task_idx` is next in the chain (or timeout);
+        returns the carry map, or None for a self-contained plan."""
+        with self.cond:
+            deadline = time.time() + self.GATE_TIMEOUT
+            while self.last_planned is not None \
+                    and task_idx > self.last_planned + 1:
+                left = deadline - time.time()
+                if left <= 0 or not self.cond.wait(timeout=left):
+                    if deadline - time.time() <= 0:
+                        break
+            if self.last_planned is None \
+                    or task_idx == self.last_planned + 1:
+                return dict(self.water)
+            return None
+
+    def planned(self, task_idx: int,
+                watermarks: Dict[Tuple[int, int], int]) -> None:
+        with self.cond:
+            if self.last_planned is None or task_idx > self.last_planned:
+                self.last_planned = task_idx
+            for k, m in watermarks.items():
+                if m > self.water.get(k, -1):
+                    self.water[k] = m
+            self.cond.notify_all()
+
+
 class LocalExecutor:
     def __init__(self, db: Database, profiler: Optional[Profiler] = None,
                  num_load_workers: int = 2, num_save_workers: int = 2,
@@ -99,6 +152,8 @@ class LocalExecutor:
         # threads share it and a concurrent clear() mid-read would KeyError
         self._device_bound_cache: Dict[Any, Any] = {}
         self._device_bound_lock = threading.Lock()
+        # job idx -> _StatefulChain when stateful task affinity is active
+        self._chains: Dict[int, _StatefulChain] = {}
 
     # ------------------------------------------------------------------
     # Job-set preparation (reference master.cpp:1367 process_job admission)
@@ -333,10 +388,37 @@ class LocalExecutor:
     # Execution
     # ------------------------------------------------------------------
 
+    def setup_chains(self, info: A.GraphInfo, jobs: List[JobContext],
+                     perf: PerfParams) -> None:
+        """Arm stateful task affinity (one planning chain per multi-task
+        job) when the graph has unbounded-state ops and the caller opted
+        in.  NOTE: the whole run then executes with ONE loader and ONE
+        pipeline instance on this node (kernel state lives in a single
+        instance's kernels, and reordering would carry-miss) — an
+        explicit trade the opt-in knob documents; cross-job parallelism
+        in a cluster comes from per-job worker stickiness."""
+        self._chains = {}
+        if not getattr(perf, "stateful_task_affinity", False):
+            return
+        unbounded = [n.name for n in info.ops
+                     if n.spec is not None
+                     and getattr(n.spec, "unbounded_state", False)]
+        if not unbounded:
+            return
+        for job in jobs:
+            if not job.skipped and len(job.tasks) > 1:
+                self._chains[job.job_idx] = _StatefulChain()
+        if self._chains:
+            _log.info(
+                "stateful task affinity armed for %d job(s) (ops: %s): "
+                "incremental plans, single evaluation instance",
+                len(self._chains), ", ".join(sorted(set(unbounded))))
+
     def run(self, outputs: Sequence[O.OpNode], perf: PerfParams,
             cache_mode: CacheMode = CacheMode.Error,
             show_progress: bool = False) -> List[JobContext]:
         info, jobs = self.prepare(outputs, perf, cache_mode)
+        self.setup_chains(info, jobs, perf)
         self.profiler.level = int(getattr(perf, "profiler_level", 1))
         work = [TaskItem(job, t, rng)
                 for job in jobs if not job.skipped
@@ -479,6 +561,8 @@ class LocalExecutor:
 
         def evaluator(evaluator_idx: int):
             te = None
+            import types
+            fb_tls = types.SimpleNamespace()  # fallback reload decoders
             try:
                 # fetch_resources runs once per node: instance 0 fetches,
                 # the rest only setup (reference evaluate_worker.cpp:488-534)
@@ -502,8 +586,8 @@ class LocalExecutor:
                         with self.profiler.span("evaluate", level=0,
                                                 task=w.task_idx,
                                                 job=w.job.job_idx):
-                            w.results = te.execute_task(
-                                w.job.jr, w.plan, w.elements)
+                            w.results = self._evaluate_with_fallback(
+                                info, te, w, fb_tls)
                         w.elements = None
                     except Exception as e:  # noqa: BLE001
                         task_failed(w, e)
@@ -520,6 +604,8 @@ class LocalExecutor:
                 record_err(e)
             finally:
                 fetch_done.set()  # never leave siblings waiting
+                for auto in getattr(fb_tls, "automata", {}).values():
+                    auto.close()
                 if te is not None and close_evaluators:
                     te.close()
 
@@ -556,11 +642,20 @@ class LocalExecutor:
         loaders_done = threading.Event()
         evals_done = threading.Event()
 
+        # stateful affinity: kernel state lives in ONE instance's kernels,
+        # so a chained run serializes evaluation (the reference pins a
+        # job's packets to one worker for the same reason).  One loader
+        # too: with N loaders, a decode-time inversion hands the
+        # evaluator task t+1 before t and every inversion costs a
+        # StateCarryMiss reload+recompute — per-task decode parallelism
+        # stays available via decoder_threads.
+        n_evals = 1 if self._chains else self.pipeline_instances
+        n_loaders = 1 if self._chains else self.num_load_workers
         loaders = [threading.Thread(target=loader, name=f"load-{i}")
-                   for i in range(self.num_load_workers)]
+                   for i in range(n_loaders)]
         evals = [threading.Thread(target=evaluator, args=(i,),
                                   name=f"eval-{i}")
-                 for i in range(self.pipeline_instances)]
+                 for i in range(n_evals)]
         savers = [threading.Thread(target=saver, name=f"save-{i}")
                   for i in range(self.num_save_workers)]
         for t in loaders + evals + savers:
@@ -612,8 +707,8 @@ class LocalExecutor:
                     with self.profiler.span("evaluate", level=0,
                                             task=w.task_idx,
                                             job=w.job.job_idx):
-                        w.results = te.execute_task(w.job.jr, w.plan,
-                                                    w.elements)
+                        w.results = self._evaluate_with_fallback(
+                            info, te, w, tls)
                     w.elements = None
                 except Exception as e:  # noqa: BLE001
                     if on_task_error is not None and on_task_error(w, e):
@@ -646,15 +741,40 @@ class LocalExecutor:
 
     # ------------------------------------------------------------------
 
+    def _evaluate_with_fallback(self, info: A.GraphInfo, te, w: TaskItem,
+                                fb_tls):
+        """Run a task; on a StateCarryMiss (the affinity chain's premise
+        broke — reordering, failed predecessor, different instance)
+        re-derive the self-contained plan, reload its sources, and run
+        again.  Affinity is an optimization only."""
+        from .evaluate import StateCarryMiss
+        try:
+            return te.execute_task(w.job.jr, w.plan, w.elements)
+        except StateCarryMiss as e:
+            _log.info("task (%d,%d): %s — re-running self-contained",
+                      w.job.job_idx, w.task_idx, e)
+            self.profiler.count("state_carry_miss")
+            w.plan = A.derive_task_streams(
+                info, w.job.jr, w.output_range,
+                job_idx=w.job.job_idx, task_idx=w.task_idx)
+            w.elements = self._load_sources(info, w, fb_tls)
+            self._prestage_device_columns(info, w)
+            return te.execute_task(w.job.jr, w.plan, w.elements)
+
     def load_task(self, info: A.GraphInfo, w: TaskItem, tls) -> TaskItem:
         """The load stage: derive the task's row plan and read/decode its
         source elements (shared by the local pipeline and cluster
         workers)."""
         with self.profiler.span("load", level=0, task=w.task_idx,
                                 job=w.job.job_idx):
+            chain = self._chains.get(w.job.job_idx)
+            carry = chain.gate_plan(w.task_idx) if chain is not None \
+                else None
             w.plan = A.derive_task_streams(
                 info, w.job.jr, w.output_range,
-                job_idx=w.job.job_idx, task_idx=w.task_idx)
+                job_idx=w.job.job_idx, task_idx=w.task_idx, carry=carry)
+            if chain is not None:
+                chain.planned(w.task_idx, w.plan.carry_watermarks)
             w.elements = self._load_sources(info, w, tls)
             self._prestage_device_columns(info, w)
         return w

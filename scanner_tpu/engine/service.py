@@ -68,10 +68,14 @@ class _BulkJob:
     # mid-bulk loses at most N tasks of metadata (reference checkpoint
     # every N jobs, master.cpp:1100-1113); 0 disables
     checkpoint_frequency: int = 0
-    # deque: NextWork pops the head O(1) — a 1000-video bulk is 10^5-10^6
-    # tasks and list.pop(0) would make dispatch quadratic (the reference
-    # shards tasks for the same reason, master.cpp:1558-1607)
-    queue: Deque[Tuple[int, int]] = field(default_factory=deque)
+    # Per-job deques + a round-robin ring of job ids: NextWork pops are
+    # O(1) (the reference shards tasks for the same reason,
+    # master.cpp:1558-1607), and a sticky job bound to another worker is
+    # skipped as a WHOLE job — a single shared deque would make every
+    # other worker rescan that job's (possibly 10^5) queued tasks per
+    # poll, starving later jobs behind it
+    queue: Dict[int, Deque[int]] = field(default_factory=dict)
+    job_rr: Deque[int] = field(default_factory=deque)
     # (job, task) -> (worker id, clock start, attempt id, started,
     # eval_done).  The `started` flag records whether StartedWork arrived
     # for this attempt: a timeout revocation of a task that only WAITED in
@@ -89,6 +93,18 @@ class _BulkJob:
                       Tuple[int, float, int, bool, bool]] = \
         field(default_factory=dict)
     next_attempt: int = 0
+    # stateful task affinity (PerfParams.stateful_task_affinity + an
+    # unbounded-state op in the graph): each job's tasks go, in order,
+    # to one worker (reference save_coordinator worker.cpp:373-415);
+    # rebound when that worker dies
+    sticky: bool = False
+    sticky_worker: Dict[int, int] = field(default_factory=dict)
+    # worker id -> the sticky job it is currently draining; NextWork
+    # serves this job to exhaustion before the ring hands the worker
+    # another sticky job — interleaving two chained jobs on one
+    # single-instance evaluator would reset kernel streams on every
+    # switch and carry-miss every task
+    sticky_cur: Dict[int, int] = field(default_factory=dict)
     # per-worker count of outstanding assignments (kept in sync with
     # `outstanding` so the NextWork window check is O(1))
     held: Dict[int, int] = field(default_factory=dict)
@@ -112,6 +128,33 @@ class _BulkJob:
     finished: bool = False
     error: str = ""
     profiles: List[dict] = field(default_factory=list)
+
+    def q_push(self, key: Tuple[int, int], front: bool = False) -> None:
+        j, t = key
+        dq = self.queue.get(j)
+        if dq is None:
+            dq = self.queue[j] = deque()
+            self.job_rr.append(j)
+        if front:
+            # requeued (revoked/failed/worker-death) task: re-insert in
+            # TASK ORDER — sticky chains want the job's deque ascending,
+            # and several requeues arriving ascending would reverse at
+            # the head with a plain appendleft.  Requeues are rare; the
+            # O(n) re-sort is fine.
+            if dq and t > dq[0]:
+                items = sorted(set(dq) | {t})
+                dq.clear()
+                dq.extend(items)
+            else:
+                dq.appendleft(t)
+        else:
+            dq.append(t)
+
+    def q_count(self) -> int:
+        return sum(len(dq) for dq in self.queue.values())
+
+    def q_has_work(self) -> bool:
+        return any(self.queue.values())
 
 
 class Master:
@@ -207,6 +250,11 @@ class Master:
                 info, jobs = ex.prepare(outputs, perf, cache_mode)
             except Exception as e:  # noqa: BLE001
                 return {"error": f"{type(e).__name__}: {e}"}
+            sticky = bool(getattr(perf, "stateful_task_affinity", False)
+                          and any(n.spec is not None
+                                  and getattr(n.spec, "unbounded_state",
+                                              False)
+                                  for n in info.ops))
             with self._lock:
                 bulk = _BulkJob(
                     bulk_id=self._next_bulk_id,
@@ -215,7 +263,8 @@ class Master:
                          "cache_mode": cache_mode.value}),
                     task_timeout=float(getattr(perf, "task_timeout", 0.0)),
                     checkpoint_frequency=int(
-                        getattr(perf, "checkpoint_frequency", 0) or 0))
+                        getattr(perf, "checkpoint_frequency", 0) or 0),
+                    sticky=sticky)
                 self._next_bulk_id += 1
                 for job in jobs:
                     if job.skipped:
@@ -227,7 +276,9 @@ class Master:
                     bulk.job_custom_sinks[job.job_idx] = \
                         list(job.custom_sinks.values())
                     bulk.job_output_rows[job.job_idx] = job.jr.output_rows
-                    bulk.queue.extend(sorted(tasks))
+                    bulk.queue[job.job_idx] = deque(
+                        sorted(t for _j, t in tasks))
+                    bulk.job_rr.append(job.job_idx)
                     bulk.total_tasks += len(tasks)
                 self._bulk = bulk
                 self._no_worker_since = time.time()
@@ -271,12 +322,57 @@ class Master:
             if window:
                 # per-worker in-flight window: don't let one node's
                 # loaders hoard the queue while its siblings idle
-                if bulk.held.get(wid, 0) >= window and bulk.queue:
+                if bulk.held.get(wid, 0) >= window and bulk.q_has_work():
                     return {"status": "wait"}
-            while bulk.queue:
-                j, t = bulk.queue.popleft()
-                if j in bulk.blacklisted_jobs or (j, t) in bulk.done:
+            # round-robin over jobs; a sticky (stateful-affinity) job
+            # bound to a live other worker is skipped as a whole, so it
+            # can never starve later jobs for this worker
+            got = None
+            if bulk.sticky:
+                # finish the worker's current chained job before taking
+                # another: job switches reset the evaluator's kernel
+                # streams and would carry-miss every task
+                jc = bulk.sticky_cur.get(wid)
+                dq = bulk.queue.get(jc) if jc is not None else None
+                if dq and jc not in bulk.blacklisted_jobs \
+                        and bulk.sticky_worker.get(jc) == wid:
+                    while dq and got is None:
+                        t = dq.popleft()
+                        if (jc, t) not in bulk.done:
+                            got = (jc, t)
+                    if not dq:
+                        bulk.queue.pop(jc, None)
+                elif jc is not None:
+                    bulk.sticky_cur.pop(wid, None)
+            for _ in range(len(bulk.job_rr)) if got is None else ():
+                j = bulk.job_rr.popleft()
+                dq = bulk.queue.get(j)
+                if not dq or j in bulk.blacklisted_jobs:
+                    bulk.queue.pop(j, None)   # drop from the ring
                     continue
+                if bulk.sticky:
+                    bw = bulk.sticky_worker.get(j)
+                    w2 = self._workers.get(bw) if bw is not None else None
+                    if w2 is None or not w2.active:
+                        bulk.sticky_worker[j] = wid  # bind (or re-bind)
+                        bulk.sticky_cur[wid] = j
+                    elif bw != wid:
+                        bulk.job_rr.append(j)
+                        continue
+                    else:
+                        bulk.sticky_cur[wid] = j
+                while dq and got is None:
+                    t = dq.popleft()
+                    if (j, t) not in bulk.done:
+                        got = (j, t)
+                if dq:
+                    bulk.job_rr.append(j)
+                else:
+                    bulk.queue.pop(j, None)
+                if got is not None:
+                    break
+            if got is not None:
+                j, t = got
                 attempt = bulk.next_attempt
                 bulk.next_attempt += 1
                 bulk.outstanding[(j, t)] = (wid, time.time(), attempt,
@@ -286,7 +382,7 @@ class Master:
                             "(attempt %d)", j, t, wid, attempt)
                 return {"status": "task", "job_idx": j, "task_idx": t,
                         "attempt": attempt}
-            if bulk.outstanding:
+            if bulk.outstanding or bulk.q_has_work():
                 return {"status": "wait"}
             return {"status": "done"}
 
@@ -398,7 +494,7 @@ class Master:
                 self._blacklist_job(bulk, key[0], err)
                 blacklisted_now = True
             else:
-                bulk.queue.append(key)
+                bulk.q_push(key, front=True)
             self._maybe_finish_bulk(bulk)
             finished_now = bulk.finished
         if blacklisted_now and not finished_now:
@@ -458,6 +554,7 @@ class Master:
             "checkpoint_frequency": bulk.checkpoint_frequency,
             "job_ntasks": {j: len(ts) for j, ts in bulk.job_tasks.items()},
             "job_output_rows": dict(bulk.job_output_rows),
+            "sticky": bulk.sticky,
         }
         self.db.backend.write(md.bulk_checkpoint_path(),
                               cloudpickle.dumps(state))
@@ -548,7 +645,9 @@ class Master:
         bulk = _BulkJob(
             bulk_id=state["bulk_id"], spec_blob=state["spec_blob"],
             task_timeout=state["task_timeout"],
-            checkpoint_frequency=state["checkpoint_frequency"])
+            checkpoint_frequency=state["checkpoint_frequency"],
+            # pre-sticky checkpoints default off (missing key)
+            sticky=bool(state.get("sticky", False)))
         for j, n in state["job_ntasks"].items():
             job = jobs[j]
             bulk.job_tasks[j] = {(j, t) for t in range(n)}
@@ -586,10 +685,14 @@ class Master:
                             "admission state")
             bulk.done = set()
             bulk.failures = {}
-        bulk.queue.extend(sorted(
-            k for j, ts in bulk.job_tasks.items()
-            if j not in bulk.blacklisted_jobs
-            for k in ts if k not in bulk.done))
+        for j, ts in sorted(bulk.job_tasks.items()):
+            if j in bulk.blacklisted_jobs:
+                continue
+            remaining = sorted(t for (_j, t) in ts if (_j, t) not in
+                               bulk.done)
+            if remaining:
+                bulk.queue[j] = deque(remaining)
+                bulk.job_rr.append(j)
         self._bulk = bulk
         self._history[bulk.bulk_id] = bulk
         self._next_bulk_id = max(self._next_bulk_id, bulk.bulk_id + 1)
@@ -605,7 +708,7 @@ class Master:
             _mlog.info(
                 "recovered bulk %d from checkpoint: %d/%d tasks done, "
                 "%d requeued", bulk.bulk_id, len(bulk.done),
-                bulk.total_tasks, len(bulk.queue))
+                bulk.total_tasks, bulk.q_count())
 
     # -- internals ----------------------------------------------------------
 
@@ -637,7 +740,7 @@ class Master:
         bulk.blacklisted_task_total += len(bulk.job_tasks.get(j, ()))
         bulk.done_in_blacklisted += sum(
             1 for k in bulk.job_tasks.get(j, ()) if k in bulk.done)
-        bulk.queue = deque(k for k in bulk.queue if k[0] != j)
+        bulk.queue.pop(j, None)  # the rr ring drops it lazily
         for k in [k for k in bulk.outstanding if k[0] == j]:
             self._unassign(bulk, k)
         if not bulk.error:
@@ -698,7 +801,7 @@ class Master:
                                 if not started:
                                     # never began executing: a queue-wait
                                     # artifact, not a task failure
-                                    bulk.queue.append(key)
+                                    bulk.q_push(key, front=True)
                                     continue
                                 n = bulk.failures.get(key, 0) + 1
                                 bulk.failures[key] = n
@@ -706,7 +809,7 @@ class Master:
                                     self._blacklist_job(
                                         bulk, key[0], "task timeout")
                                 else:
-                                    bulk.queue.append(key)
+                                    bulk.q_push(key, front=True)
                         self._maybe_finish_bulk(bulk)
                     # no workers at all
                     if not any(w.active for w in self._workers.values()):
@@ -735,7 +838,7 @@ class Master:
         for key, (owner, _t0, _a, _s, _ed) in list(bulk.outstanding.items()):
             if owner == wid:
                 self._unassign(bulk, key)
-                bulk.queue.append(key)
+                bulk.q_push(key, front=True)
 
     def wait_for_shutdown(self) -> None:
         while not self._shutdown.is_set():
@@ -886,6 +989,10 @@ class Worker:
             or self._default_pipeline_instances)
         self._queue_size = int(getattr(perf, "queue_size_per_pipeline", 4))
         info, jobs = self.executor.prepare_readonly(outputs, perf)
+        # stateful task affinity: incremental plans when the master's
+        # sticky assignment hands us a job's tasks in order (any break
+        # degrades to self-contained plans / StateCarryMiss re-runs)
+        self.executor.setup_chains(info, jobs, perf)
         with self._eval_lock:
             for te in self._evaluators.values():
                 te.close()

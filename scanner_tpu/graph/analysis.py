@@ -313,11 +313,18 @@ class TaskPlan:
     # Input node id -> rows of the stored stream to load/decode
     source_rows: Dict[int, np.ndarray]
     slice_group: int
+    # (unbounded-state node id, slice group) -> last compute row this
+    # plan advances the kernel through; the NEXT task of an affinity
+    # chain may start its recompute after this watermark
+    carry_watermarks: Dict[Tuple[int, int], int] = field(
+        default_factory=dict)
 
 
 def derive_task_streams(info: GraphInfo, jr: JobRows,
                         output_range: Tuple[int, int],
-                        job_idx: int = 0, task_idx: int = 0) -> TaskPlan:
+                        job_idx: int = 0, task_idx: int = 0,
+                        carry: Optional[Dict[Tuple[int, int], int]] = None
+                        ) -> TaskPlan:
     out_rows = np.arange(output_range[0], output_range[1], dtype=np.int64)
 
     required_out: Dict[int, set] = {n.id: set() for n in info.ops}
@@ -326,6 +333,7 @@ def derive_task_streams(info: GraphInfo, jr: JobRows,
 
     streams: Dict[int, TaskStream] = {}
     source_rows: Dict[int, np.ndarray] = {}
+    watermarks: Dict[Tuple[int, int], int] = {}
     slice_group = 0
 
     for n in reversed(info.ops):
@@ -366,12 +374,32 @@ def derive_task_streams(info: GraphInfo, jr: JobRows,
                 # so tasks stay self-contained and reassignable (the
                 # reference instead pins a task's packets to one worker,
                 # save_coordinator worker.cpp:373-415).  Total work is
-                # O(stream_len^2 / io_packet): fine for the trackers such
-                # ops model on typical streams, but callers with very long
-                # streams should Slice() them (per-group state reset
-                # bounds the recompute span) or declare bounded_state.
-                cur = set(range(int(downstream[-1]) + 1)) if len(downstream) \
-                    else set()
+                # O(stream_len^2 / io_packet) — UNLESS the caller opts
+                # into stateful task affinity (PerfParams
+                # .stateful_task_affinity), where `carry` names the row
+                # each kernel's state already advanced through in this
+                # (job, slice group): the task then recomputes only the
+                # rows past the watermark, O(n) total.  The evaluator
+                # verifies the premise at run time (KernelInstance
+                # watermark) and falls back to the self-contained plan on
+                # any mismatch, so correctness never rests on the carry.
+                # Long un-sliced streams WITHOUT affinity should Slice()
+                # (per-group state reset bounds the recompute span) or
+                # declare bounded_state.
+                g = slice_group if info.slice_level[n.id] > 0 else 0
+                lo = 0
+                if carry is not None:
+                    mark = carry.get((n.id, g))
+                    # carry only when every needed output is past the
+                    # watermark — an already-consumed output row cannot
+                    # be re-emitted by a stateful kernel
+                    if mark is not None and len(downstream) \
+                            and int(downstream[0]) > mark:
+                        lo = mark + 1
+                cur = set(range(lo, int(downstream[-1]) + 1)) \
+                    if len(downstream) else set()
+                if len(downstream):
+                    watermarks[(n.id, g)] = int(downstream[-1])
             elif ((n.spec is not None and n.spec.bounded_state is not None)
                   or n.warmup is not None):
                 warmup = n.warmup if n.warmup is not None \
@@ -409,6 +437,9 @@ def derive_task_streams(info: GraphInfo, jr: JobRows,
     for ts in streams.values():
         ts.slice_group = slice_group
 
+    # (sliced nodes sit upstream of their Unslice, so the reversed walk
+    # fixes slice_group before visiting them — watermark keys are final)
     return TaskPlan(job_idx=job_idx, task_idx=task_idx,
                     output_range=output_range, streams=streams,
-                    source_rows=source_rows, slice_group=slice_group)
+                    source_rows=source_rows, slice_group=slice_group,
+                    carry_watermarks=watermarks)

@@ -1,0 +1,220 @@
+"""Stateful task affinity (PerfParams.stateful_task_affinity).
+
+Unbounded-state ops normally force every task to recompute rows 0..end
+(self-contained tasks, O(n^2/io_packet) total); affinity chains a job's
+tasks so kernel state carries forward — O(n) total — with the evaluator
+verifying the premise against real kernel state and falling back to the
+self-contained plan on any break (reference analog: save_coordinator
+packet pinning, worker.cpp:373-415).
+"""
+
+import struct
+import sys
+
+import cloudpickle
+import numpy as np
+import pytest
+
+from scanner_tpu import (CacheMode, Client, FrameType, Kernel, NamedStream,
+                         NamedVideoStream, PerfParams, register_op)
+from scanner_tpu import video as scv
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+N_FRAMES = 96
+
+
+@register_op(name="CountingTracker", unbounded_state=True)
+class CountingTracker(Kernel):
+    """Emits its running row position; counts every execute() row so
+    tests can assert total work (linear vs quadratic)."""
+
+    total_rows = [0]  # class-level: survives across instances in-process
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.reset()
+
+    def reset(self):
+        self.x = 0
+
+    def execute(self, ignore: FrameType) -> bytes:
+        CountingTracker.total_rows[0] += 1
+        v = self.x
+        self.x += 1
+        return struct.pack("=q", v)
+
+
+@pytest.fixture()
+def sc(tmp_path):
+    vid = str(tmp_path / "v.mp4")
+    scv.synthesize_video(vid, num_frames=N_FRAMES, width=64, height=48,
+                         fps=24, keyint=8)
+    # one loader so chained tasks arrive at the evaluator in plan order
+    # (reordering is CORRECT — it just costs a fallback recompute — but
+    # the linear-work assertion wants the deterministic path)
+    c = Client(db_path=str(tmp_path / "db"), num_load_workers=1)
+    c.ingest_videos([("t", vid)])
+    yield c
+    c.stop()
+
+
+def _run_tracker(sc, name, affinity, io=8):
+    frame = sc.io.Input([NamedVideoStream(sc, "t")])
+    col = sc.ops.CountingTracker(ignore=frame)
+    out = NamedStream(sc, name)
+    sc.run(sc.io.Output(col, [out]),
+           PerfParams.manual(io, io, stateful_task_affinity=affinity),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    return [struct.unpack("=q", b)[0] for b in out.load()]
+
+
+def test_affinity_linear_work_identical_results(sc):
+    CountingTracker.total_rows[0] = 0
+    base = _run_tracker(sc, "no_aff", affinity=False)
+    work_quadratic = CountingTracker.total_rows[0]
+    assert base == list(range(N_FRAMES))
+    # self-contained tasks recompute 0..end: sum_{t=1..12} 8t = 624
+    n_tasks = N_FRAMES // 8
+    assert work_quadratic == 8 * n_tasks * (n_tasks + 1) // 2
+
+    CountingTracker.total_rows[0] = 0
+    aff = _run_tracker(sc, "aff", affinity=True)
+    work_linear = CountingTracker.total_rows[0]
+    assert aff == base
+    assert work_linear == N_FRAMES, \
+        f"affinity consumed {work_linear} rows, expected {N_FRAMES}"
+
+
+def test_affinity_with_slices_matches_plain(sc):
+    """Per-slice-group state reset still holds under affinity."""
+    def run(name, affinity):
+        frame = sc.io.Input([NamedVideoStream(sc, "t")])
+        sliced = sc.streams.Slice(frame,
+                                  partitions=[sc.partitioner.all(24)])
+        col = sc.ops.CountingTracker(ignore=sliced)
+        unsliced = sc.streams.Unslice(col)
+        out = NamedStream(sc, name)
+        sc.run(sc.io.Output(unsliced, [out]),
+               PerfParams.manual(8, 8, stateful_task_affinity=affinity),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+        return [struct.unpack("=q", b)[0] for b in out.load()]
+
+    assert run("sl_no", False) == [i % 24 for i in range(N_FRAMES)]
+    assert run("sl_yes", True) == [i % 24 for i in range(N_FRAMES)]
+
+
+def test_carry_plan_derivation(sc):
+    """Carry plans recompute only past the watermark; watermarks are
+    reported for the next link of the chain."""
+    from scanner_tpu.engine.executor import LocalExecutor
+    from scanner_tpu.graph import analysis as A
+
+    frame = sc.io.Input([NamedVideoStream(sc, "t")])
+    col = sc.ops.CountingTracker(ignore=frame)
+    outputs = [sc.io.Output(col, [NamedStream(sc, "derive_out")])]
+    ex = LocalExecutor(sc._db)
+    info, jobs = ex.prepare(outputs, PerfParams.manual(8, 8),
+                            cache_mode=CacheMode.Overwrite)
+    jr = jobs[0].jr
+    nid = next(n.id for n in info.ops
+               if n.spec is not None and n.spec.unbounded_state)
+
+    plain = A.derive_task_streams(info, jr, (16, 24))
+    assert plain.streams[nid].compute_rows[0] == 0
+    assert plain.carry_watermarks == {(nid, 0): 23}
+
+    carried = A.derive_task_streams(info, jr, (16, 24),
+                                    carry={(nid, 0): 15})
+    assert carried.streams[nid].compute_rows.tolist() == list(range(16, 24))
+    assert carried.carry_watermarks == {(nid, 0): 23}
+    # sources shrink with the plan: only the new rows decode
+    assert carried.source_rows[info.sources[0].id].tolist() == \
+        list(range(16, 24))
+
+    # a watermark past the needed outputs cannot carry (state can't
+    # re-emit consumed rows): self-contained fallback at plan time
+    stale = A.derive_task_streams(info, jr, (16, 24),
+                                  carry={(nid, 0): 23})
+    assert stale.streams[nid].compute_rows[0] == 0
+
+
+def test_carry_miss_raises_and_fallback_recovers(sc):
+    """Evaluating a carry plan on a kernel whose state is elsewhere
+    raises StateCarryMiss; the executor fallback re-runs self-contained
+    with identical results."""
+    import types
+
+    from scanner_tpu.engine.evaluate import StateCarryMiss, TaskEvaluator
+    from scanner_tpu.engine.executor import LocalExecutor, TaskItem
+    from scanner_tpu.graph import analysis as A
+    from scanner_tpu.util.profiler import Profiler
+
+    frame = sc.io.Input([NamedVideoStream(sc, "t")])
+    col = sc.ops.CountingTracker(ignore=frame)
+    outputs = [sc.io.Output(col, [NamedStream(sc, "miss_out")])]
+    ex = LocalExecutor(sc._db)
+    info, jobs = ex.prepare(outputs, PerfParams.manual(8, 8),
+                            cache_mode=CacheMode.Overwrite)
+    job = jobs[0]
+    nid = next(n.id for n in info.ops
+               if n.spec is not None and n.spec.unbounded_state)
+
+    te = TaskEvaluator(info, Profiler())
+    try:
+        # carry plan claiming state at row 15 — but this evaluator is
+        # fresh: premise broken, must raise (silent reset would emit
+        # wrong values)
+        w = TaskItem(job, 2, (16, 24))
+        w.plan = A.derive_task_streams(info, job.jr, (16, 24), job_idx=0,
+                                       task_idx=2, carry={(nid, 0): 15})
+        w.elements = ex._load_sources(info, w, types.SimpleNamespace())
+        with pytest.raises(StateCarryMiss):
+            te.execute_task(job.jr, w.plan, w.elements)
+
+        # the executor-level fallback reloads + re-runs self-contained
+        w.elements = ex._load_sources(info, w, types.SimpleNamespace())
+        res = ex._evaluate_with_fallback(info, te, w,
+                                         types.SimpleNamespace())
+        sink_id = info.sinks[0].id
+        vals = [struct.unpack("=q", b)[0]
+                for b in res[sink_id].elements()]
+        assert vals == list(range(16, 24))
+    finally:
+        te.close()
+
+
+def test_cluster_sticky_assignment(tmp_path):
+    """With affinity, the master hands every task of the job to ONE
+    worker, in order; results match the single-node run."""
+    import scanner_tpu.kernels  # noqa: F401
+    from scanner_tpu.engine.service import Master, Worker
+
+    db_path = str(tmp_path / "db")
+    vid = str(tmp_path / "v.mp4")
+    scv.synthesize_video(vid, num_frames=N_FRAMES, width=64, height=48,
+                         fps=24, keyint=8)
+    seed = Client(db_path=db_path)
+    seed.ingest_videos([("t", vid)])
+    master = Master(db_path=db_path, no_workers_timeout=10.0)
+    addr = f"localhost:{master.port}"
+    workers = [Worker(addr, db_path=db_path) for _ in range(2)]
+    sc = Client(db_path=db_path, master=addr)
+    try:
+        CountingTracker.total_rows[0] = 0
+        frame = sc.io.Input([NamedVideoStream(sc, "t")])
+        col = sc.ops.CountingTracker(ignore=frame)
+        out = NamedStream(sc, "aff_dist")
+        sc.run(sc.io.Output(col, [out]),
+               PerfParams.manual(8, 8, stateful_task_affinity=True),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+        got = [struct.unpack("=q", b)[0] for b in out.load()]
+        assert got == list(range(N_FRAMES))
+        bulk = master._history[max(master._history)]
+        assert bulk.sticky
+        assert len(set(bulk.sticky_worker.values())) == 1
+    finally:
+        sc.stop()
+        for w in workers:
+            w.stop()
+        master.stop()

@@ -569,7 +569,9 @@ def test_scheduler_dispatch_throughput(tmp_path):
             bulk.job_sink_names[j] = []
             bulk.job_custom_sinks[j] = []
             bulk.job_output_rows[j] = 0
-            bulk.queue.extend(sorted(tasks))
+            bulk.queue[j] = __import__("collections").deque(
+                sorted(t for _j, t in tasks))
+            bulk.job_rr.append(j)
             bulk.total_tasks += len(tasks)
         with master._lock:
             master._bulk = bulk
@@ -625,7 +627,9 @@ def test_scheduler_concurrent_dispatch_stress(tmp_path):
             bulk.job_sink_names[j] = []
             bulk.job_custom_sinks[j] = []
             bulk.job_output_rows[j] = 0
-            bulk.queue.extend(sorted(tasks))
+            bulk.queue[j] = __import__("collections").deque(
+                sorted(t for _j, t in tasks))
+            bulk.job_rr.append(j)
             bulk.total_tasks += len(tasks)
         with master._lock:
             master._bulk = bulk
