@@ -113,6 +113,52 @@ def _report_capture() -> bool:
     return True
 
 
+# model configs: engine op + constructor args (must match pipeline())
+_MODEL_CFG_OPS = {3: ("PoseDetect", {"width": 8}),
+                  4: ("ObjectDetect", {"width": 8}),
+                  5: ("FaceEmbedding", {"width": 8}),
+                  7: ("InstanceSegment", {"width": 8})}
+# peak dense bf16 FLOP/s per chip by generation (public spec sheets)
+_PEAK_BF16 = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
+
+
+def _annotate_mfu(detail, platform):
+    """Attach model FLOPs/frame, achieved TFLOP/s and (on TPU) MFU to
+    each model config's record: the configs that most need the chip
+    carry a utilization number, not just fps.  FLOPs come from XLA's
+    own cost analysis of the kernel's jitted inference
+    (models/*.infer_cost_flops)."""
+    import jax
+    import numpy as np
+
+    from scanner_tpu.common import DeviceType
+    from scanner_tpu.graph.ops import KernelConfig, registry
+
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    peak = _PEAK_BF16.get(gen) if platform == "tpu" else None
+    batch = np.zeros((32, H, W, 3), np.uint8)
+    cfg = KernelConfig(device=DeviceType.TPU, devices=list(jax.devices()))
+    for d in detail:
+        op = _MODEL_CFG_OPS.get(d.get("config"))
+        if op is None:
+            continue
+        name, kw = op
+        try:
+            kern = registry.get(name).kernel_factory(cfg, **kw)
+            flops = kern.infer_cost_flops(batch)
+        except Exception as e:  # noqa: BLE001 — never fail the bench
+            d["mfu_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+            continue
+        if not flops:
+            continue
+        per_frame = flops / len(batch)
+        d["model_flops_per_frame"] = round(per_frame)
+        d["achieved_tflops"] = round(per_frame * d["fps"] / 1e12, 4)
+        if peak:
+            d["mfu"] = round(per_frame * d["fps"] / peak, 6)
+            d["peak_tflops"] = peak / 1e12
+
+
 def main():
     if not _tpu_reachable():
         print("bench: TPU backend unreachable, falling back to CPU",
@@ -240,6 +286,7 @@ def main():
             return d
 
         detail = [run_config(c) for c in _configs()]
+        _annotate_mfu(detail, platform)
         for d in detail:
             print(f"bench: config {d['config']}: {d['fps']} fps "
                   f"({d['frames']} frames, {d['platform']})",

@@ -149,6 +149,21 @@ def unpack_detections(row) -> Dict[str, np.ndarray]:
     return {"boxes": a[keep, :4], "scores": a[keep, 4]}
 
 
+def anchored_cost_flops(kern, batch):
+    """Shared MFU probe for the anchors-based detection family
+    (ObjectDetect / FaceDetect / InstanceSegment): resolve the batch's
+    stride-16 anchor grid like execute() does, then ask XLA's cost
+    analysis for the jitted inference's FLOPs (infer.lowered_flops)."""
+    from .infer import lowered_flops
+    images = jnp.asarray(batch)
+    fh = -(-images.shape[1] // 16)
+    fw = -(-images.shape[2] // 16)
+    if (fh, fw) not in kern._anchors:
+        kern._anchors[(fh, fw)] = jnp.asarray(make_anchors(fh, fw))
+    return lowered_flops(kern._infer, kern.params, images,
+                         kern._anchors[(fh, fw)])
+
+
 @register_op(device=DeviceType.TPU, batch=8)
 class ObjectDetect(Kernel):
     """Per-frame object detections as packed (top_k, 6) rows
@@ -188,6 +203,11 @@ class ObjectDetect(Kernel):
             return packed
 
         self._infer = infer
+
+    def infer_cost_flops(self, batch):
+        """XLA-reported FLOPs for one inference call on `batch` (for
+        the bench's MFU accounting); None when unavailable."""
+        return anchored_cost_flops(self, batch)
 
     def execute(self, frame: Sequence[FrameType]) -> Sequence[Any]:
         """Returns a (B, top_k, 6) float32 batch — per row a (top_k, 6)

@@ -86,6 +86,11 @@ class FaceEmbedding(Kernel):
                                      config.devices)
         self.params = self._dp.params
 
+    def infer_cost_flops(self, batch):
+        """XLA-reported FLOPs for one inference call on `batch` (for
+        the bench's MFU accounting); None when unavailable."""
+        return self._dp.cost_flops(jnp.asarray(batch))
+
     def execute(self, frame: Sequence[FrameType]) -> Sequence[Any]:
         # (B, dim) embeddings returned without a host sync (device arrays
         # chain through the column store; the sink fetches once per task)

@@ -21,6 +21,20 @@ from typing import Any, List, Optional, Sequence
 import numpy as np
 
 
+def lowered_flops(jitfn, *args):
+    """XLA cost-analysis FLOPs of one call of a jitted function (None if
+    the backend/compiler does not report them).  Drives the bench's MFU
+    column: achieved FLOP/s vs the chip's peak."""
+    try:
+        ca = jitfn.lower(*args).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:  # noqa: BLE001
+        return None
+
+
 class DataParallelApply:
     """Wraps a jitted `apply(params, batch)` with per-host dp sharding."""
 
@@ -40,6 +54,10 @@ class DataParallelApply:
         else:
             self._mesh = None
             self.params = params
+
+    def cost_flops(self, *args):
+        """XLA cost-analysis FLOPs of one apply() call on `args`."""
+        return lowered_flops(self._apply, self.params, *args)
 
     def __call__(self, batch):
         if self._mesh is None or len(batch) == 0:

@@ -358,6 +358,11 @@ class PoseDetect(Kernel):
                                      config.devices)
         self.params = self._dp.params
 
+    def infer_cost_flops(self, batch):
+        """XLA-reported FLOPs for one inference call on `batch` (for
+        the bench's MFU accounting); None when unavailable."""
+        return self._dp.cost_flops(jnp.asarray(batch)[:, None])
+
     def execute(self, frame: Sequence[FrameType]) -> Sequence[Any]:
         clip = jnp.asarray(frame)[:, None]  # (B, 1, H, W, 3)
         # (B, K, 3) [x, y, score] in heatmap coords, returned WITHOUT a

@@ -242,6 +242,12 @@ class InstanceSegment(Kernel):
 
         self._infer = infer
 
+    def infer_cost_flops(self, batch):
+        """XLA-reported FLOPs for one inference call on `batch` (for
+        the bench's MFU accounting); None when unavailable."""
+        from .detection import anchored_cost_flops
+        return anchored_cost_flops(self, batch)
+
     def execute(self, frame: Sequence[FrameType]) -> Sequence[Any]:
         """Returns a (B, top_k, 6 + M*M) float32 batch, device-resident
         (single fetch per task at the sink, PERF.md §1)."""
