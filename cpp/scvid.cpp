@@ -56,7 +56,7 @@ SCVID_API void scvid_set_log_level(int level) { av_log_set_level(level); }
 // Bumped whenever the exported symbol set or struct layouts change; the
 // Python loader (video/lib.py) refuses a mismatched prebuilt .so with a
 // clear "rebuild" error instead of a late AttributeError.
-SCVID_API int32_t scvid_api_version() { return 2; }
+SCVID_API int32_t scvid_api_version() { return 3; }
 
 // ---------------------------------------------------------------------------
 // Ingest: demux a container, write the packet stream, return the index.
@@ -453,6 +453,114 @@ SCVID_API int64_t scvid_decode_run(ScvidDecoder* d, const uint8_t* packets,
 
 SCVID_API int64_t scvid_decoder_emitted(ScvidDecoder* d) { return d->emitted; }
 
+// Resumable pts-matched decode with a HARD frame budget: stops (instead
+// of erroring) when `max_frames` matched frames have been written, and
+// reports how many packets were consumed so the caller re-feeds the
+// remainder on the next call.  This is the primitive behind chunked
+// work-packet streaming: a bounded output buffer (a work packet, not a
+// packet run + reorder-margin) regardless of codec delay.  Unlike
+// scvid_decode_run_pts, the codec is NOT flushed/reset at the end —
+// call scvid_decoder_reset when the logical run is abandoned.
+//
+//   flush=1 + all packets consumed: EOF is sent and the tail drained
+//   (a repeated EOF send from a resumed call is tolerated).
+//   Returns frames written, or -1 on error; *consumed = packets fed.
+//   No progress (written==0 && *consumed==0) on a flush call means the
+//   stream is drained dry — any undelivered wanted frames will never
+//   come (caller retries from an earlier keyframe or reports).
+SCVID_API int64_t scvid_decode_run_pts_stream(
+    ScvidDecoder* d, const uint8_t* packets, const uint64_t* pkt_sizes,
+    const int64_t* pkt_pts, int64_t n_packets, const int64_t* wanted_pts,
+    int64_t n_wanted, uint8_t* deliv, int32_t flush, int64_t max_frames,
+    uint8_t* out, int64_t out_capacity, int64_t* out_dims,
+    int64_t* consumed) {
+  int64_t written = 0;
+  int64_t cursor = 0;
+  int64_t frame_bytes = 0;
+  AVPacket* pkt = av_packet_alloc();
+  const uint8_t* cur = packets;
+  memset(deliv, 0, (size_t)n_wanted);
+  *consumed = 0;
+
+  // 0 = drained (EAGAIN/EOF), 1 = budget reached, -1 = error
+  auto drain = [&]() -> int {
+    while (true) {
+      if (written >= max_frames) return 1;
+      int err = avcodec_receive_frame(d->ctx, d->frame);
+      if (err == AVERROR(EAGAIN) || err == AVERROR_EOF) return 0;
+      if (err < 0) {
+        set_av_error("receive_frame", err);
+        return -1;
+      }
+      if (frame_bytes == 0) {
+        out_dims[0] = d->frame->height;
+        out_dims[1] = d->frame->width;
+        frame_bytes = frame_out_bytes(d, d->frame->height, d->frame->width);
+      } else if (d->frame->height != out_dims[0] ||
+                 d->frame->width != out_dims[1]) {
+        set_error("frame geometry changed mid-run (mid-stream SPS change?)");
+        return -1;
+      }
+      d->emitted++;
+      int64_t fpts = d->frame->best_effort_timestamp != AV_NOPTS_VALUE
+                         ? d->frame->best_effort_timestamp
+                         : d->frame->pts;
+      while (cursor < n_wanted && wanted_pts[cursor] < fpts) cursor++;
+      if (cursor < n_wanted && wanted_pts[cursor] == fpts) {
+        if ((written + 1) * frame_bytes > out_capacity) {
+          set_error("decode output exceeds buffer capacity (geometry "
+                    "mismatch with index?)");
+          return -1;
+        }
+        if (convert_frame(d, out + written * frame_bytes) < 0) return -1;
+        deliv[cursor] = 1;
+        cursor++;
+        written++;
+      }
+      av_frame_unref(d->frame);
+    }
+  };
+
+  // resume: harvest frames the codec already holds from earlier calls
+  int dr = drain();
+  if (dr < 0) {
+    av_packet_free(&pkt);
+    return -1;
+  }
+  for (int64_t i = 0; dr == 0 && i < n_packets; ++i) {
+    av_packet_unref(pkt);
+    pkt->data = const_cast<uint8_t*>(cur);
+    pkt->size = (int)pkt_sizes[i];
+    pkt->pts = pkt_pts[i];
+    int err;
+    while ((err = avcodec_send_packet(d->ctx, pkt)) == AVERROR(EAGAIN)) {
+      dr = drain();
+      if (dr != 0) break;
+    }
+    if (dr != 0) break;  // budget reached mid-EAGAIN: packet NOT consumed
+    if (err < 0) {
+      set_av_error("send_packet", err);
+      av_packet_free(&pkt);
+      return -1;
+    }
+    cur += pkt_sizes[i];
+    (*consumed)++;
+    dr = drain();
+  }
+  if (dr == 0 && flush && *consumed == n_packets) {
+    int err = avcodec_send_packet(d->ctx, nullptr);
+    // a resumed flush call re-sends EOF: AVERROR_EOF is expected then
+    if (err < 0 && err != AVERROR_EOF) {
+      set_av_error("send_packet(EOF)", err);
+      av_packet_free(&pkt);
+      return -1;
+    }
+    dr = drain();
+  }
+  av_packet_free(&pkt);
+  return dr < 0 ? -1 : written;
+}
+
 // Pts-matched variant of scvid_decode_run: packets carry their container
 // pts, and frames are selected by timestamp membership instead of emission
 // position.  This stays exact on streams where positional masks break:
@@ -474,84 +582,15 @@ SCVID_API int64_t scvid_decode_run_pts(
     const int64_t* pkt_pts, int64_t n_packets, const int64_t* wanted_pts,
     int64_t n_wanted, uint8_t* deliv, int32_t flush, uint8_t* out,
     int64_t out_capacity, int64_t* out_dims) {
-  int64_t written = 0;
-  int64_t cursor = 0;  // next wanted_pts candidate (emission is pts-ordered)
-  int64_t frame_bytes = 0;
-  AVPacket* pkt = av_packet_alloc();
-  const uint8_t* cur = packets;
-  memset(deliv, 0, (size_t)n_wanted);
-
-  auto drain = [&]() -> int {
-    while (true) {
-      int err = avcodec_receive_frame(d->ctx, d->frame);
-      if (err == AVERROR(EAGAIN) || err == AVERROR_EOF) return 0;
-      if (err < 0) {
-        set_av_error("receive_frame", err);
-        return -1;
-      }
-      if (frame_bytes == 0) {
-        out_dims[0] = d->frame->height;
-        out_dims[1] = d->frame->width;
-        frame_bytes = frame_out_bytes(d, d->frame->height, d->frame->width);
-      } else if (d->frame->height != out_dims[0] ||
-                 d->frame->width != out_dims[1]) {
-        set_error("frame geometry changed mid-run (mid-stream SPS change?)");
-        return -1;
-      }
-      d->emitted++;
-      int64_t fpts = d->frame->best_effort_timestamp != AV_NOPTS_VALUE
-                         ? d->frame->best_effort_timestamp
-                         : d->frame->pts;
-      // skip wanted entries the stream has passed (left undelivered)
-      while (cursor < n_wanted && wanted_pts[cursor] < fpts) cursor++;
-      if (cursor < n_wanted && wanted_pts[cursor] == fpts) {
-        if ((written + 1) * frame_bytes > out_capacity) {
-          set_error("decode output exceeds buffer capacity (geometry "
-                    "mismatch with index?)");
-          return -1;
-        }
-        if (convert_frame(d, out + written * frame_bytes) < 0) return -1;
-        deliv[cursor] = 1;
-        cursor++;
-        written++;
-      }
-      av_frame_unref(d->frame);
-    }
-  };
-
-  for (int64_t i = 0; i < n_packets; ++i) {
-    av_packet_unref(pkt);
-    pkt->data = const_cast<uint8_t*>(cur);
-    pkt->size = (int)pkt_sizes[i];
-    pkt->pts = pkt_pts[i];
-    cur += pkt_sizes[i];
-    int err;
-    while ((err = avcodec_send_packet(d->ctx, pkt)) == AVERROR(EAGAIN)) {
-      if (drain() < 0) {
-        av_packet_free(&pkt);
-        return -1;
-      }
-    }
-    if (err < 0) {
-      set_av_error("send_packet", err);
-      av_packet_free(&pkt);
-      return -1;
-    }
-    if (drain() < 0) {
-      av_packet_free(&pkt);
-      return -1;
-    }
-  }
-  if (flush) {
-    avcodec_send_packet(d->ctx, nullptr);
-    if (drain() < 0) {
-      av_packet_free(&pkt);
-      return -1;
-    }
-    avcodec_flush_buffers(d->ctx);
-  }
-  av_packet_free(&pkt);
-  return written;
+  // one-shot = the resumable stream primitive with an unbounded frame
+  // budget, plus the codec flush/reset the streaming caller defers
+  int64_t consumed = 0;
+  int64_t n = scvid_decode_run_pts_stream(
+      d, packets, pkt_sizes, pkt_pts, n_packets, wanted_pts, n_wanted,
+      deliv, flush, INT64_MAX, out, out_capacity, out_dims, &consumed);
+  if (n < 0) return -1;
+  if (flush) avcodec_flush_buffers(d->ctx);
+  return n;
 }
 
 // ---------------------------------------------------------------------------

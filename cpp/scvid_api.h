@@ -51,6 +51,12 @@ int64_t scvid_decode_run_pts(ScvidDecoder* d, const uint8_t* packets,
                              uint8_t* deliv, int32_t flush, uint8_t* out,
                              int64_t out_capacity, int64_t* out_dims);
 int64_t scvid_decoder_emitted(ScvidDecoder* d);
+int64_t scvid_decode_run_pts_stream(
+    ScvidDecoder* d, const uint8_t* packets, const uint64_t* pkt_sizes,
+    const int64_t* pkt_pts, int64_t n_packets, const int64_t* wanted_pts,
+    int64_t n_wanted, uint8_t* deliv, int32_t flush, int64_t max_frames,
+    uint8_t* out, int64_t out_capacity, int64_t* out_dims,
+    int64_t* consumed);
 
 ScvidEncoder* scvid_encoder_create(int32_t width, int32_t height,
                                    int32_t fps_num, int32_t fps_den,

@@ -103,6 +103,15 @@ class PerfParams:
     # failed task, worker death — falls back to the self-contained
     # recompute, so results never depend on the affinity holding.
     stateful_task_affinity: bool = False
+    # Work-packet streaming: a task's io packet never materializes
+    # whole — the loader decodes work-packet-sized chunks through an
+    # incremental decoder session and the evaluator consumes them as
+    # they arrive, carrying kernel state across chunk boundaries.
+    # Bounds peak memory to a few work packets per task (the 4K case)
+    # and overlaps decode/h2d/compute inside a task (reference element
+    # cache + feeder threads, evaluate_worker.h:207-218).
+    # SCANNER_TPU_STREAM_PACKETS=0 is the global kill switch.
+    stream_work_packets: bool = True
 
     # reference-compat kwargs that are meaningless on TPU and accepted but
     # ignored (XLA owns device/host memory pooling; there is no CUDA pool

@@ -40,6 +40,8 @@ from .batch import ColumnBatch, concat_batches
 from .evaluate import TaskEvaluator
 
 _SENTINEL = object()
+_CHUNK_DONE = object()   # streaming producer: all chunks delivered
+_CHUNK_ERR = object()    # streaming producer: (marker, exception)
 
 _log = get_logger("engine")
 
@@ -78,6 +80,12 @@ class TaskItem:
     # master-assigned attempt id (cluster mode): distinguishes re-issues
     # of the same task after a timeout revocation
     attempt: int = 0
+    # work-packet streaming (PerfParams.stream_work_packets): the task's
+    # per-chunk plans, the loader->evaluator chunk queue, and the abort
+    # handshake (evaluator failure must unblock a producing loader)
+    chunk_plans: Optional[List[A.TaskPlan]] = None
+    chunk_q: Optional["queue.Queue"] = None
+    chunk_abort: Optional[threading.Event] = None
 
 
 class _StatefulChain:
@@ -154,6 +162,8 @@ class LocalExecutor:
         self._device_bound_lock = threading.Lock()
         # job idx -> _StatefulChain when stateful task affinity is active
         self._chains: Dict[int, _StatefulChain] = {}
+        # PerfParams.stream_work_packets, latched per run/bulk
+        self._stream_opt = True
 
     # ------------------------------------------------------------------
     # Job-set preparation (reference master.cpp:1367 process_job admission)
@@ -419,6 +429,7 @@ class LocalExecutor:
             show_progress: bool = False) -> List[JobContext]:
         info, jobs = self.prepare(outputs, perf, cache_mode)
         self.setup_chains(info, jobs, perf)
+        self._stream_opt = bool(getattr(perf, "stream_work_packets", True))
         self.profiler.level = int(getattr(perf, "profiler_level", 1))
         work = [TaskItem(job, t, rng)
                 for job in jobs if not job.skipped
@@ -538,12 +549,18 @@ class LocalExecutor:
                         except Exception as e:  # noqa: BLE001
                             task_failed(w, e)
                             continue
+                        placed = False
                         while not stop.is_set():
                             try:
                                 eval_q.put(w, timeout=0.25)
+                                placed = True
                                 break
                             except queue.Full:
                                 pass
+                        if placed and w.chunk_plans is not None:
+                            # streaming task: decode chunks into its
+                            # bounded queue while the evaluator consumes
+                            self._produce_chunks(info, w, tls, stop=stop)
                 finally:
                     # release decoder handles held by this loader thread
                     for auto in getattr(tls, "automata", {}).values():
@@ -582,12 +599,18 @@ class LocalExecutor:
                         break
                     try:
                         if on_start is not None and on_start(w) is False:
+                            if w.chunk_abort is not None:
+                                w.chunk_abort.set()  # unblock the loader
                             continue  # revoked attempt: drop silently
                         with self.profiler.span("evaluate", level=0,
                                                 task=w.task_idx,
                                                 job=w.job.job_idx):
-                            w.results = self._evaluate_with_fallback(
-                                info, te, w, fb_tls)
+                            if w.chunk_q is not None:
+                                w.results = self._consume_chunks(
+                                    info, te, w, fb_tls, stop=stop)
+                            else:
+                                w.results = self._evaluate_with_fallback(
+                                    info, te, w, fb_tls)
                         w.elements = None
                     except Exception as e:  # noqa: BLE001
                         task_failed(w, e)
@@ -681,6 +704,7 @@ class LocalExecutor:
         """The NO_PIPELINING path: every stage inline on this thread."""
         import types
         tls = types.SimpleNamespace()
+        fb_tls = types.SimpleNamespace()  # carry-miss fallback decoders
         if evaluator_factory is not None:
             te = evaluator_factory(0, False)
         else:
@@ -707,8 +731,18 @@ class LocalExecutor:
                     with self.profiler.span("evaluate", level=0,
                                             task=w.task_idx,
                                             job=w.job.job_idx):
-                        w.results = self._evaluate_with_fallback(
-                            info, te, w, tls)
+                        if w.chunk_plans is not None:
+                            # inline streaming on this one thread; the
+                            # carry-miss fallback loads through fb_tls —
+                            # NOT tls, whose decoder sessions are
+                            # suspended mid-run and must not be reset
+                            w.results = self._consume_iter(
+                                info, te, w,
+                                self._iter_chunk_items(info, w, tls),
+                                fb_tls)
+                        else:
+                            w.results = self._evaluate_with_fallback(
+                                info, te, w, fb_tls)
                     w.elements = None
                 except Exception as e:  # noqa: BLE001
                     if on_task_error is not None and on_task_error(w, e):
@@ -731,8 +765,9 @@ class LocalExecutor:
                 if show_progress:
                     print(f"\rtasks {done}/{total}", end="", flush=True)
         finally:
-            for auto in getattr(tls, "automata", {}).values():
-                auto.close()
+            for ns in (tls, fb_tls):
+                for auto in getattr(ns, "automata", {}).values():
+                    auto.close()
             if close_evaluators:
                 te.close()
         if show_progress:
@@ -740,6 +775,189 @@ class LocalExecutor:
         return done
 
     # ------------------------------------------------------------------
+
+    # ------------------------------------------------------------------
+    # Work-packet streaming (PerfParams.stream_work_packets)
+    # ------------------------------------------------------------------
+
+    class _VideoFeed:
+        """Incremental frame supply for one video source node of one
+        streaming task: per-item decoder sessions
+        (DecoderAutomata.stream_frames) chained in row order, a small
+        row->frame buffer, and retention driven by the later chunks'
+        minimum row so stencil back-reach is served from memory instead
+        of a per-chunk keyframe re-decode (the reference's element
+        cache, evaluate_worker.h:207-218)."""
+
+        def __init__(self, ex: "LocalExecutor", w: TaskItem, tls,
+                     node_id: int, si, plans: List[A.TaskPlan],
+                     output_format: str):
+            desc = si["table"]
+            all_rows = np.unique(np.concatenate([
+                np.asarray(p.source_rows[node_id], np.int64)
+                for p in plans]))
+            # suffix minima: after serving chunk i, rows below the
+            # smallest row any LATER chunk requests can be dropped
+            mins = [int(np.asarray(p.source_rows[node_id]).min())
+                    if len(p.source_rows[node_id]) else np.iinfo(np.int64).max
+                    for p in plans]
+            suffix = []
+            cur = np.iinfo(np.int64).max
+            for m in reversed(mins):
+                suffix.append(cur)
+                cur = min(cur, m)
+            self._keep_from = list(reversed(suffix))  # per chunk index
+            self._chunk_i = 0
+            self._buf: Dict[int, Any] = {}
+
+            # decode in slices matched to the chunk row count so peak
+            # scratch/buffer is ~one work packet, not a fixed constant
+            wp_est = max(4, max(len(p.source_rows[node_id])
+                                for p in plans))
+
+            # the streamable guard (load_task) pins the task to ONE
+            # item; its own descriptor drives the convert-mark geometry
+            # (items of one table may differ — same rule as the
+            # whole-task loader's per-item marks)
+            item = desc.item_of_row(int(all_rows[0]))
+            item_start, _ = desc.item_bounds(item)
+            auto = ex._automata(tls, w.job, node_id, si, item,
+                                output_format=output_format)
+            self.convert = (("yuv420", auto.vd.height, auto.vd.width)
+                            if output_format == "yuv420" else None)
+
+            def gen():
+                for rr, fr in auto.stream_frames(
+                        (all_rows - item_start).tolist(),
+                        packets_per_call=wp_est,
+                        max_frames_per_yield=wp_est):
+                    yield rr + item_start, fr
+
+            self._gen = gen()
+
+        def batch_for(self, rows: Sequence[int]) -> ColumnBatch:
+            rows_arr = np.asarray(rows, np.int64)
+            need = set(rows_arr.tolist()) - self._buf.keys()
+            while need:
+                rr, fr = next(self._gen)  # StopIteration = decode bug
+                for r, f in zip(rr.tolist(), fr):
+                    self._buf[r] = f
+                need -= set(rr.tolist())
+            data = np.stack([self._buf[int(r)] for r in rows_arr]) \
+                if len(rows_arr) else np.zeros((0,), np.uint8)
+            keep_from = self._keep_from[self._chunk_i]
+            self._chunk_i += 1
+            for r in [r for r in self._buf if r < keep_from]:
+                del self._buf[r]
+            return ColumnBatch(rows_arr, data, convert=self.convert)
+
+    def _iter_chunk_items(self, info: A.GraphInfo, w: TaskItem, tls):
+        """Yield (plan, elements) per work-packet chunk of a streaming
+        task, decoding incrementally and pre-staging device columns so
+        the h2d of chunk k+1 rides under the compute of chunk k."""
+        feeds: Dict[int, LocalExecutor._VideoFeed] = {}
+        for nid in w.chunk_plans[0].source_rows:
+            si = w.job.source_info[nid]
+            if si.get("is_video") and "custom" not in si:
+                fmt = ("yuv420" if self._yuv_device_wire(info, nid)
+                       else "rgb24")
+                feeds[nid] = self._VideoFeed(self, w, tls, nid, si,
+                                             w.chunk_plans, fmt)
+        for plan in w.chunk_plans:
+            elements: Dict[int, ColumnBatch] = {}
+            with self.profiler.span("load", level=0, task=w.task_idx,
+                                    job=w.job.job_idx,
+                                    chunk=plan.output_range[0]):
+                for nid, rows in plan.source_rows.items():
+                    if nid in feeds:
+                        elements[nid] = feeds[nid].batch_for(rows)
+                    else:
+                        elements[nid] = self._load_plain_source(
+                            w, nid, [int(r) for r in rows])
+                self._prestage_device_columns(info, w, elements=elements)
+            yield plan, elements
+
+    def _chunk_put(self, w: TaskItem, item, stop) -> bool:
+        while True:
+            if (stop is not None and stop.is_set()) \
+                    or w.chunk_abort.is_set():
+                return False
+            try:
+                w.chunk_q.put(item, timeout=0.25)
+                return True
+            except queue.Full:
+                pass
+
+    def _produce_chunks(self, info: A.GraphInfo, w: TaskItem, tls,
+                        stop=None) -> None:
+        """Loader-side: decode chunks into the task's bounded queue; a
+        consumer failure (chunk_abort) or pipeline stop unblocks us."""
+        try:
+            for item in self._iter_chunk_items(info, w, tls):
+                if not self._chunk_put(w, item, stop):
+                    return
+            self._chunk_put(w, _CHUNK_DONE, stop)
+        except Exception as e:  # noqa: BLE001 — surfaces on the consumer
+            self._chunk_put(w, (_CHUNK_ERR, e), stop)
+
+    def _consume_iter(self, info: A.GraphInfo, te, w: TaskItem,
+                      chunk_iter, fb_tls) -> Dict[int, ColumnBatch]:
+        """Execute (plan, elements) chunks from any iterator; merge
+        per-sink results in row order (shared by the threaded queue
+        consumer and the serial NO_PIPELINING path)."""
+        parts: Dict[int, List[ColumnBatch]] = {}
+        n = 0
+        for plan, elements in chunk_iter:
+            res = self._execute_chunk(info, te, w, plan, elements, fb_tls)
+            for sid, b in res.items():
+                parts.setdefault(sid, []).append(b)
+            n += 1
+        self.profiler.count("stream_chunks", n)
+        return {sid: concat_batches(lst) for sid, lst in parts.items()}
+
+    def _consume_chunks(self, info: A.GraphInfo, te, w: TaskItem, fb_tls,
+                        stop=None) -> Dict[int, ColumnBatch]:
+        """Evaluator-side: execute chunks as they arrive over the
+        producer queue.  Any failure aborts the producer."""
+
+        def from_queue():
+            while True:
+                try:
+                    item = w.chunk_q.get(timeout=0.25)
+                except queue.Empty:
+                    if stop is not None and stop.is_set():
+                        raise JobException(
+                            "pipeline stopped during streaming task")
+                    continue
+                if item is _CHUNK_DONE:
+                    return
+                if isinstance(item, tuple) and item[0] is _CHUNK_ERR:
+                    raise item[1]
+                yield item
+
+        try:
+            return self._consume_iter(info, te, w, from_queue(), fb_tls)
+        except BaseException:
+            w.chunk_abort.set()
+            raise
+
+    def _execute_chunk(self, info: A.GraphInfo, te, w: TaskItem, plan,
+                       elements, fb_tls) -> Dict[int, ColumnBatch]:
+        from .evaluate import StateCarryMiss
+        try:
+            return te.execute_task(w.job.jr, plan, elements)
+        except StateCarryMiss as e:
+            _log.info("task (%d,%d) chunk %s: %s — re-running "
+                      "self-contained", w.job.job_idx, w.task_idx,
+                      plan.output_range, e)
+            self.profiler.count("state_carry_miss")
+            plan2 = A.derive_task_streams(
+                info, w.job.jr, plan.output_range,
+                job_idx=w.job.job_idx, task_idx=w.task_idx)
+            tmp = TaskItem(w.job, w.task_idx, plan.output_range,
+                           plan=plan2)
+            elements2 = self._load_sources(info, tmp, fb_tls)
+            return te.execute_task(w.job.jr, plan2, elements2)
 
     def _evaluate_with_fallback(self, info: A.GraphInfo, te, w: TaskItem,
                                 fb_tls):
@@ -770,6 +988,50 @@ class LocalExecutor:
             chain = self._chains.get(w.job.job_idx)
             carry = chain.gate_plan(w.task_idx) if chain is not None \
                 else None
+            start, end = w.output_range
+            wp = int(getattr(w.job.jr, "work_packet_size", 0) or 0)
+            if self._stream_packets() and wp > 0 and (end - start) > wp:
+                # Work-packet streaming (reference element cache +
+                # feeder, evaluate_worker.h:207-218): the task's io
+                # packet never materializes whole — per-chunk plans
+                # drive an incremental decode -> h2d -> compute
+                # pipeline; peak memory is a few chunks, and the h2d of
+                # chunk k+1 rides under the compute of chunk k.
+                plans = []
+                cur = dict(carry) if carry else None
+                for cs in range(start, end, wp):
+                    p = A.derive_task_streams(
+                        info, w.job.jr, (cs, min(cs + wp, end)),
+                        job_idx=w.job.job_idx, task_idx=w.task_idx,
+                        carry=cur)
+                    if p.carry_watermarks:
+                        cur = dict(cur or {})
+                        cur.update(p.carry_watermarks)
+                    plans.append(p)
+                # a video source whose rows span multiple table items
+                # keeps the whole-task path: per-item geometry may
+                # differ, which the ragged concat handles and the
+                # streaming feed's uniform batches would not
+                streamable = True
+                for nid in plans[0].source_rows:
+                    si = w.job.source_info[nid]
+                    if si.get("is_video") and "custom" not in si:
+                        desc = si["table"]
+                        items = {desc.item_of_row(int(r))
+                                 for p in plans
+                                 for r in p.source_rows[nid]}
+                        if len(items) > 1:
+                            streamable = False
+                            break
+                if streamable:
+                    if chain is not None:
+                        chain.planned(w.task_idx, cur or {})
+                    w.chunk_plans = plans
+                    w.plan = None
+                    w.elements = None
+                    w.chunk_q = queue.Queue(maxsize=2)
+                    w.chunk_abort = threading.Event()
+                    return w
             w.plan = A.derive_task_streams(
                 info, w.job.jr, w.output_range,
                 job_idx=w.job.job_idx, task_idx=w.task_idx, carry=carry)
@@ -779,8 +1041,16 @@ class LocalExecutor:
             self._prestage_device_columns(info, w)
         return w
 
-    def _prestage_device_columns(self, info: A.GraphInfo,
-                                 w: TaskItem) -> None:
+    def _stream_packets(self) -> bool:
+        import os
+        if os.environ.get("SCANNER_TPU_STREAM_PACKETS", "1") \
+                in ("0", "false"):
+            return False
+        return self._stream_opt
+
+    def _prestage_device_columns(self, info: A.GraphInfo, w: TaskItem,
+                                 elements: Optional[Dict[int, Any]] = None
+                                 ) -> None:
         """Start the host->device transfer of device-bound source columns
         from the LOADER thread.  device_put is async: the copy proceeds
         while this loader decodes the next task and while the evaluator
@@ -792,11 +1062,12 @@ class LocalExecutor:
         from .evaluate import _accel_backend
         if not _accel_backend():
             return
-        for nid, b in w.elements.items():
+        cols = w.elements if elements is None else elements
+        for nid, b in cols.items():
             if self._column_device_bound(info, nid) \
                     and isinstance(b.data, np.ndarray) \
                     and b.data.dtype != object:
-                w.elements[nid] = b.to_device()
+                cols[nid] = b.to_device()
 
     def _yuv_device_wire(self, info: A.GraphInfo, node_id: int) -> bool:
         """Should this video column decode to YUV420 wire format?  Yes
@@ -855,9 +1126,8 @@ class LocalExecutor:
             si = w.job.source_info[node_id]
             rows_arr = np.asarray(rows, np.int64)
             rows_l = [int(r) for r in rows]
-            if "custom" in si:
-                vals = si["custom"].storage.read_rows(si["custom"], rows_l)
-                out[node_id] = ColumnBatch.from_elements(rows_arr, vals)
+            if "custom" in si or not si["is_video"]:
+                out[node_id] = self._load_plain_source(w, node_id, rows_l)
             elif si["is_video"]:
                 # rows are global; multi-item video tables (job outputs)
                 # hold one independently-decodable item per task
@@ -890,16 +1160,25 @@ class LocalExecutor:
                         np.asarray(local, np.int64) + start, frames,
                         convert=convert))
                 out[node_id] = concat_batches(parts)
-            else:
-                from ..storage.streams import decode_element
-                desc = si["table"]
-                vals = list(self.db.load_column(
-                    desc.id, si["column"], rows=rows_l,
-                    sparsity_threshold=w.job.sparsity_threshold))
-                codec = si.get("codec", "raw")
-                out[node_id] = ColumnBatch.from_elements(
-                    rows_arr, [decode_element(v, codec) for v in vals])
         return out
+
+    def _load_plain_source(self, w: TaskItem, node_id: int,
+                           rows_l: List[int]) -> ColumnBatch:
+        """Non-video source rows: custom-storage reads or column loads
+        (shared by the whole-task and per-chunk streaming loaders)."""
+        si = w.job.source_info[node_id]
+        rows_arr = np.asarray(rows_l, np.int64)
+        if "custom" in si:
+            vals = si["custom"].storage.read_rows(si["custom"], rows_l)
+            return ColumnBatch.from_elements(rows_arr, vals)
+        from ..storage.streams import decode_element
+        desc = si["table"]
+        vals = list(self.db.load_column(
+            desc.id, si["column"], rows=rows_l,
+            sparsity_threshold=w.job.sparsity_threshold))
+        codec = si.get("codec", "raw")
+        return ColumnBatch.from_elements(
+            rows_arr, [decode_element(v, codec) for v in vals])
 
     def _automata(self, tls, job: JobContext, node_id: int, si,
                   item: int = 0, output_format: str = "rgb24"):

@@ -993,6 +993,8 @@ class Worker:
         # sticky assignment hands us a job's tasks in order (any break
         # degrades to self-contained plans / StateCarryMiss re-runs)
         self.executor.setup_chains(info, jobs, perf)
+        self.executor._stream_opt = bool(
+            getattr(perf, "stream_work_packets", True))
         with self._eval_lock:
             for te in self._evaluators.values():
                 te.close()

@@ -204,6 +204,86 @@ class DecoderAutomata:
                     f"index stale)")
             start = int(self.index.kf_decs[ki - 1])
 
+    def stream_frames(self, rows: Sequence[int], packets_per_call: int = 16,
+                      max_frames_per_yield: int = 16):
+        """Incrementally decode ascending unique display rows, yielding
+        ``(row_array, frames_array)`` slices as the codec emits them.
+
+        One decode session per keyframe run: packets are fed in slices of
+        ``packets_per_call`` through repeated bounded
+        ``decode_run_pts_stream`` calls WITHOUT resetting the codec (the
+        C layer stops — does not error — at ``max_frames_per_yield``
+        matched frames and reports the packets it consumed, so the
+        output buffer is a work packet, not a packet run plus a
+        reorder-delay margin).  Peak memory is one yield slice.  This is
+        the work-packet streaming loader's decode primitive (reference
+        element cache + feeder threads, evaluate_worker.h:207-218 /
+        decoder_automata.cpp).  Frames arrive in display order; yields
+        are disjoint and cover exactly `rows`.  Open-GOP / false-keyframe
+        retries restart the run from an earlier keyframe for the
+        still-undelivered tail only.
+        """
+        rows_arr = np.unique(np.asarray(list(rows), np.int64))
+        if len(rows_arr) == 0:
+            return
+        frame_bytes = self.frame_bytes
+        shape_tail = ((self.vd.height, self.vd.width, 3)
+                      if self.output_format == "rgb24" else (frame_bytes,))
+        pts_all = np.asarray(self.vd.sample_pts, np.int64)
+        empty_sizes = np.zeros(0, np.uint64)
+        empty_pts = np.zeros(0, np.int64)
+        for run in self.index.plan(rows_arr):
+            out_disp = np.asarray(run.out_disp, np.int64)
+            start = run.start_dec
+            while True:  # open-GOP / false-keyframe retry loop
+                rem_rows = out_disp
+                rem_pts = pts_all[self.index.dec_of_disp[rem_rows]]
+                self.decoder.reset()
+                pos = start
+                while len(rem_rows):
+                    if pos <= run.end_dec:
+                        end = min(pos + packets_per_call - 1, run.end_dec)
+                        data, sizes = self._read_packets(pos, end)
+                        pkt_pts = pts_all[pos:end + 1]
+                    else:
+                        # flush-only continuation: harvest codec backlog
+                        data, sizes, pkt_pts = b"", empty_sizes, empty_pts
+                        end = pos - 1
+                    buf = self._scratch_buf(
+                        max_frames_per_yield * frame_bytes)
+                    n, oh, ow, deliv, consumed = \
+                        self.decoder.decode_run_pts_stream(
+                            data, sizes, pkt_pts, rem_pts,
+                            buf[:max_frames_per_yield * frame_bytes],
+                            max_frames=max_frames_per_yield,
+                            flush=(end >= run.end_dec))
+                    if n and (oh, ow) != (self.vd.height, self.vd.width):
+                        raise ScannerException(
+                            f"decoded geometry {oh}x{ow} != descriptor "
+                            f"{self.vd.height}x{self.vd.width}")
+                    if n:
+                        got = buf[:n * frame_bytes].reshape(
+                            (n,) + shape_tail).copy()
+                        yield rem_rows[deliv], got
+                    rem_rows = rem_rows[~deliv]
+                    rem_pts = rem_pts[~deliv]
+                    pos += consumed
+                    if pos > run.end_dec and n == 0 and consumed == 0:
+                        break  # flushed dry; tail undeliverable here
+                if not len(rem_rows):
+                    break
+                # leading open-GOP frames (or a false keyframe): retry the
+                # undelivered tail from one keyframe earlier
+                out_disp = rem_rows
+                ki = int(np.searchsorted(self.index.kf_decs, start,
+                                         side="right")) - 1
+                if ki <= 0 or start <= 0:
+                    raise ScannerException(
+                        f"frames with pts {rem_pts[:5].tolist()} not "
+                        f"delivered (run {start}..{run.end_dec}; stream "
+                        f"damaged or index stale)")
+                start = int(self.index.kf_decs[ki - 1])
+
     def get_frames(self, rows: Sequence[int]) -> np.ndarray:
         """Decode exactly the given display-order frame indices.
 

@@ -870,10 +870,11 @@ def test_prestage_pipeline_e2e(tmp_path, monkeypatch):
     staged_tasks = []
     orig_prestage = LocalExecutor._prestage_device_columns
 
-    def spy_prestage(self, info, w):
-        orig_prestage(self, info, w)
+    def spy_prestage(self, info, w, elements=None):
+        orig_prestage(self, info, w, elements=elements)
         from scanner_tpu.engine.batch import _is_jax
-        if all(_is_jax(b.data) for b in w.elements.values()):
+        cols = w.elements if elements is None else elements
+        if all(_is_jax(b.data) for b in cols.values()):
             staged_tasks.append(w.task_idx)
     monkeypatch.setattr(LocalExecutor, "_prestage_device_columns",
                         spy_prestage)
@@ -906,8 +907,10 @@ def test_prestage_pipeline_e2e(tmp_path, monkeypatch):
         # H.264 is lossy: compare means with a tolerance
         assert all(abs(a - b) < 4.0 for a, b in zip(got, want))
         # every task (24 rows / 16-row io packets = 2) left the loader
-        # with its source column already on device
-        assert len(staged_tasks) == 2, staged_tasks
+        # with its source column already on device; with work-packet
+        # streaming the staging happens per chunk, so task ids repeat
+        assert set(staged_tasks) == {0, 1}, staged_tasks
+        assert len(staged_tasks) >= 2
     finally:
         client.stop()
 
