@@ -116,12 +116,19 @@ class TaskEvaluator:
                  skip_fetch_resources: bool = False):
         self.info = info
         self.profiler = profiler
-        if devices is None and _accel_backend():
+        if devices is None:
+            import os
+
             # hand every kernel this host's chips: model kernels dp-shard
             # their batches across them (models/infer.py), the TPU
-            # equivalent of the reference pinning one GPU per instance
-            import jax
-            devices = list(jax.local_devices())
+            # equivalent of the reference pinning one GPU per instance.
+            # SCANNER_TPU_KERNEL_DEVICES=all extends this to the CPU
+            # backend so dryruns/tests exercise the dp-sharded kernel
+            # path on a virtual multi-device host.
+            if os.environ.get("SCANNER_TPU_KERNEL_DEVICES") == "all" \
+                    or _accel_backend():
+                import jax
+                devices = list(jax.local_devices())
         self.kernels: Dict[int, KernelInstance] = {}
         for n in info.ops:
             if not n.is_builtin:
