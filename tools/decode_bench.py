@@ -1,0 +1,128 @@
+"""Decode-scaling bench: N loader threads x libav decoder_threads vs fps.
+
+The engine's design claim is "N GIL-free decoders feed one TPU"
+(cpp/scvid.cpp header; reference decoder_cpus / load-worker pools,
+worker.cpp:1631) — this tool puts a measured curve behind it on any
+host.  Each loader thread owns one DecoderAutomata (one codec handle)
+and decodes a distinct row range of the same ingested stream; the C
+calls release the GIL, so throughput should scale with threads until
+cores (or memory bandwidth) saturate.
+
+Run: python tools/decode_bench.py [--frames N] [--width W] [--height H]
+Prints one JSON line per (loaders, decoder_threads) config and writes
+DECODE_BENCH.json; the PERF.md scaling table is transcribed from it.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--frames", type=int, default=384)
+    ap.add_argument("--width", type=int, default=640)
+    ap.add_argument("--height", type=int, default=480)
+    ap.add_argument("--keyint", type=int, default=32)
+    ap.add_argument("--reps", type=int, default=2)
+    args = ap.parse_args()
+
+    from scanner_tpu.storage import Database, PosixStorage
+    from scanner_tpu import video as scv
+
+    ncpu = os.cpu_count() or 1
+    root = tempfile.mkdtemp(prefix="decbench_")
+    vid = os.path.join(root, "clip.mp4")
+    scv.synthesize_video(vid, num_frames=args.frames, width=args.width,
+                         height=args.height, fps=30, keyint=args.keyint)
+    db = Database(PosixStorage(os.path.join(root, "db")))
+    _, failed = scv.ingest_videos(db, [("clip", vid)])
+    assert not failed, failed
+
+    def run_cfg(n_loaders: int, dec_threads: int) -> float:
+        """Aggregate fps: each loader decodes a keyint-ALIGNED share of
+        the stream (every loader seeks to a keyframe, like engine
+        tasks).  Shares are dealt GOP by GOP round-robin so every
+        loader gets work even when ceil-division would starve the last
+        ones (n_loaders must match the thread count the row claims)."""
+        n_gops = -(-args.frames // args.keyint)
+        assert n_loaders <= n_gops, \
+            f"{n_loaders} loaders need >= {n_loaders} GOPs " \
+            f"(have {n_gops}; raise --frames)"
+        shares = [[] for _ in range(n_loaders)]
+        for g in range(n_gops):
+            lo = g * args.keyint
+            hi = min(args.frames, lo + args.keyint)
+            shares[g % n_loaders].extend(range(lo, hi))
+        autos = [scv.open_automata(db, "clip", n_threads=dec_threads)
+                 for _ in shares]
+        try:
+            best = float("inf")
+            for _ in range(args.reps):
+                done = []
+                errs = []
+
+                def work(a, rows):
+                    try:
+                        got = a.get_frames(rows)
+                        done.append(len(got))
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(e)
+
+                ts = [threading.Thread(target=work, args=(a, r))
+                      for a, r in zip(autos, shares)]
+                t0 = time.time()
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                dt = time.time() - t0
+                if errs:
+                    raise errs[0]
+                assert sum(done) == args.frames
+                best = min(best, dt)
+            return args.frames / best
+        finally:
+            for a in autos:
+                a.close()
+
+    configs = []
+    for n_loaders in (1, 2, 4, 8):
+        if n_loaders > max(ncpu * 2, 2):
+            break
+        configs.append((n_loaders, 1))
+    for dec_threads in (2, 4):
+        if dec_threads <= ncpu:
+            configs.append((1, dec_threads))
+    if ncpu >= 4:
+        configs.append((2, 2))
+
+    out = {"host_cpus": ncpu, "frames": args.frames,
+           "geometry": f"{args.width}x{args.height}",
+           "keyint": args.keyint,
+           "clock": time.strftime("%Y-%m-%dT%H:%M:%S"), "rows": []}
+    base = None
+    for n_loaders, dec_threads in configs:
+        fps = run_cfg(n_loaders, dec_threads)
+        if base is None:
+            base = fps
+        row = {"loaders": n_loaders, "decoder_threads": dec_threads,
+               "fps": round(fps, 1), "speedup": round(fps / base, 2)}
+        out["rows"].append(row)
+        print(json.dumps(row), flush=True)
+    with open(os.path.join(REPO, "DECODE_BENCH.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    import shutil
+    shutil.rmtree(root, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
