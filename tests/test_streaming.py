@@ -85,10 +85,16 @@ class StreamTracker(Kernel):
         return struct.pack("=q", v)
 
 
-def test_chunked_state_carry_within_task(tmp_path):
-    """Chunk plans inside one task carry unbounded state chunk-to-chunk
-    (no affinity needed): total rows consumed stays near-linear even
-    though each task holds several work packets."""
+@pytest.mark.parametrize("affinity,expected_rows", [(False, 96), (True, 64)])
+def test_chunked_state_carry(tmp_path, affinity, expected_rows):
+    """Chunk plans inside one task carry unbounded state chunk-to-chunk.
+
+    Without affinity: chunk 0 of each task recomputes the task prefix
+    (rows 0..start), later chunks carry — 2 tasks x 4 chunks over 64
+    rows consume 32 + 64 = 96 rows (vs 2*(8+16+24+32)=160 + prefixes
+    unchunked).  With affinity the inter-task chain stacks on the
+    intra-task carry: every row consumed exactly once (64) — state
+    flows across every chunk AND task boundary."""
     vid = str(tmp_path / "v.mp4")
     scv.synthesize_video(vid, num_frames=64, width=64, height=48, fps=24,
                          keyint=8)
@@ -100,15 +106,12 @@ def test_chunked_state_carry_within_task(tmp_path):
         out = NamedStream(sc, "o")
         jid = sc.run(sc.io.Output(sc.ops.StreamTracker(ignore=frame),
                                   [out]),
-                     PerfParams.manual(8, 32),
+                     PerfParams.manual(
+                         8, 32, stateful_task_affinity=affinity),
                      cache_mode=CacheMode.Overwrite, show_progress=False)
         vals = [struct.unpack("=q", b)[0] for b in out.load()]
         assert vals == list(range(64))
-        # 2 tasks x 4 chunks: chunk 0 of each task recomputes the task
-        # prefix (rows 0..start), later chunks carry.  Without chunk
-        # carry this would be 2*(8+16+24+32)=160 + task prefix; with it:
-        # task0 consumes 32, task1 consumes 64 (prefix 32 + its 32).
-        assert StreamTracker.total_rows[0] == 96, \
+        assert StreamTracker.total_rows[0] == expected_rows, \
             StreamTracker.total_rows[0]
         stats = sc.get_profile(jid).statistics()
         assert stats["_counters"]["stream_chunks"] == 8
