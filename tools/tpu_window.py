@@ -232,6 +232,51 @@ sc.stop()
 """
 
 
+_R5_AB = r"""
+import json, os, shutil, tempfile, time
+import atexit
+root = tempfile.mkdtemp(prefix="scr5ab_")
+atexit.register(lambda: shutil.rmtree(root, ignore_errors=True))
+from scanner_tpu import (CacheMode, Client, NamedStream, NamedVideoStream,
+                         PerfParams)
+import scanner_tpu.kernels
+from scanner_tpu import video as scv
+import jax
+assert jax.devices()[0].platform == "tpu"
+N, W, H = 600, 640, 480
+vid = os.path.join(root, "bench.mp4")
+scv.synthesize_video(vid, num_frames=N, width=W, height=H, fps=30,
+                     keyint=32)
+sc = Client(db_path=os.path.join(root, "db"), num_load_workers=3,
+            num_save_workers=1)
+_, failed = sc.ingest_videos([("bench", vid)])
+assert not failed, failed
+
+def run(name, yuv, stream):
+    os.environ["SCANNER_TPU_YUV_DEVICE"] = "1" if yuv else "0"
+    frames = sc.io.Input([NamedVideoStream(sc, "bench")])
+    ranged = sc.streams.Range(frames, [(0, N)])
+    out = NamedStream(sc, name)
+    t0 = time.time()
+    sc.run(sc.io.Output(sc.ops.Histogram(frame=ranged), [out]),
+           PerfParams.manual(32, 96, stream_work_packets=stream),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    return round(N / (time.time() - t0), 1)
+
+out = {}
+run("warm", True, True)  # compile + page-cache warmup
+# isolate each round-5 lever on hardware: YUV wire (h2d bytes) and
+# work-packet streaming (decode/h2d/compute overlap within tasks)
+out["fps_yuv_stream"] = run("ys", True, True)
+out["fps_rgb_stream"] = run("rs", False, True)
+out["fps_yuv_whole"] = run("yw", True, False)
+out["fps_rgb_whole"] = run("rw", False, False)
+os.environ.pop("SCANNER_TPU_YUV_DEVICE", None)
+print("R5_AB " + json.dumps(out))
+sc.stop()
+"""
+
+
 def tunnel_up() -> bool:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from tpu_capture import tunnel_up as probe  # same probe + env override
@@ -276,6 +321,9 @@ def main() -> int:
     results["pose_trace"] = run_step(
         "pose config stage attribution", code=_TRACE_POSE,
         timeout=900, marker="POSE_TRACE ")
+    results["round5_ab"] = run_step(
+        "YUV-wire x streaming isolation A/B (config 1)", code=_R5_AB,
+        timeout=1200, marker="R5_AB ")
     results["op_bench"] = run_step(
         "per-op device/host A/B (tools/op_bench.py -> OP_BENCH.json)",
         argv=[sys.executable, "tools/op_bench.py"], timeout=1200)
