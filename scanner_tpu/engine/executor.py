@@ -922,13 +922,24 @@ class LocalExecutor:
 
         def from_queue():
             while True:
-                try:
-                    item = w.chunk_q.get(timeout=0.25)
-                except queue.Empty:
-                    if stop is not None and stop.is_set():
-                        raise JobException(
-                            "pipeline stopped during streaming task")
-                    continue
+                t0 = time.time()
+                while True:
+                    try:
+                        item = w.chunk_q.get(timeout=0.25)
+                        break
+                    except queue.Empty:
+                        if stop is not None and stop.is_set():
+                            raise JobException(
+                                "pipeline stopped during streaming task")
+                waited = time.time() - t0
+                if waited > 0.005:
+                    # starvation attribution: time the evaluator spent
+                    # waiting on the loader's chunk production (decode
+                    # slower than compute shows up here, not as inflated
+                    # kernel spans)
+                    self.profiler.add_interval(
+                        "evaluate:chunk_wait", t0, t0 + waited, level=1,
+                        task=w.task_idx, job=w.job.job_idx)
                 if item is _CHUNK_DONE:
                     return
                 if isinstance(item, tuple) and item[0] is _CHUNK_ERR:

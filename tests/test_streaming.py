@@ -173,10 +173,13 @@ def test_streaming_bounds_peak_memory():
     from scanner_tpu.util.jaxenv import cpu_only_env
 
     def rss(stream: bool) -> int:
+        # n_devices=1: the child must NOT inherit the suite's 8-virtual-
+        # device XLA_FLAGS — per-device buffers would dwarf (and equalize)
+        # the decode-path memory this test measures
         r = subprocess.run(
             [sys.executable, "-c", _RSS_CHILD, "1" if stream else "0"],
             capture_output=True, text=True, timeout=420,
-            env=cpu_only_env(), cwd=REPO)
+            env=cpu_only_env(n_devices=1), cwd=REPO)
         assert r.returncode == 0, r.stderr[-2000:]
         for ln in r.stdout.splitlines():
             if ln.startswith("MAXRSS"):
