@@ -41,6 +41,8 @@ ATOL = {
     "histogram_pallas": 0.0,
     "resize_320x240": 1.0,
     "blur": 1.0,
+    # integer fixed-point conversion: bit-exact across backends
+    "yuv420_to_rgb": 0.0,
 }
 # device-only ops validated against a host op with identical semantics
 REF_OP = {"histogram_pallas": "histogram_cmp"}
@@ -105,6 +107,27 @@ def _make_cases(dev):
         from scanner_tpu.kernels.pallas_ops import histogram_frames
         cases.insert(1, ("histogram_pallas",
                          lambda b: histogram_frames(b)))
+
+    # the YUV420-wire on-device conversion (kernels/color.py): input is
+    # flat I420 rows rather than the shared RGB batch — built lazily on
+    # the active device, same bytes both backends (agreement bit-exact)
+    def yuv_case():
+        import jax
+
+        from scanner_tpu.kernels.color import yuv420_to_rgb_device
+        from scanner_tpu.video.lib import yuv420_frame_bytes
+        state = {}
+
+        def fn(_b):
+            if "flat" not in state:
+                r = np.random.RandomState(1)
+                state["flat"] = jax.device_put(r.randint(
+                    0, 256, (BATCH, yuv420_frame_bytes(H, W)), np.uint8))
+            return yuv420_to_rgb_device(state["flat"], H, W)
+
+        return fn
+
+    cases.append(("yuv420_to_rgb", yuv_case()))
     return cases
 
 
