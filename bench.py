@@ -291,11 +291,18 @@ def main():
             print(f"bench: config {d['config']}: {d['fps']} fps "
                   f"({d['frames']} frames, {d['platform']})",
                   file=sys.stderr)
+        # append the live-metrics registry so perf rounds get counters
+        # (recompiles, retries, bytes moved, chunk-wait seconds)
+        # alongside fps — the attribution PERF.md round 3 had to
+        # reconstruct from traces ships with every bench run
+        from scanner_tpu.util.metrics import registry
+        detail.append({"config": "metrics_registry",
+                       "snapshot": registry().snapshot()})
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_DETAIL.json"), "w") as f:
             json.dump(detail, f, indent=1)
 
-        by_cfg = {d["config"]: d["fps"] for d in detail}
+        by_cfg = {d["config"]: d["fps"] for d in detail if "fps" in d}
         if 1 in by_cfg and 3 in by_cfg:
             value = round((by_cfg[1] + by_cfg[3]) / 2.0, 2)
             metric = "histogram+pose_pipeline_throughput"

@@ -7,7 +7,10 @@ storage type/db_path, master/worker network addresses).
 from __future__ import annotations
 
 import os
-import tomllib
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: tomllib is vendored tomli
+    import tomli as tomllib
 from typing import Any, Dict, Optional
 
 from .common import ScannerException
@@ -29,6 +32,10 @@ def default_config() -> Dict[str, Any]:
             "master": "",
             "master_port": 5000,
             "worker_port": 5001,
+            # 0 disables the /metrics|/healthz|/statusz endpoint (the
+            # default); any other value binds it on that port
+            # (docs/observability.md)
+            "metrics_port": 0,
         },
     }
 
@@ -85,6 +92,13 @@ class Config:
         if n.get("master"):
             return f"{n['master']}:{n['master_port']}"
         return None
+
+    @property
+    def metrics_port(self) -> Optional[int]:
+        """Port for the live /metrics endpoint, or None when disabled
+        (the default: telemetry serving is strictly opt-in)."""
+        port = int(self.config["network"].get("metrics_port", 0) or 0)
+        return port or None
 
     @staticmethod
     def write_default(path: str = DEFAULT_PATH) -> str:

@@ -142,6 +142,10 @@ class ClusterConfig:
     log_level: str = "info"
     autoscale: bool = False
     max_workers: Optional[int] = None
+    # 0 = no /metrics|/healthz|/statusz endpoint (the default); non-zero
+    # serves it on that port on master AND workers and exposes the
+    # container port for Prometheus scraping (docs/observability.md)
+    metrics_port: int = 0
 
     def price_per_hour(self) -> float:
         return (self.master_cpus * CPU_PRICE_PER_CORE
@@ -258,7 +262,8 @@ def config_manifest(cfg: ClusterConfig) -> Dict:
                     "db_path": cfg.db_path},
         "network": {"master": f"{cfg.id}-master",
                     "master_port": cfg.master_port,
-                    "worker_port": 5001},
+                    "worker_port": 5001,
+                    "metrics_port": cfg.metrics_port},
     })
     return {
         "apiVersion": "v1", "kind": "ConfigMap",
@@ -267,7 +272,15 @@ def config_manifest(cfg: ClusterConfig) -> Dict:
     }
 
 
+def _metrics_arg(cfg: ClusterConfig) -> str:
+    return f", metrics_port={cfg.metrics_port}" if cfg.metrics_port else ""
+
+
 def master_manifest(cfg: ClusterConfig) -> Dict:
+    ports = [{"containerPort": cfg.master_port}]
+    if cfg.metrics_port:
+        ports.append({"containerPort": cfg.metrics_port,
+                      "name": "metrics"})
     return {
         "apiVersion": "apps/v1", "kind": "Deployment",
         "metadata": {"name": f"{cfg.id}-master"},
@@ -281,11 +294,12 @@ def master_manifest(cfg: ClusterConfig) -> Dict:
                     "command": ["python", "-c",
                                 ("from scanner_tpu.engine.service import "
                                  "start_master; start_master("
-                                 f"'{cfg.db_path}', port={cfg.master_port},"
+                                 f"'{cfg.db_path}', port={cfg.master_port}"
+                                 f"{_metrics_arg(cfg)},"
                                  " block=True)")],
                     "env": [{"name": "SCANNER_TPU_LOG",
                              "value": cfg.log_level}],
-                    "ports": [{"containerPort": cfg.master_port}],
+                    "ports": ports,
                     "resources": {"requests": {"cpu": str(cfg.master_cpus)}},
                 }]},
             },
@@ -299,12 +313,20 @@ def _worker_command(cfg: ClusterConfig, hosts: int,
     slices derive the in-slice rank directly from the pod ordinal (each
     slice is its own StatefulSet) and join pod 0's jax.distributed
     coordinator before serving."""
+    # each pod advertises its stable headless-service DNS name so the
+    # master's GetMetrics aggregation can dial it cross-host (a bare
+    # localhost registration would silently drop every worker from the
+    # cluster metrics view)
+    adv = (f"advertise_host=os.environ['POD_NAME'] + "
+           f"'.{cfg.id}-workers', ")
     if hosts <= 1:
         return ["python", "-c",
-                ("from scanner_tpu.engine.service import start_worker; "
+                ("import os; "
+                 "from scanner_tpu.engine.service import start_worker; "
                  f"start_worker('{cfg.id}-master:{cfg.master_port}', "
                  f"'{cfg.db_path}', "
-                 f"pipeline_instances={cfg.pipeline_instances}, "
+                 f"pipeline_instances={cfg.pipeline_instances}"
+                 f"{_metrics_arg(cfg)}, {adv}"
                  "block=True)")]
     sts = f"{cfg.id}-worker-s{slice_idx}"
     return ["python", "-c", (
@@ -317,7 +339,8 @@ def _worker_command(cfg: ClusterConfig, hosts: int,
         f"num_processes={hosts}, process_id=pid); "
         f"start_worker('{cfg.id}-master:{cfg.master_port}', "
         f"'{cfg.db_path}', "
-        f"pipeline_instances={cfg.pipeline_instances}, "
+        f"pipeline_instances={cfg.pipeline_instances}"
+        f"{_metrics_arg(cfg)}, {adv}"
         "coordinator=coord, block=True)")]
 
 
@@ -350,6 +373,9 @@ def _worker_statefulset(cfg: ClusterConfig, name: str, replicas: int,
                     "containers": [{
                         "name": "worker", "image": cfg.image,
                         "command": command,
+                        **({"ports": [{"containerPort": cfg.metrics_port,
+                                       "name": "metrics"}]}
+                           if cfg.metrics_port else {}),
                         "env": [
                             {"name": "SCANNER_TPU_LOG",
                              "value": cfg.log_level},

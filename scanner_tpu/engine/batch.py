@@ -21,13 +21,34 @@ per-row (batch=1, non-array) consumer asks for it.
 
 from __future__ import annotations
 
+import time
 from typing import Any, List, Optional, Sequence, Union
 
 import numpy as np
 
 from ..common import NullElement
+from ..util import metrics as _mx
 
 Elem = Any
+
+# host<->device traffic over the PCIe/tunnel link — the 92-830 MB/s
+# variance PERF.md round 3 had to reconstruct from traces becomes a
+# live pair of counters (rate = delta bytes / delta seconds).  h2d
+# seconds cover the device_put call (dispatch + synchronous copy part;
+# the async completion rides under later compute by design), d2h
+# seconds are the full blocking fetch.
+_M_H2D_BYTES = _mx.registry().counter(
+    "scanner_tpu_h2d_bytes_total",
+    "Bytes staged host->device via ColumnBatch.to_device.")
+_M_H2D_SECONDS = _mx.registry().counter(
+    "scanner_tpu_h2d_seconds_total",
+    "Seconds spent in host->device staging calls (dispatch side).")
+_M_D2H_BYTES = _mx.registry().counter(
+    "scanner_tpu_d2h_bytes_total",
+    "Bytes fetched device->host via ColumnBatch.to_host.")
+_M_D2H_SECONDS = _mx.registry().counter(
+    "scanner_tpu_d2h_seconds_total",
+    "Seconds spent blocking on device->host fetches.")
 
 
 def _is_jax(x) -> bool:
@@ -152,14 +173,22 @@ class ColumnBatch:
         1.5 B/px over the link, convert on device via converted())."""
         if isinstance(self.data, np.ndarray):
             import jax
-            return ColumnBatch(self.rows, jax.device_put(self.data),
+            t0 = time.time()
+            data = jax.device_put(self.data)
+            _M_H2D_SECONDS.inc(time.time() - t0)
+            _M_H2D_BYTES.inc(self.data.nbytes)
+            return ColumnBatch(self.rows, data,
                                self.nulls, convert=self.convert)
         return self
 
     def to_host(self) -> "ColumnBatch":
         """Materialize device data on host (the single sink-side fetch)."""
         if _is_jax(self.data):
-            return ColumnBatch(self.rows, np.asarray(self.data), self.nulls,
+            t0 = time.time()
+            data = np.asarray(self.data)
+            _M_D2H_SECONDS.inc(time.time() - t0)
+            _M_D2H_BYTES.inc(data.nbytes)
+            return ColumnBatch(self.rows, data, self.nulls,
                                convert=self.convert)
         return self
 

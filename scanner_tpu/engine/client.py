@@ -84,6 +84,7 @@ class Client:
                  decoder_threads: int = 1,
                  config_path: Optional[str] = None,
                  storage_options: Optional[Dict[str, Any]] = None,
+                 metrics_port: Optional[int] = None,
                  **kw):
         if config_path is not None:
             from ..config import Config
@@ -93,6 +94,8 @@ class Client:
             storage_type = storage_type or cfg.storage_type
             if master is None:
                 master = cfg.master_address
+            if metrics_port is None:
+                metrics_port = cfg.metrics_port
         storage_type = storage_type or "posix"
         if db_path is None and storage_type == "posix":
             db_path = os.path.expanduser("~/.scanner_tpu/db")
@@ -112,6 +115,20 @@ class Client:
                     "cluster mode requires scanner_tpu.engine.service") \
                     from e
             self._cluster = ClusterClient(master, db=self._db, **kw)
+
+        # live telemetry endpoint — strictly opt-in (Client(metrics_port=)
+        # or the [network] metrics_port config knob); port 0 binds an
+        # ephemeral port, see self._metrics_server.port
+        self._metrics_server = None
+        if metrics_port is not None:
+            from ..util.metrics import MetricsServer
+            self._metrics_server = MetricsServer(
+                port=metrics_port,
+                statusz=lambda: {"role": "client",
+                                 "master": self._master_address,
+                                 "db": getattr(self._db.backend, "root",
+                                               None)},
+                healthz=lambda: {"role": "client"})
 
         self.ops = O.OpGenerator()
         self.streams = StreamsGenerator()
@@ -135,6 +152,23 @@ class Client:
     def stop(self) -> None:
         if self._cluster is not None:
             self._cluster.close()
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
+
+    # -- live telemetry -----------------------------------------------------
+
+    def metrics(self) -> Dict[str, Any]:
+        """Live metrics snapshot.  Cluster mode: the master's aggregated
+        cluster-wide view (master + every live worker, each sample
+        node-labeled).  Local mode: this process's registry under
+        node="client".  Render with
+        scanner_tpu.util.metrics.render_prometheus, or read values
+        directly (see docs/observability.md for the series catalog)."""
+        if self._cluster is not None:
+            return self._cluster.metrics()
+        from ..util.metrics import merge_snapshots, registry
+        return merge_snapshots({"client": registry().snapshot()})
 
     # -- data management ----------------------------------------------------
 

@@ -15,6 +15,7 @@ host->device transfer per column)."""
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -23,8 +24,24 @@ from ..common import (DeviceType, GraphException, JobException, NullElement,
                       ScannerException, SliceList)
 from ..graph import analysis as A
 from ..graph import ops as O
+from ..util import metrics as _mx
 from ..util.profiler import Profiler
 from .batch import ColumnBatch, concat_batches, is_array_data
+
+# per-op live throughput: fps = delta rows / delta seconds per op label
+_M_OP_ROWS = _mx.registry().counter(
+    "scanner_tpu_op_rows_total",
+    "Rows evaluated per op (kernel calls, warmup rows included).",
+    labels=["op"])
+_M_OP_SECONDS = _mx.registry().counter(
+    "scanner_tpu_op_seconds_total",
+    "Wall seconds spent inside each op's kernel calls.",
+    labels=["op"])
+_M_OP_RECOMPILES = _mx.registry().counter(
+    "scanner_tpu_op_recompiles_total",
+    "New input-shape signatures seen per op — each one forces an XLA "
+    "recompile of a jitted kernel; a climbing count means shape churn.",
+    labels=["op"])
 
 Elem = Any  # np.ndarray | bytes | arbitrary python object | NullElement
 ColKey = Tuple[int, str]  # (node id, column name)
@@ -71,6 +88,8 @@ class KernelInstance:
         self._cur_stream: Tuple[int, int] = (-1, -1)  # (job, slice group)
         self._last_row: Optional[int] = None
         self._did_setup = False
+        # input-shape signatures already executed (XLA recompile proxy)
+        self._shape_sigs: set = set()
 
     def setup(self, fetch: bool = True) -> None:
         if not self._did_setup:
@@ -412,6 +431,7 @@ class TaskEvaluator:
                         args.append([b.data[int(j)] for j in p[:, 0]])
             return args
 
+        t0 = time.time()
         try:
             with self.profiler.span("evaluate:" + n.name,
                                     rows=len(compute)):
@@ -431,6 +451,14 @@ class TaskEvaluator:
                             continue
                         if batched_call:
                             args = call_args_for(live)
+                            # a never-seen arg-shape signature means XLA
+                            # compiles a fresh executable for a jitted
+                            # kernel — surface it live
+                            sig = tuple(tuple(a.shape) if is_array_data(a)
+                                        else len(a) for a in args)
+                            if sig not in ki._shape_sigs:
+                                ki._shape_sigs.add(sig)
+                                _M_OP_RECOMPILES.labels(op=n.name).inc()
                             res = ki.kernel.execute(*args)
                             emit_result(compute[live], res)
                         else:
@@ -456,6 +484,8 @@ class TaskEvaluator:
                 finally:
                     ki._last_row = None
             raise
+        _M_OP_ROWS.labels(op=n.name).inc(len(compute))
+        _M_OP_SECONDS.labels(op=n.name).inc(time.time() - t0)
 
         # assemble output columns in row order; null-propagated rows (rare)
         # interleave with kernel results, so columns containing them fall

@@ -34,10 +34,42 @@ from ..storage import Database
 from ..storage import items as IT
 from ..storage import metadata as md
 from ..storage.streams import NamedVideoStream, StoredStream
+from ..util import metrics as _mx
 from ..util.log import get_logger
 from ..util.profiler import Profiler
 from .batch import ColumnBatch, concat_batches
 from .evaluate import TaskEvaluator
+
+# live pipeline telemetry (docs/observability.md).  Queue depths answer
+# the round-3 attribution question ("which stage starves?") in real
+# time: a full evaluate queue + idle save queue = compute-bound, etc.
+_M_QDEPTH = _mx.registry().gauge(
+    "scanner_tpu_stage_queue_depth",
+    "Tasks currently queued ahead of a pipeline stage (live; sampled "
+    "at scrape time from the bounded inter-stage queues).",
+    labels=["stage"])
+_M_STAGE_SECONDS = _mx.registry().counter(
+    "scanner_tpu_stage_seconds_total",
+    "Wall seconds spent in each pipeline stage across all stage threads.",
+    labels=["stage"])
+_M_STAGE_TASKS = _mx.registry().counter(
+    "scanner_tpu_stage_tasks_total",
+    "Tasks completed per pipeline stage.",
+    labels=["stage"])
+_M_CHUNK_WAIT = _mx.registry().counter(
+    "scanner_tpu_chunk_wait_seconds_total",
+    "Evaluator seconds spent waiting on loader chunk production "
+    "(work-packet streaming starvation; mirrors evaluate:chunk_wait "
+    "trace intervals).")
+_M_DECODED = _mx.registry().counter(
+    "scanner_tpu_decoded_frames_total",
+    "Video frames decoded and delivered to the pipeline, per loader "
+    "thread.",
+    labels=["loader"])
+_M_DECODE_SECONDS = _mx.registry().counter(
+    "scanner_tpu_decode_seconds_total",
+    "Seconds spent decoding video frames, per loader thread.",
+    labels=["loader"])
 
 _SENTINEL = object()
 _CHUNK_DONE = object()   # streaming producer: all chunks delivered
@@ -513,6 +545,12 @@ class LocalExecutor:
         qsize = queue_size or 4
         eval_q: "queue.Queue" = queue.Queue(maxsize=qsize)
         save_q: "queue.Queue" = queue.Queue(maxsize=qsize)
+        # live depth gauges sample the queues at scrape time; the last
+        # pipeline to start owns the gauge (concurrent pipelines in one
+        # process share the process registry)
+        depth_fns = {"evaluate": eval_q.qsize, "save": save_q.qsize}
+        for stage, fn in depth_fns.items():
+            _M_QDEPTH.labels(stage=stage).set_function(fn)
         errors: List[BaseException] = []
         err_lock = threading.Lock()
         stop = threading.Event()
@@ -602,6 +640,7 @@ class LocalExecutor:
                             if w.chunk_abort is not None:
                                 w.chunk_abort.set()  # unblock the loader
                             continue  # revoked attempt: drop silently
+                        t0 = time.time()
                         with self.profiler.span("evaluate", level=0,
                                                 task=w.task_idx,
                                                 job=w.job.job_idx):
@@ -611,6 +650,9 @@ class LocalExecutor:
                             else:
                                 w.results = self._evaluate_with_fallback(
                                     info, te, w, fb_tls)
+                        _M_STAGE_SECONDS.labels(stage="evaluate").inc(
+                            time.time() - t0)
+                        _M_STAGE_TASKS.labels(stage="evaluate").inc()
                         w.elements = None
                     except Exception as e:  # noqa: BLE001
                         task_failed(w, e)
@@ -645,9 +687,13 @@ class LocalExecutor:
                             break
                         continue
                     try:
+                        t0 = time.time()
                         with self.profiler.span("save", level=0, task=w.task_idx,
                                                 job=w.job.job_idx):
                             self._save_task(info, w)
+                        _M_STAGE_SECONDS.labels(stage="save").inc(
+                            time.time() - t0)
+                        _M_STAGE_TASKS.labels(stage="save").inc()
                         if on_done is not None:
                             on_done(w)
                     except Exception as e:  # noqa: BLE001
@@ -681,16 +727,25 @@ class LocalExecutor:
                  for i in range(n_evals)]
         savers = [threading.Thread(target=saver, name=f"save-{i}")
                   for i in range(self.num_save_workers)]
-        for t in loaders + evals + savers:
-            t.start()
-        for t in loaders:
-            t.join()
-        loaders_done.set()
-        for t in evals:
-            t.join()
-        evals_done.set()
-        for t in savers:
-            t.join()
+        try:
+            for t in loaders + evals + savers:
+                t.start()
+            for t in loaders:
+                t.join()
+            loaders_done.set()
+            for t in evals:
+                t.join()
+            evals_done.set()
+            for t in savers:
+                t.join()
+        finally:
+            # detach the depth gauges from this run's (now dead) queues —
+            # but only if this run still owns them: a concurrent pipeline
+            # that re-bound the gauge keeps its live sampler
+            for stage, fn in depth_fns.items():
+                g = _M_QDEPTH.labels(stage=stage)
+                if g.clear_function(fn):
+                    g.set(0)
         if show_progress:
             print()
         if errors:
@@ -728,6 +783,7 @@ class LocalExecutor:
                     self.load_task(info, w, tls)
                     if on_start is not None and on_start(w) is False:
                         continue  # revoked attempt
+                    t0 = time.time()
                     with self.profiler.span("evaluate", level=0,
                                             task=w.task_idx,
                                             job=w.job.job_idx):
@@ -743,6 +799,9 @@ class LocalExecutor:
                         else:
                             w.results = self._evaluate_with_fallback(
                                 info, te, w, fb_tls)
+                    _M_STAGE_SECONDS.labels(stage="evaluate").inc(
+                        time.time() - t0)
+                    _M_STAGE_TASKS.labels(stage="evaluate").inc()
                     w.elements = None
                 except Exception as e:  # noqa: BLE001
                     if on_task_error is not None and on_task_error(w, e):
@@ -751,10 +810,14 @@ class LocalExecutor:
                 if on_eval_done is not None:
                     on_eval_done(w)
                 try:
+                    t0 = time.time()
                     with self.profiler.span("save", level=0,
                                             task=w.task_idx,
                                             job=w.job.job_idx):
                         self._save_task(info, w)
+                    _M_STAGE_SECONDS.labels(stage="save").inc(
+                        time.time() - t0)
+                    _M_STAGE_TASKS.labels(stage="save").inc()
                     if on_done is not None:
                         on_done(w)
                 except Exception as e:  # noqa: BLE001
@@ -838,11 +901,18 @@ class LocalExecutor:
         def batch_for(self, rows: Sequence[int]) -> ColumnBatch:
             rows_arr = np.asarray(rows, np.int64)
             need = set(rows_arr.tolist()) - self._buf.keys()
+            t0 = time.time()
+            decoded = 0
             while need:
                 rr, fr = next(self._gen)  # StopIteration = decode bug
                 for r, f in zip(rr.tolist(), fr):
                     self._buf[r] = f
+                decoded += len(fr)
                 need -= set(rr.tolist())
+            if decoded:
+                lbl = threading.current_thread().name
+                _M_DECODED.labels(loader=lbl).inc(decoded)
+                _M_DECODE_SECONDS.labels(loader=lbl).inc(time.time() - t0)
             data = np.stack([self._buf[int(r)] for r in rows_arr]) \
                 if len(rows_arr) else np.zeros((0,), np.uint8)
             keep_from = self._keep_from[self._chunk_i]
@@ -865,6 +935,7 @@ class LocalExecutor:
                                              w.chunk_plans, fmt)
         for plan in w.chunk_plans:
             elements: Dict[int, ColumnBatch] = {}
+            t0 = time.time()
             with self.profiler.span("load", level=0, task=w.task_idx,
                                     job=w.job.job_idx,
                                     chunk=plan.output_range[0]):
@@ -875,6 +946,7 @@ class LocalExecutor:
                         elements[nid] = self._load_plain_source(
                             w, nid, [int(r) for r in rows])
                 self._prestage_device_columns(info, w, elements=elements)
+            _M_STAGE_SECONDS.labels(stage="load").inc(time.time() - t0)
             yield plan, elements
 
     def _chunk_put(self, w: TaskItem, item, stop) -> bool:
@@ -932,6 +1004,7 @@ class LocalExecutor:
                             raise JobException(
                                 "pipeline stopped during streaming task")
                 waited = time.time() - t0
+                _M_CHUNK_WAIT.inc(waited)
                 if waited > 0.005:
                     # starvation attribution: time the evaluator spent
                     # waiting on the loader's chunk production (decode
@@ -994,6 +1067,15 @@ class LocalExecutor:
         """The load stage: derive the task's row plan and read/decode its
         source elements (shared by the local pipeline and cluster
         workers)."""
+        t0 = time.time()
+        # success-only, like the evaluate/save stage counters: a failing
+        # load must not read as the load stage racing ahead
+        out = self._load_task(info, w, tls)
+        _M_STAGE_SECONDS.labels(stage="load").inc(time.time() - t0)
+        _M_STAGE_TASKS.labels(stage="load").inc()
+        return out
+
+    def _load_task(self, info: A.GraphInfo, w: TaskItem, tls) -> TaskItem:
         with self.profiler.span("load", level=0, task=w.task_idx,
                                 job=w.job.job_idx):
             chain = self._chains.get(w.job.job_idx)
@@ -1161,7 +1243,12 @@ class LocalExecutor:
                     start, _ = desc.item_bounds(it)
                     auto = self._automata(tls, w.job, node_id, si, it,
                                           output_format=fmt)
+                    t0 = time.time()
                     frames = auto.get_frames(local)
+                    lbl = threading.current_thread().name
+                    _M_DECODED.labels(loader=lbl).inc(len(local))
+                    _M_DECODE_SECONDS.labels(loader=lbl).inc(
+                        time.time() - t0)
                     # convert mark carries THIS item's geometry (items of
                     # one table may differ); mixed-geometry concat falls
                     # back to host conversion in concat_batches

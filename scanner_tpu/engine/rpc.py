@@ -19,12 +19,21 @@ import grpc
 
 from ..common import ScannerException
 from ..storage.metadata import pack, unpack
+from ..util import metrics as _mx
 from ..util.retry import call_with_backoff
 
 GRPC_OPTIONS = [
     ("grpc.max_send_message_length", 1 << 30),
     ("grpc.max_receive_message_length", 1 << 30),
 ]
+
+# server-side handler latency (includes msgpack (de)serialization, not
+# network time) — the live flavor of the profiler's RPC spans
+_M_RPC_LATENCY = _mx.registry().histogram(
+    "scanner_tpu_rpc_latency_seconds",
+    "Server-side RPC handler latency by method (deserialize + handler + "
+    "serialize).",
+    labels=["method"])
 
 
 class RpcError(ScannerException):
@@ -45,13 +54,19 @@ class _GenericService(grpc.GenericRpcHandler):
         if method is None:
             return None
 
+        short_name = name[len(self._prefix):]
+
         def unary(request: bytes, context) -> bytes:
+            t0 = time.time()
             try:
                 return pack(method(unpack(request)))
             except Exception as e:  # noqa: BLE001
                 context.set_code(grpc.StatusCode.INTERNAL)
                 context.set_details(f"{type(e).__name__}: {e}")
                 return b""
+            finally:
+                _M_RPC_LATENCY.labels(method=short_name).observe(
+                    time.time() - t0)
 
         return grpc.unary_unary_rpc_method_handler(unary)
 
@@ -117,7 +132,10 @@ class RpcClient:
                 lambda: fn(req, timeout=timeout or self._timeout),
                 is_transient=self._transient,
                 retries=self._retries if retries is None else retries,
-                base=self._backoff_base, cap=self._backoff_cap)
+                base=self._backoff_base, cap=self._backoff_cap,
+                # UNAVAILABLE retries become visible per method:
+                # scanner_tpu_retry_attempts_total{site="rpc:NextWork"}
+                label=f"rpc:{method}")
         except grpc.RpcError as e:
             raise RpcError(
                 f"{self._service}.{method} @ {self.address}: "

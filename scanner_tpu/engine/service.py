@@ -31,7 +31,9 @@ import cloudpickle
 from ..common import CacheMode, JobException, PerfParams, ScannerException
 from ..storage import Database, make_storage
 from ..storage import metadata as md
+from ..util import metrics as _mx
 from ..util.log import get_logger
+from ..util.metrics import MetricsServer, merge_snapshots
 from ..util.profiler import Profiler
 from . import rpc
 from .evaluate import TaskEvaluator
@@ -45,6 +47,38 @@ WORKER_SERVICE = "scanner.Worker"
 
 _mlog = get_logger("master")
 _wlog = get_logger("worker")
+
+# control-plane telemetry (docs/observability.md).  The point-in-time
+# gauges are refreshed by the master's 0.5s scan loop; the counters are
+# bumped inline by the RPC handlers.
+_M_WORKERS = _mx.registry().gauge(
+    "scanner_tpu_master_workers_active",
+    "Workers currently registered and heartbeating.")
+_M_HB_AGE = _mx.registry().gauge(
+    "scanner_tpu_worker_heartbeat_age_seconds",
+    "Seconds since each worker's last heartbeat (master view).",
+    labels=["worker"])
+_M_TASKS_QUEUED = _mx.registry().gauge(
+    "scanner_tpu_master_tasks_queued",
+    "Tasks of the active bulk job waiting in the master queue.")
+_M_TASKS_OUTSTANDING = _mx.registry().gauge(
+    "scanner_tpu_master_tasks_outstanding",
+    "Tasks currently assigned to workers (active bulk job).")
+_M_TASKS_DONE = _mx.registry().counter(
+    "scanner_tpu_master_tasks_completed_total",
+    "Tasks completed across all bulk jobs this master served.")
+_M_TASK_RETRIES = _mx.registry().counter(
+    "scanner_tpu_task_retries_total",
+    "Tasks re-queued after a failure or a started-task timeout.")
+_M_REVOCATIONS = _mx.registry().counter(
+    "scanner_tpu_task_revocations_total",
+    "Task attempts revoked (timeout or stale-worker requeue).")
+_M_STRIKES = _mx.registry().counter(
+    "scanner_tpu_blacklist_strikes_total",
+    "Task failures counted toward a job's blacklist threshold.")
+_M_JOBS_BLACKLISTED = _mx.registry().counter(
+    "scanner_tpu_jobs_blacklisted_total",
+    "Jobs removed from their bulk after repeated task failures.")
 
 
 # ---------------------------------------------------------------------------
@@ -109,6 +143,10 @@ class _BulkJob:
     # `outstanding` so the NextWork window check is O(1))
     held: Dict[int, int] = field(default_factory=dict)
     done: Set[Tuple[int, int]] = field(default_factory=set)
+    # per-job done-task counts, maintained where done.add happens: the
+    # 4 Hz GetJobStatus poll must stay O(jobs) under the control-plane
+    # lock, not O(total_tasks)
+    job_done: Dict[int, int] = field(default_factory=dict)
     failures: Dict[Tuple[int, int], int] = field(default_factory=dict)
     blacklisted_jobs: Set[int] = field(default_factory=set)
     total_tasks: int = 0
@@ -128,6 +166,43 @@ class _BulkJob:
     finished: bool = False
     error: str = ""
     profiles: List[dict] = field(default_factory=list)
+    # live-status bookkeeping: output rows per task (from the admission
+    # job geometry) and cumulative rows through each pipeline stage
+    # transition the master observes (NextWork->StartedWork = loaded,
+    # EvalDone = evaluated, FinishedWork = saved).  GetJobStatus and
+    # /statusz derive per-stage fps and the ETA from these — one source
+    # of truth for the client progress bar and the endpoint.
+    admitted_at: float = field(default_factory=time.time)
+    task_rows: Dict[Tuple[int, int], int] = field(default_factory=dict)
+    stage_rows: Dict[str, int] = field(
+        default_factory=lambda: {"load": 0, "evaluate": 0, "save": 0})
+    # tasks already counted per stage: a retried attempt's second
+    # StartedWork/EvalDone must not double-count its rows, or the
+    # load/evaluate fps would read (retries+1)x the save fps on a flaky
+    # cluster ('save' dedupes via `done`)
+    stage_seen: Dict[str, Set[Tuple[int, int]]] = field(
+        default_factory=lambda: {"load": set(), "evaluate": set()})
+
+    # wall-clock end of the bulk; 0 while running.  Status fps/elapsed
+    # freeze here so querying a historical bulk an hour later does not
+    # decay its throughput toward zero.
+    finished_at: float = 0.0
+    # active-done count when this _BulkJob object started serving (0 at
+    # admission; the restored done-count after a master restart).  The
+    # ETA divides post-start progress by post-start elapsed — dividing
+    # checkpoint-restored completions by seconds-since-recovery would
+    # report a completion rate off by orders of magnitude.
+    done_at_start: int = 0
+
+    def count_stage(self, stage: str, key: Tuple[int, int]) -> None:
+        if key not in self.stage_seen[stage]:
+            self.stage_seen[stage].add(key)
+            self.stage_rows[stage] += self.task_rows.get(key, 0)
+
+    def mark_finished(self) -> None:
+        self.finished = True
+        if not self.finished_at:
+            self.finished_at = time.time()
 
     def q_push(self, key: Tuple[int, int], front: bool = False) -> None:
         j, t = key
@@ -163,7 +238,9 @@ class Master:
     def __init__(self, db_path: str, port: int = 0,
                  no_workers_timeout: float = 30.0,
                  enable_watchdog: bool = False,
-                 storage_type: str = "posix"):
+                 storage_type: str = "posix",
+                 metrics_port: Optional[int] = None,
+                 metrics_host: str = "0.0.0.0"):
         self.db = Database(make_storage(storage_type, db_path=db_path))
         self.no_workers_timeout = no_workers_timeout
         self.enable_watchdog = enable_watchdog
@@ -195,6 +272,7 @@ class Master:
             "FinishedWork": self._rpc_finished_work,
             "FailedWork": self._rpc_failed_work,
             "GetJobStatus": self._rpc_job_status,
+            "GetMetrics": self._rpc_get_metrics,
             "PokeWatchdog": self._rpc_poke,
             "PostProfile": self._rpc_post_profile,
             "GetProfiles": self._rpc_get_profiles,
@@ -202,6 +280,14 @@ class Master:
         }, port=port)
         self.port = self._server.port
         self._server.start()
+        # /metrics + /healthz + /statusz — strictly opt-in: no listener
+        # exists unless metrics_port is given (0 = ephemeral port, see
+        # .metrics_server.port)
+        self.metrics_server: Optional[MetricsServer] = None
+        if metrics_port is not None:
+            self.metrics_server = MetricsServer(
+                port=metrics_port, statusz=self._statusz,
+                healthz=lambda: {"role": "master"}, host=metrics_host)
         self._scan_thread = threading.Thread(
             target=self._scan_loop, name="master-scan", daemon=True)
         self._scan_thread.start()
@@ -271,6 +357,8 @@ class Master:
                         continue
                     tasks = {(job.job_idx, t) for t in range(len(job.tasks))}
                     bulk.job_tasks[job.job_idx] = tasks
+                    for t, (s, e) in enumerate(job.tasks):
+                        bulk.task_rows[(job.job_idx, t)] = e - s
                     bulk.job_sink_names[job.job_idx] = [
                         d.name for d, _c, _k, _e in job.sink_tables.values()]
                     bulk.job_custom_sinks[job.job_idx] = \
@@ -283,7 +371,7 @@ class Master:
                 self._bulk = bulk
                 self._no_worker_since = time.time()
                 if bulk.total_tasks == 0:
-                    bulk.finished = True
+                    bulk.mark_finished()
                 self._history[bulk.bulk_id] = bulk
                 _mlog.info(
                     "bulk %d admitted: %d jobs, %d tasks",
@@ -401,6 +489,7 @@ class Master:
                     and cur[2] == req.get("attempt"):
                 bulk.outstanding[key] = (cur[0], time.time(), cur[2], True,
                                          cur[4])
+                bulk.count_stage("load", key)
                 return {"ok": True}
         return {"ok": False, "revoked": True}
 
@@ -421,6 +510,7 @@ class Master:
                 bulk.outstanding[key] = (cur[0], cur[1], cur[2], cur[3],
                                          True)
                 self._dec_held(bulk, cur[0])
+                bulk.count_stage("evaluate", key)
                 return {"ok": True}
         return {"ok": False, "revoked": True}
 
@@ -444,6 +534,9 @@ class Master:
             if key in bulk.done or key[0] in bulk.blacklisted_jobs:
                 return {"ok": True}
             bulk.done.add(key)
+            bulk.job_done[key[0]] = bulk.job_done.get(key[0], 0) + 1
+            bulk.stage_rows["save"] += bulk.task_rows.get(key, 0)
+            _M_TASKS_DONE.inc()
             _mlog.debug("task (%d,%d) finished by worker %d "
                         "(%d/%d done)", key[0], key[1],
                         req.get("worker_id", -1), len(bulk.done),
@@ -483,6 +576,7 @@ class Master:
                 return {"ok": True}
             n = bulk.failures.get(key, 0) + 1
             bulk.failures[key] = n
+            _M_STRIKES.inc()
             _mlog.warning("task (%d,%d) failed on worker %d "
                           "(failure %d/%d): %s", key[0], key[1],
                           req.get("worker_id", -1), n, MAX_TASK_FAILURES,
@@ -495,6 +589,7 @@ class Master:
                 blacklisted_now = True
             else:
                 bulk.q_push(key, front=True)
+                _M_TASK_RETRIES.inc()
             self._maybe_finish_bulk(bulk)
             finished_now = bulk.finished
         if blacklisted_now and not finished_now:
@@ -504,21 +599,101 @@ class Master:
             self._clear_bulk_checkpoint(bulk.bulk_id)
         return {"ok": True}
 
+    def _job_status_locked(self, bulk: _BulkJob) -> dict:
+        """One source of truth for job progress: the GetJobStatus reply,
+        the client progress bar, and /statusz all read this.  Caller
+        holds self._lock."""
+        # freeze the clock at bulk completion: a historical bulk queried
+        # later must report its real throughput, not a decayed one
+        end = bulk.finished_at or time.time()
+        elapsed = max(end - bulk.admitted_at, 1e-6)
+        # fps per stage from the master-observed transitions; after a
+        # master restart these count post-recovery progress only, so the
+        # ETA reflects the live completion rate
+        stage_fps = {s: round(r / elapsed, 2)
+                     for s, r in bulk.stage_rows.items()}
+        active_total = bulk.total_tasks - bulk.blacklisted_task_total
+        active_done = len(bulk.done) - bulk.done_in_blacklisted
+        eta = None
+        done_since_start = active_done - bulk.done_at_start
+        if not bulk.finished and done_since_start > 0:
+            rate = done_since_start / elapsed
+            eta = round((active_total - active_done) / rate, 1)
+        per_job = {}
+        for j, tasks in bulk.job_tasks.items():
+            per_job[j] = {"tasks_done": bulk.job_done.get(j, 0),
+                          "tasks_total": len(tasks),
+                          "blacklisted": j in bulk.blacklisted_jobs}
+        return {
+            "finished": bulk.finished,
+            "tasks_done": len(bulk.done),
+            "total_tasks": bulk.total_tasks,
+            "stage_fps": stage_fps,
+            "eta_seconds": eta,
+            "elapsed_seconds": round(elapsed, 1),
+            "per_job": per_job,
+            "failed_jobs": sorted(bulk.blacklisted_jobs),
+            "error": bulk.error,
+            "num_workers": sum(1 for w in self._workers.values()
+                               if w.active),
+        }
+
     def _rpc_job_status(self, req: dict) -> dict:
         with self._lock:
             bulk = self._history.get(req["bulk_id"]) \
                 if req.get("bulk_id") is not None else self._bulk
             if bulk is None:
                 return {"error": "no such bulk job"}
-            return {
-                "finished": bulk.finished,
-                "tasks_done": len(bulk.done),
-                "total_tasks": bulk.total_tasks,
-                "failed_jobs": sorted(bulk.blacklisted_jobs),
-                "error": bulk.error,
-                "num_workers": sum(1 for w in self._workers.values()
-                                   if w.active),
-            }
+            return self._job_status_locked(bulk)
+
+    def _statusz(self) -> dict:
+        """JSON body of /statusz: live job progress + worker liveness."""
+        now = time.time()
+        with self._lock:
+            workers = [{"worker_id": w.worker_id, "address": w.address,
+                        "active": w.active,
+                        "heartbeat_age_seconds": round(now - w.last_seen,
+                                                       3)}
+                       for w in self._workers.values()]
+            bulk = self._bulk
+            status = self._job_status_locked(bulk) \
+                if bulk is not None else None
+            bulk_id = bulk.bulk_id if bulk is not None else None
+        return {"role": "master", "workers": workers,
+                "bulk_id": bulk_id, "bulk": status}
+
+    def _rpc_get_metrics(self, req: dict) -> dict:
+        """Cluster-wide metrics: this process's snapshot plus every live
+        worker's, merged under per-node labels.  The one place the
+        master dials workers (at the address each worker advertised at
+        registration) — a diagnostic pull outside the job data/control
+        plane (which stays strictly worker-pull-based).  Dials run
+        concurrently with a short deadline so one wedged worker cannot
+        pin an RPC-server thread for the whole scrape, and an
+        unreachable worker just drops out of the merged view."""
+        from concurrent import futures as _fut
+
+        with self._lock:
+            targets = [(w.worker_id, w.address)
+                       for w in self._workers.values()
+                       if w.active and w.address]
+        by_node: Dict[str, dict] = {"master": _mx.registry().snapshot()}
+
+        def pull(wid: int, addr: str):
+            c = rpc.RpcClient(addr, WORKER_SERVICE, timeout=2.0)
+            try:
+                return wid, c.try_call("GetMetrics", retries=0)
+            finally:
+                c.close()
+
+        if targets:
+            with _fut.ThreadPoolExecutor(
+                    max_workers=min(16, len(targets))) as pool:
+                for wid, reply in pool.map(lambda t: pull(*t), targets):
+                    if reply and "snapshot" in reply:
+                        by_node[f"worker{wid}"] = reply["snapshot"]
+        return {"snapshot": merge_snapshots(by_node),
+                "nodes": sorted(by_node)}
 
     def _rpc_poke(self, req: dict) -> dict:
         self._last_poke = time.time()
@@ -651,6 +826,8 @@ class Master:
         for j, n in state["job_ntasks"].items():
             job = jobs[j]
             bulk.job_tasks[j] = {(j, t) for t in range(n)}
+            for t, (s, e) in enumerate(job.tasks[:n]):
+                bulk.task_rows[(j, t)] = e - s
             bulk.job_sink_names[j] = [
                 d.name for d, _c, _k, _e in job.sink_tables.values()]
             bulk.job_custom_sinks[j] = list(job.custom_sinks.values())
@@ -685,6 +862,10 @@ class Master:
                             "admission state")
             bulk.done = set()
             bulk.failures = {}
+        # ETA baseline: rate counts only post-recovery completions
+        bulk.done_at_start = len(bulk.done) - bulk.done_in_blacklisted
+        for j, _t in bulk.done:
+            bulk.job_done[j] = bulk.job_done.get(j, 0) + 1
         for j, ts in sorted(bulk.job_tasks.items()):
             if j in bulk.blacklisted_jobs:
                 continue
@@ -736,6 +917,7 @@ class Master:
             # finish counters would let the bulk "finish" early
             return
         _mlog.error("job %d blacklisted after repeated failures: %s", j, err)
+        _M_JOBS_BLACKLISTED.inc()
         bulk.blacklisted_jobs.add(j)
         bulk.blacklisted_task_total += len(bulk.job_tasks.get(j, ()))
         bulk.done_in_blacklisted += sum(
@@ -764,7 +946,7 @@ class Master:
         active_total = bulk.total_tasks - bulk.blacklisted_task_total
         active_done = len(bulk.done) - bulk.done_in_blacklisted
         if active_done >= active_total and not bulk.outstanding:
-            bulk.finished = True
+            bulk.mark_finished()
             _mlog.info("bulk %d finished: %d/%d tasks done",
                        bulk.bulk_id, len(bulk.done), bulk.total_tasks)
             self.db.write_megafile()
@@ -777,6 +959,26 @@ class Master:
             now = time.time()
             finished_bulk_id = None
             with self._lock:
+                # refresh the point-in-time gauges (0.5s resolution is
+                # plenty for a human-watched dashboard)
+                _M_WORKERS.set(sum(1 for w in self._workers.values()
+                                   if w.active))
+                for w in self._workers.values():
+                    if w.active:
+                        _M_HB_AGE.labels(worker=str(w.worker_id)).set(
+                            now - w.last_seen)
+                    else:
+                        # drop the child: worker ids are never reused,
+                        # so keeping one -1 series per dead id would
+                        # grow every scrape of a week-old master
+                        _M_HB_AGE.remove_labels(worker=str(w.worker_id))
+                cur = self._bulk
+                if cur is not None and not cur.finished:
+                    _M_TASKS_QUEUED.set(cur.q_count())
+                    _M_TASKS_OUTSTANDING.set(len(cur.outstanding))
+                else:
+                    _M_TASKS_QUEUED.set(0)
+                    _M_TASKS_OUTSTANDING.set(0)
                 # stale workers -> deactivate + requeue their tasks
                 for w in self._workers.values():
                     if w.active and now - w.last_seen > WORKER_STALE_AFTER:
@@ -794,6 +996,7 @@ class Master:
                                 list(bulk.outstanding.items()):
                             if now - t0 > bulk.task_timeout:
                                 self._unassign(bulk, key)
+                                _M_REVOCATIONS.inc()
                                 _mlog.warning(
                                     "task (%d,%d) timed out on worker %d "
                                     "after %.1fs (started=%s): revoking",
@@ -805,11 +1008,13 @@ class Master:
                                     continue
                                 n = bulk.failures.get(key, 0) + 1
                                 bulk.failures[key] = n
+                                _M_STRIKES.inc()
                                 if n >= MAX_TASK_FAILURES:
                                     self._blacklist_job(
                                         bulk, key[0], "task timeout")
                                 else:
                                     bulk.q_push(key, front=True)
+                                    _M_TASK_RETRIES.inc()
                         self._maybe_finish_bulk(bulk)
                     # no workers at all
                     if not any(w.active for w in self._workers.values()):
@@ -818,7 +1023,7 @@ class Master:
                             bulk.error = (
                                 f"no workers available after "
                                 f"{self.no_workers_timeout}s")
-                            bulk.finished = True
+                            bulk.mark_finished()
                     else:
                         self._no_worker_since = now
                 if bulk is not None and bulk.finished:
@@ -839,15 +1044,20 @@ class Master:
             if owner == wid:
                 self._unassign(bulk, key)
                 bulk.q_push(key, front=True)
+                _M_REVOCATIONS.inc()
+                _M_TASK_RETRIES.inc()
 
     def wait_for_shutdown(self) -> None:
         while not self._shutdown.is_set():
             time.sleep(0.2)
-        self._server.stop()
+        self.stop()
 
     def stop(self) -> None:
         self._shutdown.set()
         self._server.stop()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
 
 
 # ---------------------------------------------------------------------------
@@ -866,7 +1076,10 @@ class Worker:
                  num_load_workers: int = 2, num_save_workers: int = 2,
                  pipeline_instances: int = 1,
                  decoder_threads: int = 1,
-                 coordinator=None):
+                 coordinator=None,
+                 metrics_port: Optional[int] = None,
+                 metrics_host: str = "0.0.0.0",
+                 advertise_host: Optional[str] = None):
         if coordinator is not None:
             # join the multi-process JAX runtime BEFORE any backend touch:
             # meshes built by kernels then span all participating hosts
@@ -880,18 +1093,32 @@ class Worker:
         self._shutdown = threading.Event()
         self._server = rpc.RpcServer(WORKER_SERVICE, {
             "Ping": lambda req: {"ok": True},
+            # serves the master's cluster-wide metrics aggregation
+            "GetMetrics": lambda req: {
+                "snapshot": _mx.registry().snapshot()},
             "Shutdown": self._rpc_shutdown,
         }, port=port)
         self.port = self._server.port
         self._server.start()
+        self.metrics_server: Optional[MetricsServer] = None
+        if metrics_port is not None:
+            self.metrics_server = MetricsServer(
+                port=metrics_port, statusz=self._statusz,
+                healthz=lambda: {"role": "worker"}, host=metrics_host)
         self.executor = LocalExecutor(self.db, self.profiler,
                                       num_load_workers=num_load_workers,
                                       num_save_workers=num_save_workers,
                                       pipeline_instances=pipeline_instances,
                                       decoder_threads=decoder_threads)
         rpc.wait_for_server(master_address, MASTER_SERVICE)
+        # the address other processes can dial THIS worker at (the
+        # master's GetMetrics aggregation uses it).  localhost is right
+        # for single-host clusters and tests; multi-host deployments
+        # pass the pod/host DNS name (deploy.py wires the pod name)
+        self.advertise_address = \
+            f"{advertise_host or 'localhost'}:{self.port}"
         self.worker_id = self.master.call(
-            "RegisterWorker", address=f"localhost:{self.port}")["worker_id"]
+            "RegisterWorker", address=self.advertise_address)["worker_id"]
         _wlog.info("worker %d registered with master %s (port %d)",
                    self.worker_id, master_address, self.port)
         # cached per-bulk state
@@ -921,7 +1148,7 @@ class Worker:
                 if hb.get("reregister"):
                     reg = self.master.try_call(
                         "RegisterWorker",
-                        address=f"localhost:{self.port}")
+                        address=self.advertise_address)
                     if reg:
                         self.worker_id = reg["worker_id"]
                 else:
@@ -931,6 +1158,19 @@ class Worker:
     def _rpc_shutdown(self, req: dict) -> dict:
         self._shutdown.set()
         return {"ok": True}
+
+    def _statusz(self) -> dict:
+        # getattr guards: the endpoint is live before __init__ finishes
+        ex = getattr(self, "executor", None)
+        return {
+            "role": "worker",
+            "worker_id": getattr(self, "worker_id", None),
+            "master": self.master.address,
+            "bulk_id": getattr(self, "_bulk_id", None),
+            "pipeline_instances": ex.pipeline_instances if ex else None,
+            "num_load_workers": ex.num_load_workers if ex else None,
+            "num_save_workers": ex.num_save_workers if ex else None,
+        }
 
     # ------------------------------------------------------------------
 
@@ -1121,6 +1361,9 @@ class Worker:
     def stop(self) -> None:
         self._shutdown.set()
         self._server.stop()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
         with self._eval_lock:
             for te in self._evaluators.values():
                 te.close()
@@ -1187,8 +1430,18 @@ class ClusterClient:
                 # or checkpoint missing): surface, don't KeyError
                 raise JobException(st.get("error", "bulk job lost"))
             if show_progress:
+                # same numbers as /statusz (GetJobStatus is the single
+                # source of truth for job progress)
+                fps = (st.get("stage_fps") or {}).get("save")
+                eta = st.get("eta_seconds")
+                extra = ""
+                if fps:
+                    extra += f" {fps:.0f} rows/s"
+                if eta is not None:
+                    extra += f" eta {eta:.0f}s"
                 print(f"\rtasks {st['tasks_done']}/{st['total_tasks']} "
-                      f"workers={st['num_workers']}", end="", flush=True)
+                      f"workers={st['num_workers']}{extra}",
+                      end="", flush=True)
             if st.get("finished"):
                 if show_progress:
                     print()
@@ -1206,6 +1459,15 @@ class ClusterClient:
                 return [Profiler.from_dict(d)
                         for d in reply.get("profiles", [])]
             time.sleep(self.poll_interval)
+
+    def metrics(self) -> dict:
+        """Cluster-wide merged metrics snapshot (master + every live
+        worker, node-labeled) via the master's GetMetrics RPC."""
+        reply = self.master.call("GetMetrics", timeout=30.0)
+        return reply["snapshot"]
+
+    def job_status(self, bulk_id: Optional[int] = None) -> dict:
+        return self.master.call("GetJobStatus", bulk_id=bulk_id)
 
     def close(self) -> None:
         self._watchdog_stop.set()

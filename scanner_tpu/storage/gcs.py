@@ -104,10 +104,14 @@ class GcsStorage(StorageBackend):
 
     def _with_retry(self, fn):
         """Run fn() retrying transient 429/5xx/connection errors with
-        full-jitter exponential backoff (storehouse retry parity)."""
+        full-jitter exponential backoff (storehouse retry parity).
+        Retries count into scanner_tpu_retry_attempts_total{site="gcs"}
+        and the final give-up logs at WARNING with the accumulated wait
+        (util/retry.py) — a throttled bucket is visible live, not only
+        as mysteriously slow tasks."""
         return call_with_backoff(
             fn, is_transient=_transient, retries=self._retries,
-            base=self._backoff_base, cap=self._backoff_cap)
+            base=self._backoff_base, cap=self._backoff_cap, label="gcs")
 
     # -- reads ----------------------------------------------------------
 

@@ -14,6 +14,17 @@ from collections import defaultdict
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional
 
+from . import metrics as _mx
+
+# every profiler counter event mirrors into this live series, so the
+# post-mortem trace counters and the /metrics endpoint can never
+# disagree on event counts (docs/observability.md)
+_M_EVENTS = _mx.registry().counter(
+    "scanner_tpu_profiler_events_total",
+    "Profiler counter events (state_carry_miss, stream_chunks, ...); "
+    "mirrors Profiler.count so traces and live metrics agree.",
+    labels=["event"])
+
 
 @dataclass
 class Interval:
@@ -82,6 +93,7 @@ class Profiler:
     def count(self, name: str, n: int = 1) -> None:
         with self._lock:
             self._counters[name] += n
+        _M_EVENTS.labels(event=name).inc(n)
 
     def intervals(self) -> List[Interval]:
         with self._lock:
@@ -101,6 +113,8 @@ class Profiler:
         return {
             "node": self.node,
             "base_time": self.base_time,
+            "level": self.level,
+            "max_intervals": self.max_intervals,
             "counters": self.counters,
             "device_traces": list(self.device_traces),
             "intervals": [
@@ -111,7 +125,13 @@ class Profiler:
 
     @classmethod
     def from_dict(cls, d: dict) -> "Profiler":
-        p = cls(node=d["node"], base_time=d["base_time"])
+        # level/max_intervals must survive the round-trip: a merged
+        # worker profile re-filtered or re-capped on the master would
+        # silently drop spans the worker already admitted (older
+        # serializations lack the keys; keep their recording intact)
+        p = cls(node=d["node"], base_time=d["base_time"],
+                level=int(d.get("level", 99)),
+                max_intervals=int(d.get("max_intervals", 2 ** 63 - 1)))
         p.device_traces = list(d.get("device_traces", []))
         lst = p._list()
         for iv in d["intervals"]:

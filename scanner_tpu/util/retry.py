@@ -5,6 +5,12 @@ Capability parity: the reference wraps every cross-process call in
 worker.cpp:886) and its storehouse layer retries transient storage
 errors.  One shared helper serves both the RPC client (UNAVAILABLE
 channels) and the GCS backend (429/5xx).
+
+Retries are no longer silent: each retry increments the live
+``scanner_tpu_retry_attempts_total{site=...}`` counter (util/metrics.py),
+and a final give-up after real retries logs at WARNING with the
+accumulated backoff wait — an operator watching /metrics or the log sees
+a flapping dependency before it becomes a job failure.
 """
 
 from __future__ import annotations
@@ -13,7 +19,17 @@ import random
 import time
 from typing import Callable, Optional, TypeVar
 
+from . import metrics as _mx
+from .log import get_logger
+
 T = TypeVar("T")
+
+_log = get_logger("retry")
+
+_M_RETRIES = _mx.registry().counter(
+    "scanner_tpu_retry_attempts_total",
+    "Transient-failure retries by call site (rpc:<method>, gcs, ...).",
+    labels=["site"])
 
 
 def backoff_delays(retries: int, base: float = 0.05, cap: float = 2.0,
@@ -31,11 +47,15 @@ def call_with_backoff(fn: Callable[[], T], *,
                       retries: int = 4, base: float = 0.05,
                       cap: float = 2.0,
                       sleep: Callable[[float], None] = time.sleep,
-                      rng: Optional[random.Random] = None) -> T:
+                      rng: Optional[random.Random] = None,
+                      label: str = "") -> T:
     """Run fn(); on a transient exception retry up to `retries` times with
     full-jitter exponential backoff.  Non-transient exceptions and the
-    final transient failure propagate unchanged."""
+    final transient failure propagate unchanged.  `label` names the call
+    site in the retry counter and the give-up log line."""
     delays = backoff_delays(retries, base=base, cap=cap, rng=rng)
+    attempts = 0
+    waited = 0.0
     while True:
         try:
             return fn()
@@ -45,5 +65,16 @@ def call_with_backoff(fn: Callable[[], T], *,
             try:
                 delay = next(delays)
             except StopIteration:
+                if attempts:
+                    # only after real retries: retries=0 callers (e.g.
+                    # wait_for_server's own poll loop) stay quiet
+                    _log.warning(
+                        "giving up%s after %d retries (%.2fs accumulated "
+                        "backoff): %s: %s",
+                        f" [{label}]" if label else "", attempts, waited,
+                        type(e).__name__, e)
                 raise e from None
+            attempts += 1
+            waited += delay
+            _M_RETRIES.labels(site=label or "other").inc()
             sleep(delay)

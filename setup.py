@@ -27,6 +27,8 @@ setup(
     install_requires=[
         "jax", "flax", "optax", "numpy", "msgpack", "cloudpickle",
         "grpcio",
+        # config.py falls back to tomli where stdlib tomllib is absent
+        'tomli; python_version < "3.11"',
     ],
     cmdclass={"build_py": BuildWithNative},
 )

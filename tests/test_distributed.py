@@ -246,6 +246,59 @@ def test_long_task_survives_stale_scan(cluster):
     assert out.len() == 40 and out.committed()
 
 
+def test_job_status_reports_progress_and_fps(cluster):
+    """GetJobStatus carries the live-status fields /statusz shares:
+    per-job tasks done/total, per-stage fps, ETA, worker count."""
+    sc, master, workers, _dbp, addr = cluster
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    h = sc.ops.DistHist(frame=frame)
+    out = NamedStream(sc, "status_out")
+    sc.run(sc.io.Output(h, [out]), PerfParams.manual(4, 8),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    st = master._rpc_job_status({})
+    assert st["finished"] is True
+    assert st["tasks_done"] == st["total_tasks"]
+    n_tasks = (N_FRAMES + 7) // 8
+    assert st["tasks_done"] == n_tasks
+    # per-stage fps derived from the master-observed transitions: every
+    # row passed every stage, so all three are positive and roughly equal
+    assert set(st["stage_fps"]) == {"load", "evaluate", "save"}
+    assert all(v > 0 for v in st["stage_fps"].values()), st["stage_fps"]
+    # ETA only exists while the bulk is unfinished
+    assert st["eta_seconds"] is None
+    assert st["elapsed_seconds"] > 0
+    per_job = st["per_job"]
+    assert len(per_job) == 1
+    (job,) = per_job.values()
+    assert job["tasks_done"] == job["tasks_total"] == n_tasks
+    assert job["blacklisted"] is False
+    # blacklisted jobs are flagged per job
+    frame = sc.io.Input([NamedVideoStream(sc, "test1")])
+    f = sc.ops.DistFail(frame=frame)
+    out2 = NamedStream(sc, "status_fail_out")
+    with pytest.raises(ScannerException):
+        sc.run(sc.io.Output(f, [out2]), PerfParams.manual(8, 8),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+    st2 = master._rpc_job_status({})
+    assert any(j["blacklisted"] for j in st2["per_job"].values())
+    assert st2["failed_jobs"]
+
+
+def test_stage_rows_not_double_counted_on_retry():
+    """A retried attempt's second StartedWork/EvalDone must not inflate
+    the per-stage row counts GetJobStatus reports — on a flaky cluster
+    the load fps would otherwise read (retries+1)x the save fps."""
+    from scanner_tpu.engine.service import _BulkJob
+
+    bulk = _BulkJob(bulk_id=0, spec_blob=b"", task_timeout=0.0)
+    bulk.task_rows[(0, 0)] = 8
+    bulk.count_stage("load", (0, 0))
+    bulk.count_stage("load", (0, 0))      # re-issued attempt
+    bulk.count_stage("evaluate", (0, 0))
+    bulk.count_stage("evaluate", (0, 0))
+    assert bulk.stage_rows == {"load": 8, "evaluate": 8, "save": 0}
+
+
 def test_cluster_profiles(cluster):
     sc, master, workers, _dbp, _addr = cluster
     frame = sc.io.Input([NamedVideoStream(sc, "test1")])

@@ -200,6 +200,52 @@ def test_deploy_multihost_slice():
     assert 'type = "gcs"' in toml
 
 
+def test_deploy_metrics_port_wiring():
+    """ClusterConfig.metrics_port threads the live-telemetry endpoint
+    through the manifests: start_master/start_worker args, exposed
+    container ports, and the ConfigMap toml — and stays fully absent at
+    the default (telemetry serving is opt-in, docs/observability.md)."""
+    import ast
+
+    from scanner_tpu.deploy import (CloudConfig, Cluster, ClusterConfig,
+                                    MachineType)
+
+    def manifests(port):
+        cfg = ClusterConfig(id="sc", num_workers=2,
+                            worker=MachineType(tpu_type="v5litepod-4"),
+                            metrics_port=port)
+        cluster = Cluster(CloudConfig(project="p"), cfg)
+        return {(m["kind"], m["metadata"]["name"]): m
+                for m in cluster.manifests()}
+
+    on = manifests(9090)
+    mc = on[("Deployment", "sc-master")]["spec"]["template"]["spec"][
+        "containers"][0]
+    ast.parse(mc["command"][2])
+    assert "metrics_port=9090" in mc["command"][2]
+    assert {"containerPort": 9090, "name": "metrics"} in mc["ports"]
+    wc = on[("StatefulSet", "sc-worker")]["spec"]["template"]["spec"][
+        "containers"][0]
+    ast.parse(wc["command"][2])
+    assert "metrics_port=9090" in wc["command"][2]
+    assert {"containerPort": 9090, "name": "metrics"} in wc["ports"]
+    # workers advertise their stable pod DNS so the master's GetMetrics
+    # aggregation can dial them cross-host
+    assert "advertise_host=os.environ['POD_NAME'] + '.sc-workers'" \
+        in wc["command"][2]
+    assert "metrics_port = 9090" in on[("ConfigMap", "sc-config")][
+        "data"]["scanner_tpu.toml"]
+
+    off = manifests(0)
+    mc = off[("Deployment", "sc-master")]["spec"]["template"]["spec"][
+        "containers"][0]
+    assert "metrics_port" not in mc["command"][2]
+    wc = off[("StatefulSet", "sc-worker")]["spec"]["template"]["spec"][
+        "containers"][0]
+    assert "metrics_port" not in wc["command"][2]
+    assert "ports" not in wc
+
+
 def test_deploy_gcloud_commands():
     from scanner_tpu.deploy import (CloudConfig, Cluster, ClusterConfig,
                                     MachineType)

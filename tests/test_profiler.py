@@ -17,6 +17,32 @@ def test_level_filtering():
     assert names == ["coarse"]
 
 
+def test_serialization_restores_level_and_cap():
+    """from_dict must carry level/max_intervals through the worker ->
+    master profile round-trip: a merged profile that re-filtered or
+    re-capped on the master would silently drop spans the worker
+    already admitted."""
+    p = Profiler(node="w", level=2, max_intervals=7)
+    with p.span("detail", level=2):
+        pass
+    q = Profiler.from_dict(p.to_dict())
+    assert q.level == 2
+    assert q.max_intervals == 7
+    assert [iv.name for iv in q.intervals()] == ["detail"]
+    # a restored profile must admit the same levels the source did:
+    # level-2 spans survived the wire, so new level-2 recording (e.g.
+    # during a master-side merge) must not be filtered either
+    q.add_interval("post", 0.0, 1.0, level=2)
+    assert {iv.name for iv in q.intervals()} == {"detail", "post"}
+    # legacy payloads without the keys must not re-filter or re-cap
+    d = p.to_dict()
+    del d["level"], d["max_intervals"]
+    legacy = Profiler.from_dict(d)
+    assert [iv.name for iv in legacy.intervals()] == ["detail"]
+    legacy.add_interval("post2", 0.0, 1.0, level=2)
+    assert "post2" in {iv.name for iv in legacy.intervals()}
+
+
 def test_interval_cap_counts_drops():
     p = Profiler(max_intervals=5)
     for i in range(9):

@@ -1,0 +1,193 @@
+"""scanner-top: live cluster telemetry in a terminal.
+
+Polls the master's GetJobStatus + GetMetrics RPCs and renders a
+per-job / per-node table — the interactive consumer of the telemetry
+subsystem (docs/observability.md).  `top` for a scanner cluster:
+
+    python tools/scanner_top.py --master localhost:5000
+    python tools/scanner_top.py --master localhost:5000 --once   # scripts
+
+Rates (decode fps, eval rows/s, h2d MB/s) come from counter deltas
+between polls; the first poll (and --once) uses since-process-start
+averages via scanner_tpu_process_start_time_seconds.  Exit codes:
+0 ok, 2 master unreachable.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+# -- snapshot digestion -----------------------------------------------------
+
+def _sum_counter(snap: dict, name: str, node: str) -> float:
+    """Sum a counter's samples for one node across its other labels."""
+    entry = snap.get(name)
+    if not entry:
+        return 0.0
+    return sum(s.get("value", 0.0) for s in entry["samples"]
+               if s["labels"].get("node") == node)
+
+
+def _gauge(snap: dict, name: str, node: str, **labels) -> float:
+    entry = snap.get(name)
+    if not entry:
+        return 0.0
+    for s in entry["samples"]:
+        sl = s["labels"]
+        if sl.get("node") == node and all(sl.get(k) == v
+                                          for k, v in labels.items()):
+            return s.get("value", 0.0)
+    return 0.0
+
+
+def _nodes(snap: dict):
+    seen = []
+    for entry in snap.values():
+        for s in entry["samples"]:
+            n = s["labels"].get("node")
+            if n and n not in seen:
+                seen.append(n)
+    return sorted(seen)
+
+
+NODE_COUNTERS = {
+    "decode_f": "scanner_tpu_decoded_frames_total",
+    "eval_r": "scanner_tpu_op_rows_total",
+    "h2d_b": "scanner_tpu_h2d_bytes_total",
+    "d2h_b": "scanner_tpu_d2h_bytes_total",
+    "retries": "scanner_tpu_retry_attempts_total",
+}
+
+
+def digest(snap: dict) -> dict:
+    """Per-node counter totals + gauges + a timestamp, ready for rate
+    computation between two polls."""
+    out = {"t": time.time(), "nodes": {}}
+    for node in _nodes(snap):
+        d = {k: _sum_counter(snap, name, node)
+             for k, name in NODE_COUNTERS.items()}
+        d["start"] = _gauge(snap, "scanner_tpu_process_start_time_seconds",
+                            node)
+        d["evalq"] = _gauge(snap, "scanner_tpu_stage_queue_depth", node,
+                            stage="evaluate")
+        d["saveq"] = _gauge(snap, "scanner_tpu_stage_queue_depth", node,
+                            stage="save")
+        out["nodes"][node] = d
+    return out
+
+
+def _rate(cur: dict, prev: dict, key: str, now: float) -> float:
+    """delta/interval vs the previous poll, or since-start average."""
+    if prev is not None:
+        dt = max(cur["_dt"], 1e-6)
+        return max(cur[key] - prev.get(key, 0.0), 0.0) / dt
+    up = max(now - cur["start"], 1e-6) if cur.get("start") else None
+    return cur[key] / up if up else 0.0
+
+
+# -- rendering --------------------------------------------------------------
+
+def render(status: dict, cur: dict, prev: dict, master: str) -> str:
+    now = cur["t"]
+    lines = [f"scanner-top  master={master}  "
+             f"{time.strftime('%H:%M:%S', time.localtime(now))}"]
+    if status is None or "tasks_done" not in status:
+        lines.append("no active bulk job")
+    else:
+        fps = status.get("stage_fps") or {}
+        eta = status.get("eta_seconds")
+        lines.append(
+            f"bulk: {status['tasks_done']}/{status['total_tasks']} tasks"
+            f"  workers={status.get('num_workers', '?')}"
+            f"  load {fps.get('load', 0):.1f} r/s"
+            f"  eval {fps.get('evaluate', 0):.1f} r/s"
+            f"  save {fps.get('save', 0):.1f} r/s"
+            + (f"  ETA {eta:.0f}s" if eta is not None else "")
+            + ("  FINISHED" if status.get("finished") else ""))
+        per_job = status.get("per_job") or {}
+        lagging = [(j, d) for j, d in sorted(per_job.items())
+                   if d["tasks_done"] < d["tasks_total"]]
+        if len(per_job) > 1:
+            shown = lagging[:8]
+            lines.append(f"jobs: {len(per_job)} total, "
+                         f"{len(per_job) - len(lagging)} complete"
+                         + ("; in flight: " + ", ".join(
+                             f"#{j} {d['tasks_done']}/{d['tasks_total']}"
+                             + (" [blacklisted]" if d.get("blacklisted")
+                                else "")
+                             for j, d in shown) if shown else ""))
+    lines.append("")
+    hdr = (f"{'NODE':10} {'DECODE f/s':>10} {'EVAL r/s':>9} "
+           f"{'H2D MB/s':>9} {'D2H MB/s':>9} {'EVALQ':>6} {'SAVEQ':>6} "
+           f"{'RETRY':>6}")
+    lines.append(hdr)
+    prev_nodes = (prev or {}).get("nodes", {})
+    for node, d in sorted(cur["nodes"].items()):
+        p = prev_nodes.get(node)
+        if p is not None:
+            d["_dt"] = cur["t"] - prev["t"]
+        lines.append(
+            f"{node:10} "
+            f"{_rate(d, p, 'decode_f', now):>10.1f} "
+            f"{_rate(d, p, 'eval_r', now):>9.1f} "
+            f"{_rate(d, p, 'h2d_b', now) / 1e6:>9.2f} "
+            f"{_rate(d, p, 'd2h_b', now) / 1e6:>9.2f} "
+            f"{d['evalq']:>6.0f} {d['saveq']:>6.0f} "
+            f"{d['retries']:>6.0f}")
+    return "\n".join(lines)
+
+
+# -- main -------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="live per-job/per-worker telemetry for a scanner_tpu "
+                    "cluster (top-style)")
+    ap.add_argument("--master", default="localhost:5000",
+                    help="master address host:port (default %(default)s)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="poll period seconds (default %(default)s)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (for scripts)")
+    args = ap.parse_args(argv)
+
+    from scanner_tpu.engine.rpc import RpcClient
+    from scanner_tpu.engine.service import MASTER_SERVICE
+
+    client = RpcClient(args.master, MASTER_SERVICE, timeout=10.0)
+    prev = None
+    try:
+        while True:
+            reply = client.try_call("GetMetrics", retries=1)
+            if reply is None:
+                print(f"scanner-top: master {args.master} unreachable",
+                      file=sys.stderr)
+                return 2
+            status = client.try_call("GetJobStatus", bulk_id=None,
+                                     retries=1)
+            if status is not None and "error" in status \
+                    and "tasks_done" not in status:
+                status = None
+            cur = digest(reply["snapshot"])
+            frame = render(status, cur, prev, args.master)
+            if args.once:
+                print(frame)
+                return 0
+            # clear screen + home, like top
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            prev = cur
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        client.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
