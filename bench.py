@@ -296,8 +296,23 @@ def main():
         # alongside fps — the attribution PERF.md round 3 had to
         # reconstruct from traces ships with every bench run
         from scanner_tpu.util.metrics import registry
-        detail.append({"config": "metrics_registry",
-                       "snapshot": registry().snapshot()})
+        snap = registry().snapshot()
+
+        def per_op(series: str) -> dict:
+            return {s["labels"].get("op", "_"): s["value"]
+                    for s in snap.get(series, {}).get("samples", [])}
+
+        # shape-stability digest: with bucketed dispatch (PERF.md §5)
+        # recompiles must sit at ladder size per op whatever the task
+        # geometry; pad_rows is the padding waste paid for that
+        detail.append({
+            "config": "shape_stability",
+            "recompiles": per_op("scanner_tpu_op_recompiles_total"),
+            "pad_rows": per_op("scanner_tpu_op_pad_rows_total"),
+            "precompile_seconds":
+                per_op("scanner_tpu_op_precompile_seconds"),
+        })
+        detail.append({"config": "metrics_registry", "snapshot": snap})
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_DETAIL.json"), "w") as f:
             json.dump(detail, f, indent=1)

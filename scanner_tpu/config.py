@@ -37,6 +37,14 @@ def default_config() -> Dict[str, Any]:
             # (docs/observability.md)
             "metrics_port": 0,
         },
+        "perf": {
+            # directory for JAX's persistent compilation cache: jitted
+            # kernel executables (one per bucket shape, see PERF.md §5)
+            # survive process restarts instead of recompiling.  "" (the
+            # default) disables; SCANNER_TPU_COMPILATION_CACHE overrides
+            # per process.
+            "compilation_cache_dir": "",
+        },
     }
 
 
@@ -92,6 +100,13 @@ class Config:
         if n.get("master"):
             return f"{n['master']}:{n['master_port']}"
         return None
+
+    @property
+    def compilation_cache_dir(self) -> Optional[str]:
+        """Persistent XLA compilation-cache directory, or None when
+        disabled (the default)."""
+        d = self.config.get("perf", {}).get("compilation_cache_dir", "")
+        return d or None
 
     @property
     def metrics_port(self) -> Optional[int]:

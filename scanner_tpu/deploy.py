@@ -146,6 +146,14 @@ class ClusterConfig:
     # serves it on that port on master AND workers and exposes the
     # container port for Prometheus scraping (docs/observability.md)
     metrics_port: int = 0
+    # persistent XLA compilation-cache directory for workers ("" =
+    # disabled).  Point it at pod-local scratch or a gs:// prefix shared
+    # by the fleet: a restarted/rescheduled worker then re-loads its
+    # jitted kernel executables instead of re-paying seconds of TPU
+    # compile per bucket shape (PERF.md §5).  Wired into the ConfigMap
+    # toml ([perf] section) and each worker's
+    # SCANNER_TPU_COMPILATION_CACHE env var.
+    compilation_cache_dir: str = ""
 
     def price_per_hour(self) -> float:
         return (self.master_cpus * CPU_PRICE_PER_CORE
@@ -256,7 +264,7 @@ def cluster_resize_commands(cloud: CloudConfig, cfg: ClusterConfig,
 
 def config_manifest(cfg: ClusterConfig) -> Dict:
     """ConfigMap carrying ~/.scanner_tpu.toml for every pod."""
-    toml = dump_toml({
+    sections = {
         "storage": {"type": "gcs" if cfg.db_path.startswith("gs://")
                     else "posix",
                     "db_path": cfg.db_path},
@@ -264,7 +272,11 @@ def config_manifest(cfg: ClusterConfig) -> Dict:
                     "master_port": cfg.master_port,
                     "worker_port": 5001,
                     "metrics_port": cfg.metrics_port},
-    })
+    }
+    if cfg.compilation_cache_dir:
+        sections["perf"] = {
+            "compilation_cache_dir": cfg.compilation_cache_dir}
+    toml = dump_toml(sections)
     return {
         "apiVersion": "v1", "kind": "ConfigMap",
         "metadata": {"name": f"{cfg.id}-config"},
@@ -382,6 +394,11 @@ def _worker_statefulset(cfg: ClusterConfig, name: str, replicas: int,
                             {"name": "POD_NAME",
                              "valueFrom": {"fieldRef": {
                                  "fieldPath": "metadata.name"}}},
+                            # worker-side persistent XLA executable cache
+                            # (Worker.__init__ picks the env var up)
+                            *([{"name": "SCANNER_TPU_COMPILATION_CACHE",
+                                "value": cfg.compilation_cache_dir}]
+                              if cfg.compilation_cache_dir else []),
                         ],
                         "resources": {
                             "requests": {"cpu": str(cfg.worker.cpus)},

@@ -155,10 +155,44 @@ class ColumnBatch:
                     for i, p in enumerate(safe)]
         return ColumnBatch(new_rows, data, nulls, convert=self.convert)
 
+    def _contig_slice(self, start_row: int, k: int,
+                      new_rows: np.ndarray) -> Optional["ColumnBatch"]:
+        """Slice rows [start_row, start_row+k) directly if they are all
+        present and contiguous in this batch (two binary-searched
+        endpoint checks instead of a full positions lookup; array data
+        stays a view).  None = not contiguous here, use the slow path."""
+        p0 = int(np.searchsorted(self.rows, start_row))
+        if p0 + k > len(self.rows) or self.rows[p0] != start_row \
+                or self.rows[p0 + k - 1] != start_row + k - 1:
+            return None
+        nulls = self.nulls[p0:p0 + k] if self.nulls is not None else None
+        return ColumnBatch(new_rows, self.data[p0:p0 + k], nulls,
+                           convert=self.convert)
+
     def take_rows(self, rows: np.ndarray,
                   new_rows: Optional[np.ndarray] = None) -> "ColumnBatch":
-        return self.take(self.positions(np.asarray(rows, np.int64)),
-                         rows if new_rows is None else new_rows)
+        rows = np.asarray(rows, np.int64)
+        nr = rows if new_rows is None else new_rows
+        # contiguous [start, end) fast path — the sink hot path fetches
+        # exactly this shape once per task
+        k = len(rows)
+        if k and len(self.rows) and int(rows[-1]) - int(rows[0]) == k - 1 \
+                and (k == 1 or bool((np.diff(rows) == 1).all())):
+            out = self._contig_slice(int(rows[0]), k, nr)
+            if out is not None:
+                return out
+        return self.take(self.positions(rows), nr)
+
+    def take_range(self, start: int, end: int) -> "ColumnBatch":
+        """take_rows for the contiguous row range [start, end) without
+        the caller materializing an index or this batch running the full
+        positions lookup (executor._sink_rows hot path)."""
+        rows = np.arange(start, end, dtype=np.int64)
+        if len(rows) and len(self.rows):
+            out = self._contig_slice(int(start), len(rows), rows)
+            if out is not None:
+                return out
+        return self.take(self.positions(rows), rows)
 
     def relabel(self, new_rows: np.ndarray) -> "ColumnBatch":
         """Same data, new row ids (slice/unslice row renumbering)."""

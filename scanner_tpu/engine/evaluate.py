@@ -11,10 +11,26 @@ are vectorized gathers/relabels on the batch, device kernels receive
 on-device slices and chain device-to-device (the reference's pooled
 block-allocator + per-call repacking, memory.cpp:269 /
 evaluate_worker.cpp:1040-1100, replaced by zero-copy views + a single
-host->device transfer per column)."""
+host->device transfer per column).
+
+Shape-stable dispatch: XLA compiles one executable per (shape, dtype)
+signature, and a TPU compile costs seconds — so device-kernel calls are
+routed through a small power-of-two bucket ladder (`bucket_ladder`).  A
+tail chunk pads up to the next bucket by edge-repeating its last row
+(the REPEAT_EDGE convention stencils already use) and the padding is
+sliced off before results are emitted; null-propagated rows ride through
+the call at the full chunk shape and are overwritten with NullElement
+afterward, so neither task geometry nor null sparsity ever mints a new
+executable.  Host/python kernels keep exact shapes (retracing is free
+there), and stateful kernels do too (padding rows would advance their
+state).  `TaskEvaluator(precompile=...)` warms each device op's ladder
+on a background thread — overlapped with the first task's decode — so
+steady-state tasks never stall on a compile."""
 
 from __future__ import annotations
 
+import os
+import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -25,8 +41,11 @@ from ..common import (DeviceType, GraphException, JobException, NullElement,
 from ..graph import analysis as A
 from ..graph import ops as O
 from ..util import metrics as _mx
+from ..util.log import get_logger
 from ..util.profiler import Profiler
 from .batch import ColumnBatch, concat_batches, is_array_data
+
+_log = get_logger("evaluate")
 
 # per-op live throughput: fps = delta rows / delta seconds per op label
 _M_OP_ROWS = _mx.registry().counter(
@@ -39,8 +58,21 @@ _M_OP_SECONDS = _mx.registry().counter(
     labels=["op"])
 _M_OP_RECOMPILES = _mx.registry().counter(
     "scanner_tpu_op_recompiles_total",
-    "New input-shape signatures seen per op — each one forces an XLA "
-    "recompile of a jitted kernel; a climbing count means shape churn.",
+    "New input (shape, dtype) signatures seen per op — each one forces "
+    "an XLA recompile of a jitted kernel; a climbing count means shape "
+    "churn.  With bucketed dispatch this is bounded by the op's "
+    "bucket-ladder size.",
+    labels=["op"])
+_M_OP_PAD_ROWS = _mx.registry().counter(
+    "scanner_tpu_op_pad_rows_total",
+    "Edge-repeat padding rows added by bucketed dispatch to round tail "
+    "chunks up to a bucket shape (padding waste; the price of never "
+    "re-tracing).",
+    labels=["op"])
+_M_OP_PRECOMPILE = _mx.registry().gauge(
+    "scanner_tpu_op_precompile_seconds",
+    "Seconds the setup-time warm-up spent precompiling this device "
+    "op's bucket ladder (overlapped with the first task's decode).",
     labels=["op"])
 
 Elem = Any  # np.ndarray | bytes | arbitrary python object | NullElement
@@ -62,6 +94,94 @@ def _accel_backend() -> bool:
         import jax
         _BACKEND = jax.default_backend()
     return _BACKEND != "cpu"
+
+
+# ---------------------------------------------------------------------------
+# Shape-stable bucketed dispatch
+# ---------------------------------------------------------------------------
+
+# smallest bucket: a ladder of {4, 8, ..., cap} bounds the executable
+# count at log2(cap/4)+1 while wasting at most 3 padded rows on the
+# tiniest call
+_MIN_BUCKET = 4
+
+
+def bucket_ladder(cap: int) -> List[int]:
+    """Batch-size buckets for a kernel whose per-call batch cap is `cap`:
+    powers of two from min(4, cap) up, with `cap` itself as the top rung
+    (so a full chunk never pads).  Every jitted-kernel call shape is one
+    of these, so the op compiles at most len(ladder) executables per
+    input dtype."""
+    cap = max(1, int(cap))
+    if cap <= _MIN_BUCKET:
+        return [cap]
+    ladder = []
+    b = _MIN_BUCKET
+    while b < cap:
+        ladder.append(b)
+        b <<= 1
+    ladder.append(cap)
+    return ladder
+
+
+def bucket_for(k: int, ladder: Sequence[int]) -> int:
+    """Smallest ladder bucket >= k (k must be <= ladder[-1])."""
+    for b in ladder:
+        if b >= k:
+            return b
+    return ladder[-1]
+
+
+def _bucketing_enabled() -> bool:
+    """SCANNER_TPU_BUCKETED=0 opts out (exact call shapes, the
+    pre-bucketing behavior; padding-equivalence tests A/B against it)."""
+    return os.environ.get("SCANNER_TPU_BUCKETED", "1") not in ("0", "false")
+
+
+def _precompile_enabled() -> bool:
+    """Ladder warm-up default: on for accelerator backends (where a cold
+    compile stalls the pipeline for seconds), off on the CPU backend
+    (retracing is cheap and tests construct many evaluators).
+    SCANNER_TPU_PRECOMPILE=1/0 forces either way."""
+    flag = os.environ.get("SCANNER_TPU_PRECOMPILE", "")
+    if flag in ("0", "false"):
+        return False
+    if flag in ("1", "force", "true"):
+        return True
+    return _accel_backend()
+
+
+def _source_geometry_inputs(node: O.OpNode) -> bool:
+    """True when every FRAME input of `node` reaches an Input node
+    through builtins only (gathers never change frame geometry), so the
+    ladder warm-up's synthesized frames have the source's shape.  An
+    intervening kernel (Resize/CropResize/...) may change geometry; its
+    consumers skip warm-up rather than compile a wrong-shape ladder —
+    and stall their first real call behind it via ensure_warm."""
+    for c in node.input_columns():
+        if not c.is_frame:
+            continue
+        p = c.op
+        while p.is_builtin and p.name != O.INPUT_OP:
+            cols = p.input_columns()
+            if not cols:
+                return False
+            p = cols[0].op
+        if p.name != O.INPUT_OP:
+            return False
+    return True
+
+
+def _strip_pad(res, k: int, n_out: int):
+    """Drop bucket-padding rows from a kernel result before emission.
+    Accepts every result protocol emit_result does: a single batch, a
+    tuple of per-column batches, or a list of per-row results/tuples."""
+    if n_out > 1 and isinstance(res, tuple) and len(res) == n_out:
+        return tuple(r[:k] for r in res)
+    try:
+        return res[:k]
+    except TypeError:
+        return res  # malformed result: let emit_result raise its error
 
 
 class StateCarryMiss(Exception):
@@ -88,8 +208,15 @@ class KernelInstance:
         self._cur_stream: Tuple[int, int] = (-1, -1)  # (job, slice group)
         self._last_row: Optional[int] = None
         self._did_setup = False
-        # input-shape signatures already executed (XLA recompile proxy)
+        # input (shape, dtype) signatures already executed (XLA recompile
+        # proxy — dtype included: equal shapes with different dtypes are
+        # distinct executables)
         self._shape_sigs: set = set()
+        # bucket-ladder warm-up state: idle (not scheduled) | pending
+        # (scheduled, not started) | running | done
+        self._warm_lock = threading.Lock()
+        self._warm_state = "idle"
+        self._warm_done = threading.Event()
 
     def setup(self, fetch: bool = True) -> None:
         if not self._did_setup:
@@ -125,6 +252,74 @@ class KernelInstance:
             self.kernel.reset()
         self._last_row = row
 
+    # -- bucket-ladder warm-up (precompile) ----------------------------
+
+    def _example_args(self, b: int, h: int, w: int) -> Optional[List[Any]]:
+        """Synthesized execute() args at batch size `b` for warm-up:
+        frame columns get (b[, W], h, w, 3) uint8 zeros, non-frame
+        columns come from the kernel's optional `precompile_input(name)`
+        hook.  None = this op is not generically warmable (variadic, or
+        a non-frame input without a hook)."""
+        if self.spec.variadic:
+            return None
+        sten = self.node.effective_stencil()
+        win = len(sten) if sten != [0] else 0
+        args: List[Any] = []
+        for name, is_frame in self.spec.input_columns:
+            if is_frame:
+                shape = (b, win, h, w, 3) if win else (b, h, w, 3)
+                args.append(np.zeros(shape, np.uint8))
+            else:
+                hook = getattr(self.kernel, "precompile_input", None)
+                row = hook(name) if hook is not None else None
+                if row is None:
+                    return None
+                args.append([[row] * win for _ in range(b)] if win
+                            else [row] * b)
+        return args
+
+    def precompile(self, ladder: Sequence[int], h: int, w: int) -> None:
+        """Compile this kernel's jitted function at every ladder bucket
+        (best-effort: a failing warm-up shape is skipped; the real call
+        then compiles it).  Runs on the evaluator's warm-up thread;
+        ensure_warm() on the evaluation thread claims or waits."""
+        with self._warm_lock:
+            if self._warm_state != "pending":
+                return  # claimed by a real call racing ahead of us
+            self._warm_state = "running"
+        t0 = time.time()
+        try:
+            for b in ladder:
+                args = self._example_args(b, h, w)
+                if args is None:
+                    return
+                try:
+                    self.kernel.execute(*args)
+                except Exception:  # noqa: BLE001 — warm-up is best-effort
+                    _log.debug("precompile of %s at batch %d failed",
+                               self.node.name, b, exc_info=True)
+                    return
+            _M_OP_PRECOMPILE.labels(op=self.node.name).set(
+                time.time() - t0)
+        finally:
+            with self._warm_lock:
+                self._warm_state = "done"
+            self._warm_done.set()
+
+    def ensure_warm(self) -> None:
+        """Called before a real execute(): if this kernel's warm-up is
+        mid-flight, wait for it (two concurrent execute() calls on one
+        kernel instance are not guaranteed safe); if it has not started
+        yet, claim it so the warm-up thread skips this kernel."""
+        with self._warm_lock:
+            if self._warm_state == "pending":
+                self._warm_state = "done"
+                self._warm_done.set()
+                return
+            if self._warm_state != "running":
+                return
+        self._warm_done.wait()
+
     def close(self) -> None:
         self.kernel.close()
 
@@ -132,7 +327,8 @@ class KernelInstance:
 class TaskEvaluator:
     def __init__(self, info: A.GraphInfo, profiler: Profiler,
                  devices: Optional[List[Any]] = None,
-                 skip_fetch_resources: bool = False):
+                 skip_fetch_resources: bool = False,
+                 precompile: Optional[Tuple[int, int, int]] = None):
         self.info = info
         self.profiler = profiler
         if devices is None:
@@ -159,6 +355,39 @@ class TaskEvaluator:
                 self.kernels[n.id] = ki
         for ki in self.kernels.values():
             ki.setup(fetch=not skip_fetch_resources)
+        # bucket-ladder warm-up: compile every device op's ladder shapes
+        # on a background thread so the compiles overlap the first
+        # task's decode instead of stalling its evaluation.  `precompile`
+        # is a (frame_h, frame_w, work_packet_size) hint from the
+        # executor (engine geometry is not knowable from the graph
+        # alone); evaluation threads join per-kernel via ensure_warm().
+        self._precompile_thread: Optional[threading.Thread] = None
+        if precompile is not None and _precompile_enabled() \
+                and _bucketing_enabled():
+            h, w, wp = precompile
+            targets: List[Tuple[KernelInstance, List[int]]] = []
+            for ki in self.kernels.values():
+                n = ki.node
+                if n.effective_device() != DeviceType.TPU \
+                        or n.effective_batch() <= 1 \
+                        or ki.spec.is_stateful or ki.spec.variadic \
+                        or not _source_geometry_inputs(n):
+                    continue
+                # same per-call cap derivation as _run_kernel
+                if n.batch is None and wp:
+                    cap = max(1, min(n.effective_batch(), int(wp)))
+                else:
+                    cap = max(1, n.effective_batch())
+                ki._warm_state = "pending"
+                targets.append((ki, bucket_ladder(cap)))
+            if targets:
+                def warm() -> None:
+                    for ki, ladder in targets:
+                        ki.precompile(ladder, h, w)
+
+                self._precompile_thread = threading.Thread(
+                    target=warm, name="precompile", daemon=True)
+                self._precompile_thread.start()
 
     def close(self) -> None:
         for ki in self.kernels.values():
@@ -287,6 +516,18 @@ class TaskEvaluator:
         else:
             batch = max(1, n.effective_batch())
 
+        # Shape-stable dispatch: device-placed batched kernels wrap
+        # jitted functions that compile one executable per (shape,
+        # dtype), on ANY backend — so their calls are rounded up to a
+        # small bucket ladder (pad by edge-repeating the last row, slice
+        # the padding off after).  Host/python kernels keep exact shapes
+        # (retracing is free), and so do stateful kernels: padding rows
+        # would advance their state past the real stream position.
+        use_buckets = (batched_call and not n.spec.is_stateful
+                       and n.effective_device() == DeviceType.TPU
+                       and _bucketing_enabled())
+        ladder = bucket_ladder(batch) if use_buckets else None
+
         # Device staging: a device kernel gets its inputs moved host->device
         # ONCE per task column (async, whole batch); a host kernel gets
         # device inputs fetched once.  Updated in the store so sibling
@@ -342,6 +583,17 @@ class TaskEvaluator:
         for b, pos in zip(in_batches, col_pos):
             if b.nulls is not None:
                 null_in |= b.nulls[pos].any(axis=1)
+
+        # Under bucketed dispatch a sparse null must not shrink the call
+        # shape (every distinct "live subset" size would mint an
+        # executable): run the FULL chunk and overwrite dead rows with
+        # NullElement afterward.  Safe only when every nulled input is
+        # array data (null positions hold valid zero rows); an object
+        # column holds NullElement objects the kernel would choke on, so
+        # those rare chunks call on the live subset — still padded up to
+        # a bucket below, so shapes stay ladder-bounded either way.
+        mask_nulls = use_buckets and all(
+            b.nulls is None or is_array_data(b.data) for b in in_batches)
 
         # contiguous runs of compute rows; reset state between runs
         run_bounds: List[Tuple[int, int]] = []
@@ -431,6 +683,7 @@ class TaskEvaluator:
                         args.append([b.data[int(j)] for j in p[:, 0]])
             return args
 
+        ki.ensure_warm()
         t0 = time.time()
         try:
             with self.profiler.span("evaluate:" + n.name,
@@ -442,24 +695,44 @@ class TaskEvaluator:
                     while i < hi:
                         j = min(i + batch, hi)
                         sel = np.arange(i, j)
-                        live = sel[~null_in[sel]]
                         dead = sel[null_in[sel]]
                         if len(dead):
                             null_rows(compute[dead])
+                        if mask_nulls and len(dead) < len(sel):
+                            # full-chunk call; dead rows' outputs are
+                            # overwritten with nulls at assembly time
+                            live = sel
+                        else:
+                            live = sel[~null_in[sel]]
                         if not len(live):
                             i = j
                             continue
                         if batched_call:
-                            args = call_args_for(live)
-                            # a never-seen arg-shape signature means XLA
-                            # compiles a fresh executable for a jitted
-                            # kernel — surface it live
-                            sig = tuple(tuple(a.shape) if is_array_data(a)
-                                        else len(a) for a in args)
+                            exec_sel, pad = live, 0
+                            if use_buckets:
+                                pad = bucket_for(len(live),
+                                                 ladder) - len(live)
+                                if pad:
+                                    exec_sel = np.concatenate(
+                                        [live,
+                                         np.repeat(live[-1:], pad)])
+                                    _M_OP_PAD_ROWS.labels(
+                                        op=n.name).inc(pad)
+                            args = call_args_for(exec_sel)
+                            # a never-seen arg (shape, dtype) signature
+                            # means XLA compiles a fresh executable for
+                            # a jitted kernel — surface it live
+                            sig = tuple(
+                                (tuple(a.shape), str(a.dtype))
+                                if is_array_data(a) else len(a)
+                                for a in args)
                             if sig not in ki._shape_sigs:
                                 ki._shape_sigs.add(sig)
                                 _M_OP_RECOMPILES.labels(op=n.name).inc()
                             res = ki.kernel.execute(*args)
+                            if pad:
+                                res = _strip_pad(res, len(live),
+                                                 len(out_cols))
                             emit_result(compute[live], res)
                         else:
                             args = call_args_for(live)
@@ -498,11 +771,15 @@ class TaskEvaluator:
                 outputs[col] = ColumnBatch(np.zeros(0, np.int64), [])
                 continue
             if null_set:
-                by_row: Dict[int, Elem] = {int(r): NullElement()
-                                           for r in null_set}
+                by_row: Dict[int, Elem] = {}
                 for p in parts:
                     for r, e in zip(p.rows.tolist(), p.elements()):
                         by_row[r] = e
+                # nulls LAST so they win: bucketed dispatch runs dead
+                # rows through the kernel (full-chunk shape) and their
+                # outputs must be discarded here
+                for r in null_set:
+                    by_row[int(r)] = NullElement()
                 rows_sorted = np.asarray(sorted(by_row), np.int64)
                 outputs[col] = ColumnBatch.from_elements(
                     rows_sorted, [by_row[int(r)] for r in rows_sorted])

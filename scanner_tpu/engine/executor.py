@@ -264,8 +264,15 @@ class LocalExecutor:
             io = max(16, min(512, target // frame_bytes))
 
             def best_work(n: int):
-                """Largest divisor of n in [4, 16] (compute batch floor:
-                 1-row work packets drown in scheduling overhead)."""
+                """Best divisor of n in [4, 16] (compute batch floor:
+                1-row work packets drown in scheduling overhead).
+                Powers of two are preferred so steady-state work packets
+                land exactly on a bucket of the shape-stable kernel
+                dispatch (engine/evaluate.py bucket_ladder) — a full
+                chunk then never pads."""
+                for w in (16, 8, 4):
+                    if n % w == 0:
+                        return w
                 for w in range(min(16, n), 3, -1):
                     if n % w == 0:
                         return w
@@ -286,7 +293,10 @@ class LocalExecutor:
                 if w is not None:
                     io, work = snapped, w
             if work is None:
+                # round down to a power of two: the work packet is the
+                # kernel call shape, and a pow2 packet is its own bucket
                 work = max(4, min(16, io // 4))
+                work = 1 << (int(work).bit_length() - 1)
                 io = (io // work) * work
             perf.io_packet_size = int(io)
             perf.work_packet_size = int(work)
@@ -475,7 +485,8 @@ class LocalExecutor:
             with device_trace(self.profiler):
                 self._run_pipeline(
                     info, work, show_progress,
-                    queue_size=int(perf.queue_size_per_pipeline))
+                    queue_size=int(perf.queue_size_per_pipeline),
+                    precompile=self.precompile_hint(jobs))
         for job in jobs:
             if job.skipped:
                 continue
@@ -487,9 +498,28 @@ class LocalExecutor:
         self.db.write_megafile()
         return jobs
 
+    @staticmethod
+    def precompile_hint(jobs: List[JobContext]
+                        ) -> Optional[Tuple[int, int, int]]:
+        """(frame_h, frame_w, work_packet_size) for the evaluator's
+        bucket-ladder warm-up (evaluate.py precompile), from the first
+        non-skipped job with a video source — the geometry the device
+        kernels will actually see.  None = nothing to warm."""
+        for job in jobs:
+            if getattr(job, "skipped", False):
+                continue
+            for si in job.source_info.values():
+                vm = si.get("video_meta")
+                if vm is not None and vm.height and vm.width:
+                    wp = int(getattr(job.jr, "work_packet_size", 0) or 0)
+                    return (int(vm.height), int(vm.width), wp)
+        return None
+
     def _run_pipeline(self, info: A.GraphInfo, work: List[TaskItem],
                       show_progress: bool,
-                      queue_size: Optional[int] = None) -> None:
+                      queue_size: Optional[int] = None,
+                      precompile: Optional[Tuple[int, int, int]] = None
+                      ) -> None:
         pending = list(work)
         src_lock = threading.Lock()
 
@@ -498,7 +528,8 @@ class LocalExecutor:
                 return pending.pop(0) if pending else None
 
         done = self.run_pipeline(info, source, show_progress=show_progress,
-                                 total=len(work), queue_size=queue_size)
+                                 total=len(work), queue_size=queue_size,
+                                 precompile=precompile)
         if done != len(work):
             raise JobException(
                 f"pipeline finished {done}/{len(work)} tasks")
@@ -508,7 +539,9 @@ class LocalExecutor:
                      on_task_error=None,
                      evaluator_factory=None, close_evaluators: bool = True,
                      queue_size: Optional[int] = None,
-                     show_progress: bool = False, total: int = 0) -> int:
+                     show_progress: bool = False, total: int = 0,
+                     precompile: Optional[Tuple[int, int, int]] = None
+                     ) -> int:
         """Multi-stage streaming pipeline (reference worker.cpp:1467-1724
         load/evaluate/save stage drivers): N loaders pull TaskItems from
         `source` and decode, P evaluator instances execute, S savers
@@ -541,7 +574,7 @@ class LocalExecutor:
             return self._run_serial(info, source, on_start, on_done,
                                     on_eval_done, on_task_error,
                                     evaluator_factory, close_evaluators,
-                                    show_progress, total)
+                                    show_progress, total, precompile)
         qsize = queue_size or 4
         eval_q: "queue.Queue" = queue.Queue(maxsize=qsize)
         save_q: "queue.Queue" = queue.Queue(maxsize=qsize)
@@ -612,7 +645,8 @@ class LocalExecutor:
             if evaluator_factory is not None:
                 return evaluator_factory(idx, skip_fetch)
             return TaskEvaluator(info, self.profiler,
-                                 skip_fetch_resources=skip_fetch)
+                                 skip_fetch_resources=skip_fetch,
+                                 precompile=precompile)
 
         def evaluator(evaluator_idx: int):
             te = None
@@ -755,7 +789,9 @@ class LocalExecutor:
     def _run_serial(self, info: A.GraphInfo, source, on_start, on_done,
                     on_eval_done, on_task_error, evaluator_factory,
                     close_evaluators: bool, show_progress: bool,
-                    total: int) -> int:
+                    total: int,
+                    precompile: Optional[Tuple[int, int, int]] = None
+                    ) -> int:
         """The NO_PIPELINING path: every stage inline on this thread."""
         import types
         tls = types.SimpleNamespace()
@@ -763,7 +799,7 @@ class LocalExecutor:
         if evaluator_factory is not None:
             te = evaluator_factory(0, False)
         else:
-            te = TaskEvaluator(info, self.profiler)
+            te = TaskEvaluator(info, self.profiler, precompile=precompile)
         done = 0
         try:
             while True:
@@ -1377,9 +1413,10 @@ class LocalExecutor:
     @staticmethod
     def _sink_rows(batch, start: int, end: int) -> List[Any]:
         """Materialize a sink ColumnBatch's rows [start, end) as host
-        elements (one device fetch; array rows become views)."""
-        host = batch.take_rows(np.arange(start, end, dtype=np.int64))
-        return host.elements()
+        elements (one device fetch; array rows become views).  The
+        contiguous range takes ColumnBatch.take_range's direct-slice
+        fast path — no index materialization or positions lookup."""
+        return batch.take_range(start, end).elements()
 
     @staticmethod
     def _is_encodable(rows: List[Any]) -> bool:

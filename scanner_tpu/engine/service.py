@@ -1079,7 +1079,14 @@ class Worker:
                  coordinator=None,
                  metrics_port: Optional[int] = None,
                  metrics_host: str = "0.0.0.0",
-                 advertise_host: Optional[str] = None):
+                 advertise_host: Optional[str] = None,
+                 compilation_cache_dir: Optional[str] = None):
+        # persistent XLA executable cache: a restarted/rescheduled worker
+        # re-loads its jitted kernels' executables instead of recompiling
+        # (falls back to the SCANNER_TPU_COMPILATION_CACHE env var the
+        # deploy manifests set; no-op when neither is configured)
+        from ..util.jaxenv import enable_compilation_cache
+        enable_compilation_cache(compilation_cache_dir)
         if coordinator is not None:
             # join the multi-process JAX runtime BEFORE any backend touch:
             # meshes built by kernels then span all participating hosts
@@ -1337,8 +1344,11 @@ class Worker:
             with self._eval_lock:
                 te = self._evaluators.get(idx)
                 if te is None:
-                    te = TaskEvaluator(self._info, self.profiler,
-                                       skip_fetch_resources=skip_fetch)
+                    te = TaskEvaluator(
+                        self._info, self.profiler,
+                        skip_fetch_resources=skip_fetch,
+                        precompile=LocalExecutor.precompile_hint(
+                            self._jobs or []))
                     self._evaluators[idx] = te
                 return te
 

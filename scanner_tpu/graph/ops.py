@@ -56,6 +56,15 @@ class Kernel:
     def execute(self, *cols, **kwcols):
         raise NotImplementedError
 
+    def precompile_input(self, name: str):
+        """Optional warm-up hook for the engine's bucket-ladder
+        precompile (engine/evaluate.py): return one example row for the
+        non-frame input column `name` (frame columns are synthesized by
+        the engine), or None to opt this op out of generic warm-up.
+        The example only needs the right shape/dtype — warm-up results
+        are discarded."""
+        return None
+
     def close(self) -> None:
         pass
 

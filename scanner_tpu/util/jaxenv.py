@@ -59,6 +59,42 @@ def cpu_only_env(base: Optional[Dict[str, str]] = None,
     return env
 
 
+def enable_compilation_cache(cache_dir: Optional[str] = None
+                             ) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``cache_dir`` so
+    jitted-kernel executables survive process restarts — a worker that
+    restarts (or a new bench/job process on the same host) re-loads its
+    bucket-ladder executables from disk instead of paying seconds of
+    TPU compile per shape.
+
+    Resolution: explicit argument, else the ``SCANNER_TPU_COMPILATION_CACHE``
+    env var (the deploy manifests set it), else the ``[perf]
+    compilation_cache_dir`` config knob via the callers that read config.
+    Empty/unset = no-op (returns None).  The min-size/min-compile-time
+    thresholds are lowered so even small kernel executables are cached
+    (the default skips sub-second compiles — exactly the CPU-backend
+    ones tests exercise).
+    """
+    path = cache_dir or os.environ.get("SCANNER_TPU_COMPILATION_CACHE", "")
+    if not path:
+        return None
+    if "://" not in path:
+        # local path: expand + create.  Remote prefixes (gs://...) go to
+        # JAX verbatim — makedirs on a URL would create a junk local
+        # "gs:/bucket" tree (or crash on a read-only root filesystem)
+        path = os.path.expanduser(path)
+        os.makedirs(path, exist_ok=True)
+    import jax
+    jax.config.update("jax_compilation_cache_dir", path)
+    for knob, val in (("jax_persistent_cache_min_entry_size_bytes", -1),
+                      ("jax_persistent_cache_min_compile_time_secs", 0.0)):
+        try:
+            jax.config.update(knob, val)
+        except (AttributeError, ValueError):
+            pass  # knob not present on this jax version
+    return path
+
+
 def force_cpu_platform(n_devices: Optional[int] = None) -> None:
     """Force THIS process's JAX onto the CPU backend.
 

@@ -246,6 +246,61 @@ def test_deploy_metrics_port_wiring():
     assert "ports" not in wc
 
 
+def test_deploy_compilation_cache_wiring(tmp_path, monkeypatch):
+    """ClusterConfig.compilation_cache_dir threads JAX's persistent
+    compilation cache through the manifests (ConfigMap [perf] section +
+    worker env var) and stays fully absent at the default; the config
+    knob and jaxenv helper resolve the same setting process-side."""
+    from scanner_tpu.deploy import (CloudConfig, Cluster, ClusterConfig,
+                                    MachineType)
+
+    def manifests(cache):
+        cfg = ClusterConfig(id="sc", num_workers=2,
+                            worker=MachineType(tpu_type="v5litepod-4"),
+                            compilation_cache_dir=cache)
+        return {(m["kind"], m["metadata"]["name"]): m
+                for m in Cluster(CloudConfig(project="p"), cfg).manifests()}
+
+    on = manifests("gs://bkt/xla-cache")
+    toml = on[("ConfigMap", "sc-config")]["data"]["scanner_tpu.toml"]
+    assert "[perf]" in toml
+    assert 'compilation_cache_dir = "gs://bkt/xla-cache"' in toml
+    wc = on[("StatefulSet", "sc-worker")]["spec"]["template"]["spec"][
+        "containers"][0]
+    assert {"name": "SCANNER_TPU_COMPILATION_CACHE",
+            "value": "gs://bkt/xla-cache"} in wc["env"]
+
+    off = manifests("")
+    assert "[perf]" not in off[("ConfigMap", "sc-config")]["data"][
+        "scanner_tpu.toml"]
+    wc = off[("StatefulSet", "sc-worker")]["spec"]["template"]["spec"][
+        "containers"][0]
+    assert not any(e.get("name") == "SCANNER_TPU_COMPILATION_CACHE"
+                   for e in wc["env"])
+
+    # config knob -> Config property
+    from scanner_tpu.config import Config, dump_toml
+    p = tmp_path / "cfg.toml"
+    p.write_text(dump_toml(
+        {"perf": {"compilation_cache_dir": str(tmp_path / "cc")}}))
+    assert Config(str(p)).compilation_cache_dir == str(tmp_path / "cc")
+    p.write_text(dump_toml({"perf": {"compilation_cache_dir": ""}}))
+    assert Config(str(p)).compilation_cache_dir is None
+
+    # jaxenv helper: env-var fallback, creates the dir, points jax at it
+    import jax
+
+    from scanner_tpu.util.jaxenv import enable_compilation_cache
+    monkeypatch.delenv("SCANNER_TPU_COMPILATION_CACHE", raising=False)
+    assert enable_compilation_cache(None) is None  # unset = no-op
+    cache = tmp_path / "xla"
+    monkeypatch.setenv("SCANNER_TPU_COMPILATION_CACHE", str(cache))
+    assert enable_compilation_cache(None) == str(cache)
+    assert cache.is_dir()
+    assert jax.config.jax_compilation_cache_dir == str(cache)
+    jax.config.update("jax_compilation_cache_dir", None)  # detach again
+
+
 def test_deploy_gcloud_commands():
     from scanner_tpu.deploy import (CloudConfig, Cluster, ClusterConfig,
                                     MachineType)

@@ -229,6 +229,13 @@ def test_registered_series_names_lint():
         if m.kind == "counter":
             assert m.name.endswith("_total"), \
                 f"counter {m.name} should end _total"
+    # the shape-stability series (docs/observability.md catalog) must
+    # exist: padding waste and ladder-precompile time ride alongside the
+    # recompile proxy
+    names = {m.name for m in metrics}
+    assert {"scanner_tpu_op_recompiles_total",
+            "scanner_tpu_op_pad_rows_total",
+            "scanner_tpu_op_precompile_seconds"} <= names
 
 
 # ---------------------------------------------------------------------------
