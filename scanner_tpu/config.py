@@ -45,6 +45,14 @@ def default_config() -> Dict[str, Any]:
             # per process.
             "compilation_cache_dir": "",
         },
+        "faults": {
+            # deterministic fault-injection plan (docs/robustness.md for
+            # the clause syntax; util/faults.py implements it).  "" (the
+            # default) disarms every injection site; the
+            # SCANNER_TPU_FAULTS env var overrides per process.  NEVER
+            # set in production config — this exists for chaos testing.
+            "plan": "",
+        },
     }
 
 
@@ -107,6 +115,13 @@ class Config:
         disabled (the default)."""
         d = self.config.get("perf", {}).get("compilation_cache_dir", "")
         return d or None
+
+    @property
+    def faults_plan(self) -> Optional[str]:
+        """Armed fault-injection plan spec, or None (the default: all
+        injection sites disabled, zero overhead)."""
+        plan = self.config.get("faults", {}).get("plan", "")
+        return plan or None
 
     @property
     def metrics_port(self) -> Optional[int]:

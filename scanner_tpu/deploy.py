@@ -154,6 +154,13 @@ class ClusterConfig:
     # toml ([perf] section) and each worker's
     # SCANNER_TPU_COMPILATION_CACHE env var.
     compilation_cache_dir: str = ""
+    # seconds kubernetes waits between SIGTERM and SIGKILL on worker
+    # pods.  start_worker maps SIGTERM to drain mode (finish in-flight
+    # tasks, stop pulling, deregister — engine/service.py
+    # Worker.drain), so size this to cover the longest task plus its
+    # save; a too-small value turns every rolling update into a crash
+    # the stale scan must clean up.
+    termination_grace_period: int = 120
 
     def price_per_hour(self) -> float:
         return (self.master_cpus * CPU_PRICE_PER_CORE
@@ -382,6 +389,10 @@ def _worker_statefulset(cfg: ClusterConfig, name: str, replicas: int,
                                         "sts": name}},
                 "spec": {
                     "nodeSelector": node_selector,
+                    # SIGTERM -> Worker.drain; give in-flight tasks this
+                    # long to finish before the SIGKILL follow-up
+                    "terminationGracePeriodSeconds":
+                        cfg.termination_grace_period,
                     "containers": [{
                         "name": "worker", "image": cfg.image,
                         "command": command,

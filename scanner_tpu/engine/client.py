@@ -102,6 +102,12 @@ class Client:
             if compilation_cache_dir is None \
                     and not os.environ.get("SCANNER_TPU_COMPILATION_CACHE"):
                 compilation_cache_dir = cfg.compilation_cache_dir
+            # [faults] plan arms the chaos-injection registry for this
+            # process (env var SCANNER_TPU_FAULTS, read at import time,
+            # wins — it is the per-process override)
+            if cfg.faults_plan and not os.environ.get("SCANNER_TPU_FAULTS"):
+                from ..util import faults
+                faults.install(cfg.faults_plan)
         # persistent XLA executable cache (arg > SCANNER_TPU_COMPILATION_CACHE
         # env > [perf] compilation_cache_dir config; unset = no-op): in-process
         # jobs re-load jitted kernel executables across runs (PERF.md §5)
@@ -168,6 +174,16 @@ class Client:
             self._metrics_server = None
 
     # -- live telemetry -----------------------------------------------------
+
+    def job_status(self, bulk_id: Optional[int] = None) -> Dict[str, Any]:
+        """Cluster job status (GetJobStatus): live progress of the given
+        (default: active) bulk, plus `num_workers` even when no bulk is
+        active — lets tooling wait for worker registration.  Cluster
+        mode only."""
+        if self._cluster is None:
+            raise ScannerException(
+                "job_status requires cluster mode (Client(master=...))")
+        return self._cluster.job_status(bulk_id)
 
     def metrics(self) -> Dict[str, Any]:
         """Live metrics snapshot.  Cluster mode: the master's aggregated

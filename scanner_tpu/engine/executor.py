@@ -34,6 +34,7 @@ from ..storage import Database
 from ..storage import items as IT
 from ..storage import metadata as md
 from ..storage.streams import NamedVideoStream, StoredStream
+from ..util import faults as _faults
 from ..util import metrics as _mx
 from ..util.log import get_logger
 from ..util.profiler import Profiler
@@ -1013,6 +1014,9 @@ class LocalExecutor:
         """Execute (plan, elements) chunks from any iterator; merge
         per-sink results in row order (shared by the threaded queue
         consumer and the serial NO_PIPELINING path)."""
+        if _faults.ACTIVE:
+            _faults.inject("pipeline.eval",
+                           detail=f"task={w.job.job_idx},{w.task_idx}")
         parts: Dict[int, List[ColumnBatch]] = {}
         n = 0
         for plan, elements in chunk_iter:
@@ -1085,6 +1089,9 @@ class LocalExecutor:
         broke — reordering, failed predecessor, different instance)
         re-derive the self-contained plan, reload its sources, and run
         again.  Affinity is an optimization only."""
+        if _faults.ACTIVE:
+            _faults.inject("pipeline.eval",
+                           detail=f"task={w.job.job_idx},{w.task_idx}")
         from .evaluate import StateCarryMiss
         try:
             return te.execute_task(w.job.jr, w.plan, w.elements)
@@ -1112,6 +1119,9 @@ class LocalExecutor:
         return out
 
     def _load_task(self, info: A.GraphInfo, w: TaskItem, tls) -> TaskItem:
+        if _faults.ACTIVE:
+            _faults.inject("pipeline.decode",
+                           detail=f"task={w.job.job_idx},{w.task_idx}")
         with self.profiler.span("load", level=0, task=w.task_idx,
                                 job=w.job.job_idx):
             chain = self._chains.get(w.job.job_idx)
@@ -1339,6 +1349,9 @@ class LocalExecutor:
     def _save_task(self, info: A.GraphInfo, w: TaskItem) -> None:
         """Encode + write one item per sink (reference save_worker.cpp +
         PostEvaluateWorker video encode, evaluate_worker.cpp:1373-1560)."""
+        if _faults.ACTIVE:
+            _faults.inject("pipeline.save",
+                           detail=f"task={w.job.job_idx},{w.task_idx}")
         start, end = w.output_range
         for sink in info.sinks:
             if sink.id in w.job.custom_sinks:

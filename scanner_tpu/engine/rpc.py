@@ -19,12 +19,26 @@ import grpc
 
 from ..common import ScannerException
 from ..storage.metadata import pack, unpack
+from ..util import faults as _faults
 from ..util import metrics as _mx
+from ..util.log import get_logger
 from ..util.retry import call_with_backoff
+
+_log = get_logger("rpc")
 
 GRPC_OPTIONS = [
     ("grpc.max_send_message_length", 1 << 30),
     ("grpc.max_receive_message_length", 1 << 30),
+    # cap the CHANNEL-level reconnect backoff (gRPC default maxes at
+    # 120s): a client whose peer is down for a while — a worker riding
+    # out a master restart, wait_for_server polling a still-booting
+    # server — would otherwise accumulate minutes of redial delay and
+    # stay UNAVAILABLE long after the peer is actually back.  Our own
+    # call-level full-jitter backoff handles politeness; the channel
+    # just needs to redial promptly.
+    ("grpc.initial_reconnect_backoff_ms", 100),
+    ("grpc.min_reconnect_backoff_ms", 100),
+    ("grpc.max_reconnect_backoff_ms", 2000),
 ]
 
 # server-side handler latency (includes msgpack (de)serialization, not
@@ -59,8 +73,14 @@ class _GenericService(grpc.GenericRpcHandler):
         def unary(request: bytes, context) -> bytes:
             t0 = time.time()
             try:
+                if _faults.ACTIVE:
+                    _faults.inject("rpc.server.handle", detail=short_name)
                 return pack(method(unpack(request)))
             except Exception as e:  # noqa: BLE001
+                # the server-side stack would otherwise be discarded:
+                # only "type: msg" crosses the wire in the INTERNAL
+                # status, which is useless for debugging a handler bug
+                _log.exception("RPC %s failed server-side", short_name)
                 context.set_code(grpc.StatusCode.INTERNAL)
                 context.set_details(f"{type(e).__name__}: {e}")
                 return b""
@@ -127,9 +147,18 @@ class RpcClient:
             request_serializer=lambda x: x,
             response_deserializer=lambda x: x)
         req = pack(payload)
+
+        def attempt():
+            # chaos hook fires per ATTEMPT (inside the backoff loop): an
+            # injected UNAVAILABLE storm exercises the same retry path a
+            # flapping network would
+            if _faults.ACTIVE:
+                _faults.inject("rpc.client.call", detail=method)
+            return fn(req, timeout=timeout or self._timeout)
+
         try:
             raw = call_with_backoff(
-                lambda: fn(req, timeout=timeout or self._timeout),
+                attempt,
                 is_transient=self._transient,
                 retries=self._retries if retries is None else retries,
                 base=self._backoff_base, cap=self._backoff_cap,
@@ -165,15 +194,20 @@ class RpcClient:
 
 def wait_for_server(address: str, service: str, method: str = "Ping",
                     timeout: float = 10.0) -> None:
-    c = RpcClient(address, service, timeout=2.0)
     deadline = time.time() + timeout
-    try:
-        while time.time() < deadline:
-            # no per-call retries: this loop IS the retry policy
+    while time.time() < deadline:
+        # a FRESH channel per attempt: a channel first dialed while the
+        # server was not yet listening can wedge in connection-refused
+        # long after the server is up (observed under sandboxed network
+        # stacks, where the reconnect path keeps failing while a new
+        # channel connects instantly).  This loop is the retry policy,
+        # so per-call retries stay off.
+        c = RpcClient(address, service, timeout=2.0)
+        try:
             if c.try_call(method, retries=0) is not None:
                 return
-            time.sleep(0.1)
-        raise RpcError(f"{service} at {address} not reachable "
-                       f"after {timeout}s")
-    finally:
-        c.close()
+        finally:
+            c.close()
+        time.sleep(0.25)
+    raise RpcError(f"{service} at {address} not reachable "
+                   f"after {timeout}s")

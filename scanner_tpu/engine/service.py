@@ -31,6 +31,7 @@ import cloudpickle
 from ..common import CacheMode, JobException, PerfParams, ScannerException
 from ..storage import Database, make_storage
 from ..storage import metadata as md
+from ..util import faults as _faults
 from ..util import metrics as _mx
 from ..util.log import get_logger
 from ..util.metrics import MetricsServer, merge_snapshots
@@ -40,8 +41,21 @@ from .evaluate import TaskEvaluator
 from .executor import LocalExecutor, TaskItem
 
 PING_INTERVAL = 1.0          # worker heartbeat period
+# per-call deadline for heartbeat/ping RPCs.  Deliberately ~2x the ping
+# period instead of the 30s client default: a HUNG (accepting but not
+# answering) master would otherwise pin the worker's heartbeat thread
+# for 30s per call — long past WORKER_STALE_AFTER — and a healthy
+# worker would be removed as stale purely because its liveness reports
+# were stuck behind a slow peer.
+PING_TIMEOUT = 2 * PING_INTERVAL
 WORKER_STALE_AFTER = 6.0     # master: no heartbeat -> worker removed
 MAX_TASK_FAILURES = 3        # reference master.cpp:2131 blacklist threshold
+# transient (storage/RPC) task failures requeue WITHOUT counting a
+# blacklist strike — a flaky dependency must not blacklist a healthy
+# job.  But "transient" failures that never stop are not transient:
+# past this many per task, they start counting strikes like any other
+# failure so a dead storage backend still terminates the bulk.
+MAX_TRANSIENT_FAILURES = 25
 MASTER_SERVICE = "scanner.Master"
 WORKER_SERVICE = "scanner.Worker"
 
@@ -76,9 +90,31 @@ _M_REVOCATIONS = _mx.registry().counter(
 _M_STRIKES = _mx.registry().counter(
     "scanner_tpu_blacklist_strikes_total",
     "Task failures counted toward a job's blacklist threshold.")
+_M_TRANSIENT = _mx.registry().counter(
+    "scanner_tpu_transient_retries_total",
+    "Worker-reported transient (storage/RPC) task failures requeued "
+    "without a blacklist strike.")
+_M_DRAINS = _mx.registry().counter(
+    "scanner_tpu_worker_drains_total",
+    "Workers that deregistered via SIGTERM drain (finish in-flight "
+    "tasks, stop pulling, UnregisterWorker).")
 _M_JOBS_BLACKLISTED = _mx.registry().counter(
     "scanner_tpu_jobs_blacklisted_total",
     "Jobs removed from their bulk after repeated task failures.")
+
+
+def _is_transient_failure(exc: BaseException) -> bool:
+    """Failures caused by the environment rather than the task itself —
+    storage errors (including crc-detected item corruption), RPC/
+    transport errors, timeouts.  The worker tags FailedWork with this so
+    the master requeues without a blacklist strike: a flaky dependency
+    must not blacklist a healthy job, while a deterministic kernel bug
+    still strikes out after MAX_TASK_FAILURES."""
+    import grpc
+
+    from ..common import StorageException
+    return isinstance(exc, (StorageException, rpc.RpcError, grpc.RpcError,
+                            ConnectionError, TimeoutError))
 
 
 # ---------------------------------------------------------------------------
@@ -148,6 +184,10 @@ class _BulkJob:
     # lock, not O(total_tasks)
     job_done: Dict[int, int] = field(default_factory=dict)
     failures: Dict[Tuple[int, int], int] = field(default_factory=dict)
+    # transient (storage/RPC) failures per task: requeued strike-free up
+    # to MAX_TRANSIENT_FAILURES, then they fall through to `failures`
+    transient_failures: Dict[Tuple[int, int], int] = \
+        field(default_factory=dict)
     blacklisted_jobs: Set[int] = field(default_factory=set)
     total_tasks: int = 0
     # counters so the finish check is O(1) per FinishedWork (a set
@@ -263,6 +303,7 @@ class Master:
         self._server = rpc.RpcServer(MASTER_SERVICE, {
             "Ping": self._rpc_ping,
             "RegisterWorker": self._rpc_register_worker,
+            "UnregisterWorker": self._rpc_unregister_worker,
             "Heartbeat": self._rpc_heartbeat,
             "NewJob": self._rpc_new_job,
             "GetJob": self._rpc_get_job,
@@ -305,6 +346,21 @@ class Master:
                 wid, req.get("address", ""), time.time())
         _mlog.info("worker %d registered (%s)", wid, req.get("address", ""))
         return {"worker_id": wid}
+
+    def _rpc_unregister_worker(self, req: dict) -> dict:
+        """Graceful worker departure (SIGTERM drain): deactivate NOW
+        instead of waiting WORKER_STALE_AFTER for the stale scan, and
+        requeue anything it still held (a drained worker finished its
+        in-flight tasks first, so normally nothing)."""
+        wid = req.get("worker_id")
+        with self._lock:
+            w = self._workers.get(wid)
+            if w is not None and w.active:
+                w.active = False
+                self._requeue_worker_tasks(wid)
+                _M_DRAINS.inc()
+                _mlog.info("worker %d deregistered (drain)", wid)
+        return {"ok": True}
 
     def _rpc_heartbeat(self, req: dict) -> dict:
         wid = req["worker_id"]
@@ -574,6 +630,22 @@ class Master:
             self._unassign(bulk, key)
             if key in bulk.done:
                 return {"ok": True}
+            if req.get("transient"):
+                tn = bulk.transient_failures.get(key, 0) + 1
+                bulk.transient_failures[key] = tn
+                if tn <= MAX_TRANSIENT_FAILURES:
+                    _M_TRANSIENT.inc()
+                    _M_TASK_RETRIES.inc()
+                    _mlog.warning(
+                        "task (%d,%d) transient failure on worker %d "
+                        "(%d/%d before strikes begin): %s — requeued "
+                        "without a blacklist strike", key[0], key[1],
+                        req.get("worker_id", -1), tn,
+                        MAX_TRANSIENT_FAILURES, err)
+                    bulk.q_push(key, front=True)
+                    return {"ok": True}
+                # a "transient" failure that never stops isn't: fall
+                # through and strike like a deterministic one
             n = bulk.failures.get(key, 0) + 1
             bulk.failures[key] = n
             _M_STRIKES.inc()
@@ -643,7 +715,13 @@ class Master:
             bulk = self._history.get(req["bulk_id"]) \
                 if req.get("bulk_id") is not None else self._bulk
             if bulk is None:
-                return {"error": "no such bulk job"}
+                # still report cluster liveness: lets tooling (e.g.
+                # tools/chaos_run.py) wait for workers to register
+                # before submitting anything
+                return {"error": "no such bulk job",
+                        "num_workers": sum(
+                            1 for w in self._workers.values()
+                            if w.active)}
             return self._job_status_locked(bulk)
 
     def _statusz(self) -> dict:
@@ -1094,10 +1172,11 @@ class Worker:
             from ..parallel.distributed import initialize
             initialize(coordinator)
         self.db = Database(make_storage(storage_type, db_path=db_path))
-        self.master = rpc.RpcClient(master_address, MASTER_SERVICE,
-                                    timeout=10.0)
         self.profiler = Profiler(node="worker")
         self._shutdown = threading.Event()
+        # SIGTERM drain mode (start_worker wires the signal): stop
+        # pulling, finish in-flight tasks, deregister, then shut down
+        self._draining = threading.Event()
         self._server = rpc.RpcServer(WORKER_SERVICE, {
             "Ping": lambda req: {"ok": True},
             # serves the master's cluster-wide metrics aggregation
@@ -1118,6 +1197,13 @@ class Worker:
                                       pipeline_instances=pipeline_instances,
                                       decoder_threads=decoder_threads)
         rpc.wait_for_server(master_address, MASTER_SERVICE)
+        # dial the master only AFTER it provably listens: a gRPC channel
+        # first dialed against a not-yet-listening address can wedge in
+        # connection-refused on some network stacks (see
+        # rpc.wait_for_server), and this channel lives for the worker's
+        # whole life
+        self.master = rpc.RpcClient(master_address, MASTER_SERVICE,
+                                    timeout=10.0)
         # the address other processes can dial THIS worker at (the
         # master's GetMetrics aggregation uses it).  localhost is right
         # for single-host clusters and tests; multi-host deployments
@@ -1150,14 +1236,29 @@ class Worker:
 
     def _heartbeat_loop(self) -> None:
         while not self._shutdown.is_set():
-            hb = self.master.try_call("Heartbeat", worker_id=self.worker_id)
+            try:
+                if _faults.ACTIVE:
+                    _faults.inject("worker.heartbeat",
+                                   detail=str(self.worker_id))
+            except Exception:  # noqa: BLE001 — injected fault: this
+                time.sleep(PING_INTERVAL)  # beat is dropped, loop lives
+                continue
+            # short per-call deadline (PING_TIMEOUT, ~2x the ping
+            # period) instead of the 30s client default: a hung master
+            # must cost one missed beat, not pin this thread long
+            # enough for the stale scan to remove a healthy worker
+            hb = self.master.try_call("Heartbeat", worker_id=self.worker_id,
+                                      timeout=PING_TIMEOUT)
             if hb is not None:
                 if hb.get("reregister"):
-                    reg = self.master.try_call(
-                        "RegisterWorker",
-                        address=self.advertise_address)
-                    if reg:
-                        self.worker_id = reg["worker_id"]
+                    # don't rejoin a cluster we are leaving
+                    if not self._draining.is_set():
+                        reg = self.master.try_call(
+                            "RegisterWorker",
+                            address=self.advertise_address,
+                            timeout=PING_TIMEOUT)
+                        if reg:
+                            self.worker_id = reg["worker_id"]
                 else:
                     self._hb_reply = hb
             time.sleep(PING_INTERVAL)
@@ -1166,13 +1267,40 @@ class Worker:
         self._shutdown.set()
         return {"ok": True}
 
+    def drain(self) -> None:
+        """Begin SIGTERM drain: the pull loop stops taking new tasks,
+        in-flight tasks run to completion (and report FinishedWork),
+        then the worker deregisters and shuts down.  Size the pod's
+        terminationGracePeriod (deploy.py) to cover the longest task."""
+        if self._draining.is_set():
+            return
+        _wlog.info("worker %d: drain requested (SIGTERM) — finishing "
+                   "in-flight tasks, no new pulls", self.worker_id)
+        self._draining.set()
+
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def _finish_drain(self) -> None:
+        """In-flight work is done: leave the cluster cleanly.  The
+        explicit UnregisterWorker makes the master requeue-check and
+        deactivate immediately instead of burning WORKER_STALE_AFTER
+        on the stale scan."""
+        self.master.try_call("UnregisterWorker", worker_id=self.worker_id,
+                             timeout=PING_TIMEOUT)
+        _wlog.info("worker %d: drain complete, deregistered",
+                   self.worker_id)
+        self._shutdown.set()
+
     def _statusz(self) -> dict:
         # getattr guards: the endpoint is live before __init__ finishes
         ex = getattr(self, "executor", None)
+        master = getattr(self, "master", None)
         return {
             "role": "worker",
             "worker_id": getattr(self, "worker_id", None),
-            "master": self.master.address,
+            "master": master.address if master else None,
+            "draining": self._draining.is_set(),
             "bulk_id": getattr(self, "_bulk_id", None),
             "pipeline_instances": ex.pipeline_instances if ex else None,
             "num_load_workers": ex.num_load_workers if ex else None,
@@ -1183,6 +1311,11 @@ class Worker:
 
     def _work_loop(self) -> None:
         while not self._shutdown.is_set():
+            if self._draining.is_set():
+                # _pull_loop (if any was running) returned after its
+                # in-flight tasks finished: deregister and stop
+                self._finish_drain()
+                break
             bulk_id = self._hb_reply.get("active_bulk")
             if bulk_id is None:
                 time.sleep(PING_INTERVAL / 4)
@@ -1255,6 +1388,8 @@ class Worker:
     def _pull_next(self, bulk_id: int):
         """Ask the master for one task; returns TaskItem, 'wait', None
         (bulk over), or ('task_error', j, t, exc)."""
+        if self._draining.is_set():
+            return None  # drain: stop pulling, let the pipeline empty
         if self._hb_reply.get("active_bulk") != bulk_id:
             return None
         # the window covers the load+evaluate stages only: save-parked
@@ -1298,6 +1433,7 @@ class Worker:
                     "FailedWork", bulk_id=bulk_id,
                     worker_id=self.worker_id, job_idx=j, task_idx=t,
                     attempt=attempt,
+                    transient=_is_transient_failure(exc),
                     error=f"{type(exc).__name__}: {exc}")
                 return "wait"
             return nxt
@@ -1337,6 +1473,8 @@ class Worker:
                 "FailedWork", bulk_id=bulk_id, worker_id=self.worker_id,
                 job_idx=w.job.job_idx, task_idx=w.task_idx,
                 attempt=w.attempt,
+                # storage/RPC failures requeue strike-free on the master
+                transient=_is_transient_failure(exc),
                 error=f"{type(exc).__name__}: {exc}")
             return True  # keep the pipeline running
 
@@ -1501,5 +1639,18 @@ def start_worker(master_address: str, db_path: str, port: int = 0,
                  block: bool = False, **kw) -> Worker:
     w = Worker(master_address, db_path=db_path, port=port, **kw)
     if block:
+        # SIGTERM = drain (kubernetes pod termination, deploy.py sizes
+        # terminationGracePeriod for it): finish in-flight tasks, stop
+        # pulling, deregister — then wait_for_shutdown returns and the
+        # process exits 0 instead of dying mid-task
+        import signal
+
+        def _sigterm(_signum, _frame):
+            w.drain()
+
+        try:
+            signal.signal(signal.SIGTERM, _sigterm)
+        except ValueError:
+            pass  # not the main thread: the embedder owns signals
         w.wait_for_shutdown()
     return w

@@ -14,6 +14,7 @@ import threading
 from typing import Dict, Iterator, List, Optional
 
 from ..common import StorageException
+from ..util import faults as _faults
 
 
 class StorageBackend:
@@ -79,19 +80,27 @@ class PosixStorage(StorageBackend):
     def read(self, path: str) -> bytes:
         try:
             with open(self._abs(path), "rb") as f:
-                return f.read()
+                data = f.read()
         except FileNotFoundError as e:
             raise StorageException(f"not found: {path}") from e
+        if _faults.ACTIVE:
+            data = _faults.inject("storage.read", data, detail=path)
+        return data
 
     def read_range(self, path: str, offset: int, size: int) -> bytes:
         try:
             with open(self._abs(path), "rb") as f:
                 f.seek(offset)
-                return f.read(size)
+                data = f.read(size)
         except FileNotFoundError as e:
             raise StorageException(f"not found: {path}") from e
+        if _faults.ACTIVE:
+            data = _faults.inject("storage.read", data, detail=path)
+        return data
 
     def write(self, path: str, data: bytes) -> None:
+        if _faults.ACTIVE:
+            _faults.inject("storage.write", detail=path)
         p = self._abs(path)
         os.makedirs(os.path.dirname(p), exist_ok=True)
         tmp = p + f".tmp.{os.getpid()}.{threading.get_ident()}"
@@ -102,6 +111,8 @@ class PosixStorage(StorageBackend):
         os.replace(tmp, p)
 
     def write_exclusive(self, path: str, data: bytes) -> bool:
+        if _faults.ACTIVE:
+            _faults.inject("storage.write", detail=path)
         p = self._abs(path)
         os.makedirs(os.path.dirname(p), exist_ok=True)
         # write a private tmp first, then link() it into place: the blob
@@ -185,16 +196,23 @@ class MemoryStorage(StorageBackend):
         with self._lock:
             if path not in self._blobs:
                 raise StorageException(f"not found: {path}")
-            return self._blobs[path]
+            data = self._blobs[path]
+        if _faults.ACTIVE:
+            data = _faults.inject("storage.read", data, detail=path)
+        return data
 
     def read_range(self, path: str, offset: int, size: int) -> bytes:
         return self.read(path)[offset:offset + size]
 
     def write(self, path: str, data: bytes) -> None:
+        if _faults.ACTIVE:
+            _faults.inject("storage.write", detail=path)
         with self._lock:
             self._blobs[path] = bytes(data)
 
     def write_exclusive(self, path: str, data: bytes) -> bool:
+        if _faults.ACTIVE:
+            _faults.inject("storage.write", detail=path)
         with self._lock:
             if path in self._blobs:
                 return False

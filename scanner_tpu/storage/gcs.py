@@ -20,6 +20,7 @@ from __future__ import annotations
 from typing import List, Optional
 
 from ..common import StorageException
+from ..util import faults as _faults
 from ..util.retry import call_with_backoff
 from .backend import StorageBackend
 
@@ -109,8 +110,16 @@ class GcsStorage(StorageBackend):
         and the final give-up logs at WARNING with the accumulated wait
         (util/retry.py) — a throttled bucket is visible live, not only
         as mysteriously slow tasks."""
+
+        def attempt():
+            # chaos hook fires per ATTEMPT (inside the backoff loop), so
+            # an injected transient error exercises this retry path
+            if _faults.ACTIVE:
+                _faults.inject("gcs.request")
+            return fn()
+
         return call_with_backoff(
-            fn, is_transient=_transient, retries=self._retries,
+            attempt, is_transient=_transient, retries=self._retries,
             base=self._backoff_base, cap=self._backoff_cap, label="gcs")
 
     # -- reads ----------------------------------------------------------

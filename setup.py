@@ -27,6 +27,10 @@ setup(
     install_requires=[
         "jax", "flax", "optax", "numpy", "msgpack", "cloudpickle",
         "grpcio",
+        # item-file integrity: crc32c checksums (storage/items.py).
+        # Load-bearing — without it writers fall back to zlib.crc32
+        # (format version 3) and readers skip crc32c verification.
+        "google-crc32c",
         # config.py falls back to tomli where stdlib tomllib is absent
         'tomli; python_version < "3.11"',
     ],

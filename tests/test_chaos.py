@@ -1,0 +1,793 @@
+"""Chaos suite: the cluster's robustness claims exercised under real,
+deterministically injected faults (scanner_tpu/util/faults.py; see
+docs/robustness.md for the failure model and recovery matrix).
+
+Every test asserts two things the reference's fault suite
+(py_test.py:788-1121) only implied:
+
+  1. the fault actually FIRED — via the in-process rule counters /
+     `scanner_tpu_faults_injected_total` (or the injected-crash exit
+     code for dead processes), so no test passes vacuously;
+  2. the job's output is bit-exact to a fault-free run — exactly-once,
+     no duplicate or missing rows.
+
+Fast deterministic tests run in tier-1 under the `chaos` marker; full
+spawned-cluster runs (process crash, master restart, SIGTERM drain)
+are additionally marked `slow`.
+"""
+
+import os
+import signal
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import cloudpickle
+import pytest
+
+from scanner_tpu import (CacheMode, Client, Kernel, NamedStream,
+                         PerfParams, register_op)
+from scanner_tpu.common import NullElement, StorageException
+from scanner_tpu.engine.service import (MAX_TASK_FAILURES,
+                                        MAX_TRANSIENT_FAILURES,
+                                        PING_TIMEOUT, Master, Worker,
+                                        _BulkJob, _is_transient_failure)
+from scanner_tpu.storage import items
+from scanner_tpu.storage import metadata as smd
+from scanner_tpu.storage.backend import MemoryStorage
+from scanner_tpu.util import faults
+from scanner_tpu.util import metrics as _mx
+
+# test kernels travel to worker subprocesses inside the job spec
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+pytestmark = pytest.mark.chaos
+
+N_ROWS = 24
+
+
+def _pk(v: int) -> bytes:
+    return struct.pack("<q", v)
+
+
+@register_op(name="ChaosDouble")
+class ChaosDouble(Kernel):
+    def execute(self, x: bytes) -> bytes:
+        return _pk(2 * struct.unpack("<q", x)[0])
+
+
+@register_op(name="ChaosSlowDouble")
+class ChaosSlowDouble(Kernel):
+    def execute(self, x: bytes) -> bytes:
+        time.sleep(0.15)
+        return _pk(2 * struct.unpack("<q", x)[0])
+
+
+@register_op(name="ChaosRowLog")
+class ChaosRowLog(Kernel):
+    """Doubles the packed int AND appends it to a shared log file, so
+    restart tests can assert exactly which rows were (re)executed."""
+
+    def __init__(self, config, log_path: str = ""):
+        super().__init__(config)
+        self._log = log_path
+
+    def execute(self, x: bytes) -> bytes:
+        v = struct.unpack("<q", x)[0]
+        time.sleep(0.1)
+        with open(self._log, "a") as fh:
+            fh.write(f"{v}\n")
+        return _pk(2 * v)
+
+
+EXPECT = [_pk(2 * (100 + i)) for i in range(N_ROWS)]
+
+
+def _counter(name: str, **labels) -> float:
+    """Current value of one series in the process-wide registry."""
+    entry = _mx.registry().snapshot().get(name, {})
+    for s in entry.get("samples", []):
+        if s["labels"] == labels:
+            return s["value"]
+    return 0.0
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture()
+def chaos_cluster(tmp_path):
+    """Master + 2 in-process workers over a packed-int source table."""
+    db_path = str(tmp_path / "db")
+    seed = Client(db_path=db_path)
+    seed.new_table("chaos_src", ["output"],
+                   [[_pk(100 + i)] for i in range(N_ROWS)])
+    master = Master(db_path=db_path, no_workers_timeout=30.0)
+    addr = f"localhost:{master.port}"
+    workers = [Worker(addr, db_path=db_path) for _ in range(2)]
+    sc = Client(db_path=db_path, master=addr)
+    yield sc, master, workers, db_path, addr
+    faults.clear()
+    sc.stop()
+    for w in workers:
+        w.stop()
+    master.stop()
+
+
+def _run_golden(sc, out_name: str, op: str = "ChaosDouble", **perf_kw):
+    """The golden pipeline: src -> packed-int kernel -> named sink.
+    Returns the output rows as bytes (the bit-exactness witness)."""
+    col = sc.io.Input([NamedStream(sc, "chaos_src")])
+    col = getattr(sc.ops, op)(x=col)
+    out = NamedStream(sc, out_name)
+    sc.run(sc.io.Output(col, [out]), PerfParams.manual(2, 2, **perf_kw),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    return [bytes(r) for r in out.load()]
+
+
+# ---------------------------------------------------------------------------
+# fault-registry units (no cluster)
+# ---------------------------------------------------------------------------
+
+def test_plan_parse_and_validation():
+    rules = faults.parse_plan(
+        "storage.write:raise:exc=storage:msg=boom:n=3:times=1;"
+        "pipeline.eval:delay:seconds=2.5:match=task=0;"
+        "rpc.client.call:raise:exc=unavailable:p=0.25:seed=7")
+    assert [r.site for r in rules] == ["storage.write", "pipeline.eval",
+                                      "rpc.client.call"]
+    assert rules[0].exc == "storage" and rules[0].n == 3 \
+        and rules[0].times == 1 and rules[0].msg == "boom"
+    assert rules[1].seconds == 2.5 and rules[1].match == "task=0"
+    assert rules[2].p == 0.25 and rules[2].seed == 7
+    for bad in ("nosuch.site:raise",        # unknown site
+                "storage.read:explode",     # unknown mode
+                "storage.read:raise:zz=1",  # unknown key
+                "storage.read:raise:n",     # not key=value
+                "storage.read",             # missing mode
+                "storage.write:corrupt",    # corrupt on a data-less site
+                "storage.read:raise:exc=nope"):  # unknown exception
+        with pytest.raises(faults.FaultPlanError):
+            faults.parse_plan(bad)
+    # every canned plan must stay parseable
+    for name, spec in faults.NAMED_PLANS.items():
+        assert faults.parse_plan(spec), name
+
+
+def test_disabled_path_is_noop():
+    assert not faults.ACTIVE
+    blob = b"payload"
+    assert faults.inject("storage.read", blob, detail="x") is blob
+    faults.install("storage.read:corrupt")
+    assert faults.ACTIVE
+    faults.clear()
+    assert not faults.ACTIVE
+    assert faults.inject("storage.read", blob, detail="x") is blob
+
+
+def test_trigger_determinism():
+    r = faults.FaultRule(site="pipeline.eval", mode="raise", n=3)
+    assert [r.should_fire("") for _ in range(5)] == \
+        [False, False, True, False, False]
+    r = faults.FaultRule(site="pipeline.eval", mode="raise", after=2)
+    assert [r.should_fire("") for _ in range(5)] == \
+        [False, False, True, True, True]
+    r = faults.FaultRule(site="pipeline.eval", mode="raise", every=2,
+                         times=2)
+    assert [r.should_fire("") for _ in range(8)] == \
+        [False, True, False, True, False, False, False, False]
+    r = faults.FaultRule(site="pipeline.eval", mode="raise",
+                         match="NextWork")
+    assert not r.should_fire("Heartbeat")
+    assert r.should_fire("NextWork")
+    # p-mode: same seed -> same fire sequence, run to run
+    seqs = []
+    for _ in range(2):
+        r = faults.FaultRule(site="pipeline.eval", mode="raise", p=0.5,
+                             seed=9)
+        seqs.append([r.should_fire("") for _ in range(64)])
+    assert seqs[0] == seqs[1]
+    assert any(seqs[0]) and not all(seqs[0])
+
+
+def test_multi_rule_raise_does_not_overcount_fired():
+    """When an earlier rule on a site raises, later rules that matched
+    the same call never acted — fired() must not claim they did."""
+    faults.install("storage.read:raise:exc=storage;"
+                   "storage.read:corrupt")
+    with pytest.raises(StorageException):
+        faults.inject("storage.read", b"data", detail="x")
+    by_mode = {r.mode: r.fired for r in faults.rules()}
+    assert by_mode == {"raise": 1, "corrupt": 0}, by_mode
+    assert faults.fired("storage.read") == 1
+    s = MemoryStorage()
+    items.write_item(s, "tables/1/output_0.bin",
+                     [b"abc", NullElement(), b"defg"])
+    base = _counter("scanner_tpu_item_corruptions_total")
+    faults.install("storage.read:corrupt:match=tables/1/:n=1:times=1")
+    with pytest.raises(items.ItemCorruptionError):
+        items.read_item(s, "tables/1/output_0.bin")
+    # the injected rot is spent: the re-read (what a requeued task
+    # does) sees clean bytes
+    assert items.read_item(s, "tables/1/output_0.bin") == \
+        [b"abc", None, b"defg"]
+    assert faults.fired("storage.read") == 1
+    assert _counter("scanner_tpu_item_corruptions_total") == base + 1
+    assert _counter("scanner_tpu_faults_injected_total",
+                    site="storage.read", mode="corrupt") >= 1
+    # corruption is classified transient: requeue, not blacklist strike
+    assert _is_transient_failure(
+        items.ItemCorruptionError("checksum mismatch"))
+
+
+def test_header_rot_detected_by_checksum():
+    """The crc spans the header too: a flipped bit in `nrows` would
+    silently re-base every payload offset (garbage rows, no error) if
+    only the body were checksummed."""
+    s = MemoryStorage()
+    items.write_item(s, "it", [b"abc", b"de", b"f"])
+    raw = bytearray(s.read("it"))
+    raw[8] ^= 0x01  # low byte of the nrows field: 3 -> 2
+    s.write("it_rot", bytes(raw))
+    with pytest.raises(items.ItemCorruptionError):
+        items.read_item(s, "it_rot")
+
+
+def test_item_checksum_algo_recorded_in_version(monkeypatch):
+    """The checksum ALGORITHM travels in the version field: a zlib-
+    fallback writer stamps version 3 (always verifiable), and a reader
+    without google_crc32c skips verification of version-2 items
+    instead of flagging valid data as corrupt with the wrong
+    polynomial."""
+    import zlib
+
+    import numpy as np
+    s = MemoryStorage()
+    # version-3 item (zlib crc32), as a fallback writer would produce:
+    # the crc spans the zeroed header + body
+    sizes = np.array([3], np.uint64)
+    body = sizes.tobytes() + b"xyz"
+    hdr0 = struct.pack("<IIQI", items.MAGIC, items.VERSION_CRC32, 1, 0)
+    v3 = struct.pack("<IIQI", items.MAGIC, items.VERSION_CRC32, 1,
+                     zlib.crc32(hdr0 + body) & 0xFFFFFFFF) + body
+    s.write("v3", v3)
+    assert items.read_item(s, "v3") == [b"xyz"]
+    # ...and a corrupted v3 item is still caught
+    bad = bytearray(v3)
+    bad[-1] ^= 0xFF
+    s.write("v3bad", bytes(bad))
+    with pytest.raises(items.ItemCorruptionError):
+        items.read_item(s, "v3bad")
+
+    # a crc32c (version-2) item read on a node WITHOUT google_crc32c:
+    # verification is skipped (warned once), never misreported
+    items.write_item(s, "v2", [b"abc"])
+    monkeypatch.setattr(items, "_HAVE_CRC32C", False)
+    monkeypatch.setattr(items, "_warned_unverifiable", False)
+    assert items.read_item(s, "v2") == [b"abc"]
+
+
+def test_item_v1_readable_without_checksum():
+    import numpy as np
+    s = MemoryStorage()
+    sizes = np.array([3, items.NULL_SIZE], np.uint64)
+    v1 = struct.pack("<IIQ", items.MAGIC, 1, 2) + sizes.tobytes() + b"xyz"
+    s.write("old", v1)
+    assert items.read_item(s, "old") == [b"xyz", None]
+    assert items.item_num_rows(s, "old") == 2
+    assert items.read_item_rows(s, "old", [0], sparsity_threshold=1) == \
+        [b"xyz"]
+
+
+def test_gcs_request_injection_rides_retry():
+    from test_gcs import FakeGcsClient
+
+    from scanner_tpu.storage import GcsStorage
+    g = GcsStorage("bkt", "pfx", client=FakeGcsClient(),
+                   backoff_base=0.001, backoff_cap=0.002)
+    g.write("blob", b"data")
+    # two transient connection failures per matching call window; the
+    # backend's backoff (5 retries) must ride them out
+    faults.install("gcs.request:raise:exc=connection:times=2")
+    assert g.read("blob") == b"data"
+    assert faults.fired("gcs.request") == 2
+
+
+def test_transient_classifier():
+    from scanner_tpu.engine.rpc import RpcError
+    assert _is_transient_failure(StorageException("not found: x"))
+    assert _is_transient_failure(RpcError("master gone"))
+    assert _is_transient_failure(ConnectionError("reset"))
+    assert _is_transient_failure(TimeoutError("deadline"))
+    assert not _is_transient_failure(RuntimeError("kernel bug"))
+    assert not _is_transient_failure(ValueError("bad shape"))
+
+
+def test_transient_failures_requeue_without_strike(tmp_path):
+    """Satellite: a transient FailedWork requeues strike-free; only past
+    MAX_TRANSIENT_FAILURES do strikes (and eventually the blacklist)
+    begin — a flaky dependency cannot blacklist a healthy job."""
+    master = Master(db_path=str(tmp_path / "db"), no_workers_timeout=60.0)
+    try:
+        bulk = _BulkJob(bulk_id=0, spec_blob=b"", task_timeout=0.0)
+        from collections import deque
+        bulk.job_tasks[0] = {(0, 0)}
+        bulk.job_sink_names[0] = []
+        bulk.job_custom_sinks[0] = []
+        bulk.job_output_rows[0] = 0
+        bulk.queue[0] = deque([0])
+        bulk.job_rr.append(0)
+        bulk.total_tasks = 1
+        with master._lock:
+            master._bulk = bulk
+            master._history[0] = bulk
+        wid = master._rpc_register_worker({"address": "x"})["worker_id"]
+
+        def fail_once(transient: bool):
+            r = master._rpc_next_work({"worker_id": wid, "bulk_id": 0})
+            assert r["status"] == "task"
+            assert master._rpc_failed_work({
+                "worker_id": wid, "bulk_id": 0, "job_idx": 0,
+                "task_idx": 0, "attempt": r["attempt"],
+                "transient": transient, "error": "injected"})["ok"]
+
+        for i in range(MAX_TRANSIENT_FAILURES):
+            fail_once(transient=True)
+            assert not bulk.failures, f"strike on transient failure {i}"
+            assert not bulk.blacklisted_jobs
+            assert bulk.queue[0], "task not requeued"
+        assert bulk.transient_failures[(0, 0)] == MAX_TRANSIENT_FAILURES
+        # past the cap, "transient" failures strike like any other
+        for i in range(MAX_TASK_FAILURES):
+            assert not bulk.blacklisted_jobs
+            fail_once(transient=True)
+            assert bulk.failures.get((0, 0), 0) == i + 1
+        assert bulk.blacklisted_jobs == {0}
+
+        # deterministic failures strike immediately
+        bulk2 = _BulkJob(bulk_id=1, spec_blob=b"", task_timeout=0.0)
+        bulk2.job_tasks[0] = {(0, 0)}
+        bulk2.job_sink_names[0] = []
+        bulk2.job_custom_sinks[0] = []
+        bulk2.job_output_rows[0] = 0
+        bulk2.queue[0] = deque([0])
+        bulk2.job_rr.append(0)
+        bulk2.total_tasks = 1
+        with master._lock:
+            master._bulk = bulk2
+            master._history[1] = bulk2
+        r = master._rpc_next_work({"worker_id": wid, "bulk_id": 1})
+        master._rpc_failed_work({
+            "worker_id": wid, "bulk_id": 1, "job_idx": 0, "task_idx": 0,
+            "attempt": r["attempt"], "transient": False,
+            "error": "kernel bug"})
+        assert bulk2.failures[(0, 0)] == 1
+    finally:
+        master.stop()
+
+
+def test_rpc_server_logs_traceback(caplog):
+    """Satellite: a handler exception logs its server-side stack at
+    ERROR before being mapped to StatusCode.INTERNAL — previously only
+    'type: msg' survived, and the stack was silently discarded."""
+    import logging
+
+    from scanner_tpu.engine.rpc import RpcClient, RpcError, RpcServer
+
+    def boom(req):
+        raise RuntimeError("handler exploded here")
+
+    srv = RpcServer("ChaosTest", {"Boom": boom})
+    srv.start()
+    client = RpcClient(f"localhost:{srv.port}", "ChaosTest", timeout=5.0)
+    try:
+        with caplog.at_level(logging.ERROR, logger="scanner_tpu.rpc"):
+            with pytest.raises(RpcError) as ei:
+                client.call("Boom", retries=0)
+        assert "INTERNAL" in str(ei.value)
+        assert "RuntimeError: handler exploded here" in str(ei.value)
+        assert "RPC Boom failed server-side" in caplog.text
+        # the full traceback reached the server log
+        assert "Traceback" in caplog.text
+        assert "handler exploded here" in caplog.text
+    finally:
+        client.close()
+        srv.stop()
+
+
+def test_rpc_client_unavailable_storm_backoff():
+    """An injected UNAVAILABLE storm at the client site is retried by
+    the existing full-jitter backoff — the request never reached the
+    server, so retrying cannot double-execute."""
+    from scanner_tpu.engine.rpc import RpcClient, RpcServer
+
+    srv = RpcServer("ChaosTest", {"Echo": lambda req: {"v": req["v"]}})
+    srv.start()
+    client = RpcClient(f"localhost:{srv.port}", "ChaosTest", timeout=5.0,
+                       retries=4, backoff_base=0.01, backoff_cap=0.05)
+    try:
+        faults.install(
+            "rpc.client.call:raise:exc=unavailable:match=Echo:times=2")
+        assert client.call("Echo", v=7)["v"] == 7
+        assert faults.fired("rpc.client.call") == 2
+        assert _counter("scanner_tpu_faults_injected_total",
+                        site="rpc.client.call", mode="raise") >= 2
+    finally:
+        client.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# in-process cluster chaos (tier-1)
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_uses_short_timeout(chaos_cluster):
+    """Satellite: heartbeat RPCs carry a ~2x PING_INTERVAL deadline, not
+    the 30s client default — a hung master costs one beat, not a
+    stale-worker removal."""
+    _sc, _master, workers, _dbp, _addr = chaos_cluster
+    w = workers[0]
+    seen = []
+    orig = w.master.try_call
+
+    def recording(method, timeout=None, retries=None, **kw):
+        seen.append((method, timeout))
+        return orig(method, timeout=timeout, retries=retries, **kw)
+
+    w.master.try_call = recording
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if any(m == "Heartbeat" for m, _t in seen):
+            break
+        time.sleep(0.05)
+    hb = [(m, t) for m, t in seen if m == "Heartbeat"]
+    assert hb, "no heartbeat observed"
+    assert all(t == PING_TIMEOUT for _m, t in hb), hb
+
+
+def test_chaos_sink_write_failure(chaos_cluster):
+    """Fault class: a sink item write fails.  The failure is transient
+    (storage), so the task requeues without a blacklist strike and the
+    job completes bit-exact."""
+    sc, _master, _workers, _dbp, _addr = chaos_cluster
+    golden = _run_golden(sc, "c_sink_gold")
+    assert golden == EXPECT
+    strikes0 = _counter("scanner_tpu_blacklist_strikes_total")
+    transient0 = _counter("scanner_tpu_transient_retries_total")
+    faults.install("storage.write:raise:exc=storage:"
+                   "msg=injected sink failure:match=output_:n=2:times=1")
+    got = _run_golden(sc, "c_sink_fault")
+    assert faults.fired("storage.write") == 1
+    assert _counter("scanner_tpu_faults_injected_total",
+                    site="storage.write", mode="raise") >= 1
+    assert got == golden, "output not bit-exact after sink write fault"
+    assert _counter("scanner_tpu_transient_retries_total") > transient0
+    assert _counter("scanner_tpu_blacklist_strikes_total") == strikes0, \
+        "transient sink failure counted a blacklist strike"
+
+
+def test_chaos_corrupted_item_read(chaos_cluster):
+    """Fault class: a stored item read returns corrupted bytes.  The
+    crc32c check turns silent rot into ItemCorruptionError, the worker
+    tags it transient, the requeued task re-reads clean bytes."""
+    sc, master, workers, _dbp, addr = chaos_cluster
+    golden = _run_golden(sc, "c_corrupt_gold", load_sparsity_threshold=100)
+    # single dedicated worker so the read sequence per task is
+    # deterministic: header ranged read (1st), dense whole read (2nd)
+    for w in workers:
+        w.stop()
+    solo = Worker(addr, db_path=_dbp, num_load_workers=1,
+                  num_save_workers=1)
+    try:
+        src_tid = sc._db.table_descriptor("chaos_src").id
+        corrupt0 = _counter("scanner_tpu_item_corruptions_total")
+        strikes0 = _counter("scanner_tpu_blacklist_strikes_total")
+        faults.install(
+            f"storage.read:corrupt:match=tables/{src_tid}/output_0.bin:"
+            f"n=2:times=1")
+        got = _run_golden(sc, "c_corrupt_fault",
+                          load_sparsity_threshold=100)
+        assert faults.fired("storage.read") == 1
+        assert _counter("scanner_tpu_faults_injected_total",
+                        site="storage.read", mode="corrupt") >= 1
+        assert got == golden, "output not bit-exact after corrupted read"
+        assert _counter("scanner_tpu_item_corruptions_total") == \
+            corrupt0 + 1, "crc32c did not catch the injected corruption"
+        assert _counter("scanner_tpu_blacklist_strikes_total") == strikes0
+    finally:
+        solo.stop()
+
+
+def test_chaos_worker_hang_revocation(chaos_cluster):
+    """Fault class: a worker wedges mid-evaluate while its heartbeat
+    stays live.  Stale removal must NOT trigger (the worker is alive);
+    the per-task timeout revokes the attempt and a sibling finishes it.
+    The stale attempt's late completion is ignored by the attempt-id
+    check, so the output stays exactly-once."""
+    sc, master, workers, _dbp, _addr = chaos_cluster
+    golden = _run_golden(sc, "c_hang_gold")
+    revoked0 = _counter("scanner_tpu_task_revocations_total")
+    faults.install("pipeline.eval:delay:seconds=5:n=1")
+    got = _run_golden(sc, "c_hang_fault", task_timeout=1.0)
+    assert faults.fired("pipeline.eval") == 1
+    assert _counter("scanner_tpu_faults_injected_total",
+                    site="pipeline.eval", mode="delay") >= 1
+    assert got == golden, "output not bit-exact after hang+revocation"
+    assert _counter("scanner_tpu_task_revocations_total") > revoked0, \
+        "hung task was never revoked"
+    with master._lock:
+        active = [w for w in master._workers.values() if w.active]
+    assert len(active) == 2, "a live (hung-but-heartbeating) worker " \
+                             "was removed as stale"
+
+
+def test_chaos_unavailable_storm_cluster(chaos_cluster):
+    """Fault class: UNAVAILABLE storm on the control plane.  Every 2nd
+    NextWork attempt fails at the transport; the client-side backoff
+    rides each storm out within a single logical call, so the job
+    needs no task retries at all."""
+    sc, _master, _workers, _dbp, _addr = chaos_cluster
+    golden = _run_golden(sc, "c_storm_gold")
+    retries0 = _counter("scanner_tpu_retry_attempts_total",
+                        site="rpc:NextWork")
+    faults.install("rpc.client.call:raise:exc=unavailable:"
+                   "match=NextWork:every=2:times=20")
+    got = _run_golden(sc, "c_storm_fault", task_timeout=10.0)
+    assert faults.fired("rpc.client.call") >= 10
+    assert _counter("scanner_tpu_faults_injected_total",
+                    site="rpc.client.call", mode="raise") >= 10
+    assert got == golden, "output not bit-exact through the storm"
+    assert _counter("scanner_tpu_retry_attempts_total",
+                    site="rpc:NextWork") > retries0, \
+        "storm never engaged the backoff path"
+
+
+def test_chaos_drain_in_process(chaos_cluster):
+    """SIGTERM drain semantics (hardening): a draining worker finishes
+    its in-flight tasks, stops pulling, deregisters immediately (no
+    stale-scan wait), and the sibling completes the job bit-exact."""
+    sc, master, workers, _dbp, _addr = chaos_cluster
+    golden = _run_golden(sc, "c_drain_gold")
+    drains0 = _counter("scanner_tpu_worker_drains_total")
+    victim = workers[0]
+    result = {}
+
+    def run_job():
+        try:
+            result["rows"] = _run_golden(sc, "c_drain_fault",
+                                         op="ChaosSlowDouble")
+        except Exception as e:  # noqa: BLE001
+            result["error"] = e
+
+    t = threading.Thread(target=run_job)
+    t.start()
+    time.sleep(1.0)  # let the job spin up and assign tasks
+    victim.drain()
+    t.join(timeout=60)
+    assert not t.is_alive(), "job wedged after drain"
+    assert "error" not in result, result.get("error")
+    assert result["rows"] == golden
+    # drained worker deregistered without waiting for the stale scan
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        with master._lock:
+            w = master._workers.get(victim.worker_id)
+            if w is not None and not w.active:
+                break
+        time.sleep(0.1)
+    with master._lock:
+        assert not master._workers[victim.worker_id].active, \
+            "drained worker still registered as active"
+    assert _counter("scanner_tpu_worker_drains_total") == drains0 + 1
+    assert victim._shutdown.is_set(), "drained worker did not shut down"
+
+
+# ---------------------------------------------------------------------------
+# spawned-cluster chaos (slow)
+# ---------------------------------------------------------------------------
+
+def _spawn_env(extra=None):
+    from scanner_tpu.util.jaxenv import cpu_only_env
+    env = cpu_only_env()
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("SCANNER_TPU_FAULTS", None)
+    env.update(extra or {})
+    return env
+
+
+def _spawn_worker(addr, db_path, plan=None):
+    spawn = os.path.join(os.path.dirname(__file__), "spawn_worker.py")
+    extra = {"SCANNER_TPU_FAULTS": plan} if plan else None
+    return subprocess.Popen(
+        [sys.executable, spawn, addr, db_path], env=_spawn_env(extra),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+@pytest.mark.slow
+def test_chaos_worker_crash_midtask(tmp_path):
+    """Fault class: a worker PROCESS dies mid-task (os._exit — no
+    cleanup, like a kill -9 or an OOM).  The stale scan deactivates it,
+    its tasks requeue, the surviving worker finishes, and the output is
+    bit-exact."""
+    db_path = str(tmp_path / "db")
+    seed = Client(db_path=db_path)
+    seed.new_table("chaos_src", ["output"],
+                   [[_pk(100 + i)] for i in range(N_ROWS)])
+    master = Master(db_path=db_path, no_workers_timeout=60.0)
+    addr = f"localhost:{master.port}"
+    sc = Client(db_path=db_path, master=addr)
+    survivor = _spawn_worker(addr, db_path)
+    victim = None
+    try:
+        # golden BEFORE the victim exists: its armed plan would fire
+        # during any run it participates in
+        golden = _run_golden(sc, "c_crash_gold", op="ChaosSlowDouble")
+        assert golden == EXPECT
+        victim = _spawn_worker(addr, db_path,
+                               plan=faults.NAMED_PLANS["worker-crash"])
+        # wait for the victim to register so it actually takes tasks
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            with master._lock:
+                if sum(1 for w in master._workers.values()
+                       if w.active) >= 2:
+                    break
+            time.sleep(0.1)
+        got = _run_golden(sc, "c_crash_fault", op="ChaosSlowDouble")
+        # the injected crash fired: the victim died with the chaos exit
+        # code (the cross-process twin of the faults-injected counter)
+        assert victim.wait(timeout=30) == faults.CRASH_EXIT_CODE
+        assert got == golden, "output not bit-exact after worker crash"
+    finally:
+        for p in (victim, survivor):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait()
+        sc.stop()
+        master.stop()
+
+
+@pytest.mark.slow
+def test_chaos_master_crash_recovery(tmp_path):
+    """Fault class + satellite: the MASTER dies mid-bulk (injected
+    crash in the FinishedWork handler).  A restarted master on the same
+    db_path recovers the bulk from its checkpoint (_recover_bulk), the
+    surviving worker re-registers and finishes, tasks in the persisted
+    done-set are NOT re-executed, and the output is bit-exact."""
+    import socket
+
+    db_path = str(tmp_path / "db")
+    log = str(tmp_path / "rows.log")
+    seed = Client(db_path=db_path)
+    seed.new_table("chaos_src", ["output"],
+                   [[_pk(100 + i)] for i in range(N_ROWS)])
+    seed.stop()
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    addr = f"localhost:{port}"
+    spawn = os.path.join(os.path.dirname(__file__), "spawn_master.py")
+
+    def spawn_master(plan=None):
+        extra = {"SCANNER_TPU_FAULTS": plan} if plan else None
+        return subprocess.Popen(
+            [sys.executable, spawn, db_path, str(port)],
+            env=_spawn_env(extra),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    # crash handling the 4th FinishedWork: 3 completions are in the
+    # persisted done-set (checkpoint_frequency=1), the 4th is lost and
+    # must re-run after recovery
+    m1 = spawn_master(plan=faults.NAMED_PLANS["master-crash"])
+    state = {}
+
+    def respawner():
+        state["rc1"] = m1.wait(timeout=120)
+        with open(os.path.join(db_path, smd.bulk_progress_path()),
+                  "rb") as f:
+            state["done_at_crash"] = Master._decode_task_set(
+                cloudpickle.loads(f.read())["done_runs"])
+        state["rows_at_crash"] = open(log).read().splitlines()
+        time.sleep(0.5)
+        state["m2"] = spawn_master()
+
+    worker = None
+    sc = None
+    try:
+        sc = Client(db_path=db_path, master=addr)
+        worker = Worker(addr, db_path=db_path)
+        rt = threading.Thread(target=respawner)
+        rt.start()
+        col = sc.io.Input([NamedStream(sc, "chaos_src")])
+        col = sc.ops.ChaosRowLog(x=col, log_path=log)
+        out = NamedStream(sc, "c_mcrash_out")
+        sc.run(sc.io.Output(col, [out]),
+               PerfParams.manual(2, 2, checkpoint_frequency=1),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+        rt.join(timeout=60)
+        assert not rt.is_alive(), "master never crashed/respawned"
+        # the injected crash fired (cross-process exit-code witness)
+        assert state["rc1"] == faults.CRASH_EXIT_CODE
+        assert state["done_at_crash"], "no tasks persisted before crash"
+
+        assert [bytes(r) for r in out.load()] == EXPECT
+        assert out.committed()
+        # rows of tasks in the persisted done-set ran exactly once: the
+        # recovered master did not re-execute them
+        counts = {}
+        for line in open(log).read().splitlines():
+            counts[int(line)] = counts.get(int(line), 0) + 1
+        for (_j, t) in state["done_at_crash"]:
+            for row in (100 + 2 * t, 100 + 2 * t + 1):
+                assert counts.get(row, 0) == 1, \
+                    f"row {row} of checkpointed task {t} ran " \
+                    f"{counts.get(row, 0)} times"
+        assert all(counts.get(100 + i, 0) >= 1 for i in range(N_ROWS))
+    finally:
+        if worker is not None:
+            worker.stop()
+        if sc is not None:
+            sc.stop()
+        for p in (m1, state.get("m2")):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait()
+
+
+@pytest.mark.slow
+def test_chaos_sigterm_drain_spawned(tmp_path):
+    """Hardening e2e: SIGTERM to a worker PROCESS mid-job (kubernetes
+    pod termination) drains it — in-flight tasks finish, it
+    deregisters, exits 0 within the grace period — and the sibling
+    completes the job bit-exact."""
+    db_path = str(tmp_path / "db")
+    seed = Client(db_path=db_path)
+    seed.new_table("chaos_src", ["output"],
+                   [[_pk(100 + i)] for i in range(N_ROWS)])
+    master = Master(db_path=db_path, no_workers_timeout=60.0)
+    addr = f"localhost:{master.port}"
+    sc = Client(db_path=db_path, master=addr)
+    survivor = _spawn_worker(addr, db_path)
+    victim = _spawn_worker(addr, db_path)
+    try:
+        golden = _run_golden(sc, "c_term_gold", op="ChaosSlowDouble")
+
+        def terminator():
+            time.sleep(1.5)
+            victim.send_signal(signal.SIGTERM)
+
+        tt = threading.Thread(target=terminator)
+        tt.start()
+        got = _run_golden(sc, "c_term_fault", op="ChaosSlowDouble")
+        tt.join()
+        assert got == golden, "output not bit-exact across drain"
+        # clean exit, well inside the deploy.py terminationGracePeriod
+        assert victim.wait(timeout=30) == 0, "drained worker died dirty"
+    finally:
+        for p in (victim, survivor):
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        sc.stop()
+        master.stop()
+
+
+def test_chaos_run_cli_lists_plans():
+    """tools/chaos_run.py enumerates the canned plans (full runs are
+    exercised by the slow tests; --list keeps the CLI import-checked
+    in tier-1)."""
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "tools",
+                      "chaos_run.py"), "--list"],
+        env=_spawn_env(), capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    for name in faults.NAMED_PLANS:
+        assert name in r.stdout

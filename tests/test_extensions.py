@@ -154,6 +154,10 @@ def test_deploy_manifests():
     limits = workers["spec"]["template"]["spec"]["containers"][0][
         "resources"]["limits"]
     assert limits["google.com/tpu"] == "4"
+    # SIGTERM drain window (Worker.drain, docs/robustness.md): pods get
+    # the configured grace period before the SIGKILL follow-up
+    assert workers["spec"]["template"]["spec"][
+        "terminationGracePeriodSeconds"] == cfg.termination_grace_period
     assert cfg.price_per_hour() > 0
     assert "sc-master" in cluster.manifests_json()
     toml = by_kind[("ConfigMap", "sc-config")]["data"]["scanner_tpu.toml"]

@@ -217,6 +217,8 @@ def test_registered_series_names_lint():
     import scanner_tpu.engine.rpc         # noqa: F401
     import scanner_tpu.engine.service     # noqa: F401
     import scanner_tpu.storage.gcs        # noqa: F401
+    import scanner_tpu.storage.items      # noqa: F401
+    import scanner_tpu.util.faults        # noqa: F401
     import scanner_tpu.util.profiler      # noqa: F401
     import scanner_tpu.util.retry         # noqa: F401
 
@@ -236,6 +238,12 @@ def test_registered_series_names_lint():
     assert {"scanner_tpu_op_recompiles_total",
             "scanner_tpu_op_pad_rows_total",
             "scanner_tpu_op_precompile_seconds"} <= names
+    # the robustness series (docs/robustness.md): chaos-fire evidence,
+    # crc-detected corruption, strike-free transient requeues, drains
+    assert {"scanner_tpu_faults_injected_total",
+            "scanner_tpu_item_corruptions_total",
+            "scanner_tpu_transient_retries_total",
+            "scanner_tpu_worker_drains_total"} <= names
 
 
 # ---------------------------------------------------------------------------

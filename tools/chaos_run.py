@@ -1,0 +1,221 @@
+"""chaos-run: replay a named fault plan against a local cluster.
+
+Spins up a master + N spawned worker processes over a temporary (or
+given) db, runs the golden pipeline twice — once clean, once under the
+chosen fault plan — and reports whether the fault fired and whether the
+faulted run's output is bit-exact to the clean one.  The CLI twin of
+tests/test_chaos.py, for poking a failure class by hand:
+
+    python tools/chaos_run.py --list
+    python tools/chaos_run.py worker-crash
+    python tools/chaos_run.py unavailable-storm --rows 48 --workers 3
+    python tools/chaos_run.py "pipeline.save:raise:exc=storage:n=3"
+
+A plan name resolves via scanner_tpu.util.faults.NAMED_PLANS; anything
+else is parsed as a raw plan spec (docs/robustness.md syntax).  Plans
+whose sites live in the workers (pipeline.*, storage.*, gcs.*,
+worker.*, rpc.server on workers is N/A) ship to ONE worker process via
+SCANNER_TPU_FAULTS, so the sibling(s) stay healthy to absorb the
+reassigned work; rpc.client.* / master-side plans arm in this process
+(the client) or the master respectively.  A crashed master is
+respawned once so recovery can be observed.
+
+Exit codes: 0 = fault fired and output bit-exact; 1 = verification
+failed; 2 = bad usage.
+"""
+
+import argparse
+import os
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_DEFAULT_ROWS = 24
+
+
+def _pk(v: int) -> bytes:
+    return struct.pack("<q", v)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="replay a named fault plan against a local cluster")
+    ap.add_argument("plan", nargs="?",
+                    help="named plan (see --list) or a raw plan spec")
+    ap.add_argument("--list", action="store_true",
+                    help="list the canned fault plans and exit")
+    ap.add_argument("--db", default=None,
+                    help="db path (default: a fresh temp dir)")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--rows", type=int, default=N_DEFAULT_ROWS)
+    ap.add_argument("--task-timeout", type=float, default=8.0,
+                    help="per-task timeout for the faulted run (the "
+                         "revocation safety net)")
+    args = ap.parse_args()
+
+    from scanner_tpu.util import faults
+
+    if args.list:
+        width = max(len(n) for n in faults.NAMED_PLANS)
+        for name, spec in sorted(faults.NAMED_PLANS.items()):
+            print(f"{name:<{width}}  {spec}")
+        return 0
+    if not args.plan:
+        ap.error("a plan name or spec is required (or --list)")
+
+    spec = faults.NAMED_PLANS.get(args.plan, args.plan)
+    rules = faults.parse_plan(spec)  # validate before spinning anything
+    sites = {r.site for r in rules}
+    worker_side = any(s.split(".")[0] in ("pipeline", "storage", "gcs",
+                                          "worker") for s in sites)
+    master_side = "rpc.server.handle" in sites
+    client_side = "rpc.client.call" in sites
+    print(f"plan: {spec}\nsites: {sorted(sites)} "
+          f"(worker={worker_side} master={master_side} "
+          f"client={client_side})")
+
+    import tempfile
+
+    import cloudpickle
+
+    import scanner_tpu  # noqa: F401 — registers builtin ops
+    from scanner_tpu import (CacheMode, Client, Kernel, NamedStream,
+                             PerfParams, register_op)
+    from scanner_tpu.util import metrics as _mx
+
+    @register_op(name="ChaosRunDouble")
+    class ChaosRunDouble(Kernel):
+        def execute(self, x: bytes) -> bytes:
+            time.sleep(0.1)
+            return _pk(2 * struct.unpack("<q", x)[0])
+
+    cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+    db_path = args.db or tempfile.mkdtemp(prefix="chaos_run_")
+    print(f"db: {db_path}")
+    seed = Client(db_path=db_path)
+    seed.new_table("chaos_src", ["output"],
+                   [[_pk(100 + i)] for i in range(args.rows)],
+                   overwrite=True)
+
+    # children run on the CPU backend with ambient accelerator-plugin
+    # triggers stripped (util/jaxenv.py: a wedged tunnel would hang the
+    # child at interpreter start) — same discipline as the test spawns
+    from scanner_tpu.util.jaxenv import cpu_only_env
+    env = cpu_only_env()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("SCANNER_TPU_FAULTS", None)
+
+    def spawn(script, argv, plan=None):
+        e = dict(env)
+        if plan:
+            e["SCANNER_TPU_FAULTS"] = plan
+        return subprocess.Popen([sys.executable,
+                                 os.path.join(REPO, "tests", script),
+                                 *argv], env=e)
+
+    import socket
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    addr = f"localhost:{port}"
+
+    procs = []
+    master = spawn("spawn_master.py", [db_path, str(port)],
+                   plan=spec if master_side else None)
+    procs.append(master)
+    for i in range(args.workers):
+        # the FIRST worker carries a worker-side plan; siblings stay
+        # healthy so reassigned work has somewhere to go
+        procs.append(spawn("spawn_worker.py", [addr, db_path],
+                           plan=spec if worker_side and i == 0 else None))
+
+    respawned = {}
+    if master_side:
+        def respawn_master():
+            respawned["rc"] = master.wait()
+            print(f"master died (exit {respawned['rc']}); respawning")
+            time.sleep(0.5)
+            m2 = spawn("spawn_master.py", [db_path, str(port)])
+            respawned["proc"] = m2
+            procs.append(m2)
+        threading.Thread(target=respawn_master, daemon=True).start()
+
+    from scanner_tpu.engine.rpc import wait_for_server
+    from scanner_tpu.engine.service import MASTER_SERVICE
+    wait_for_server(addr, MASTER_SERVICE, timeout=60.0)
+    sc = Client(db_path=db_path, master=addr)
+    # wait for every worker to register (subprocess import time
+    # dominates); a worker-side plan can only fire on a joined worker
+    deadline = time.time() + 60.0
+    while time.time() < deadline:
+        st = sc.job_status()
+        if st.get("num_workers", 0) >= args.workers:
+            break
+        time.sleep(0.25)
+    print(f"workers registered: {sc.job_status().get('num_workers', 0)}")
+
+    def run(out_name, **kw):
+        col = sc.io.Input([NamedStream(sc, "chaos_src")])
+        col = sc.ops.ChaosRunDouble(x=col)
+        out = NamedStream(sc, out_name)
+        sc.run(sc.io.Output(col, [out]), PerfParams.manual(2, 2, **kw),
+               cache_mode=CacheMode.Overwrite, show_progress=True)
+        return [bytes(r) for r in out.load()]
+
+    rc = 1
+    try:
+        # faulted run FIRST: worker/master-side plans armed via env are
+        # live from process start, so running clean before them would
+        # inject into the "clean" baseline.  After the faulted run the
+        # victim is dead/deactivated or its fire budget is spent, and
+        # the clean run sees an undisturbed cluster.
+        if client_side:
+            faults.install(spec)
+        print("== faulted run ==")
+        got = run("chaos_faulted", task_timeout=args.task_timeout,
+                  checkpoint_frequency=1)
+        # read the rule counters BEFORE clear() empties the registry —
+        # client-side fires exist nowhere else (sc.metrics() aggregates
+        # master+workers, not this process)
+        local_fired = faults.fired()
+        faults.clear()
+        print("== clean run ==")
+        golden = run("chaos_clean", task_timeout=args.task_timeout)
+
+        exact = got == golden
+        # remote fires show up as worker/master death or in the
+        # cluster-wide metric when the process is still alive
+        snap = sc.metrics()
+        entry = snap.get("scanner_tpu_faults_injected_total", {})
+        cluster_fired = sum(s.get("value", 0)
+                            for s in entry.get("samples", []))
+        crashed = [p for p in procs
+                   if p.poll() == faults.CRASH_EXIT_CODE]
+        print(f"\nfault fired: local={int(local_fired)} "
+              f"cluster-metric={int(cluster_fired)} "
+              f"injected-crashes={len(crashed)}")
+        print(f"output bit-exact to clean run: {exact} "
+              f"({len(got)} rows)")
+        fired = bool(local_fired or cluster_fired or crashed
+                     or respawned.get("rc") == faults.CRASH_EXIT_CODE)
+        rc = 0 if (exact and fired) else 1
+        if not fired:
+            print("WARNING: no evidence the fault fired — plan matched "
+                  "nothing?")
+    finally:
+        sc.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
