@@ -313,6 +313,32 @@ def main():
                 per_op("scanner_tpu_op_precompile_seconds"),
         })
         detail.append({"config": "metrics_registry", "snapshot": snap})
+        # static-analysis digest: finding counts per code ride with every
+        # perf round, so analyzer drift (new findings, baseline growth)
+        # is visible in the same trajectory as fps regressions
+        try:
+            from scanner_tpu.analysis.static import (
+                analyze, load_baseline, split_findings)
+            _root = os.path.dirname(os.path.abspath(__file__))
+            _proj, _found = analyze(
+                [os.path.join(_root, "scanner_tpu")], root=_root)
+            _res = split_findings(_proj, _found, load_baseline(
+                os.path.join(_root, "tools",
+                             "scanner_check_baseline.json")))
+            _counts: dict = {}
+            for _f in _found:
+                _counts[_f.code] = _counts.get(_f.code, 0) + 1
+            detail.append({
+                "config": "static_analysis",
+                "findings_by_code": _counts,
+                "unsuppressed": len(_res.unsuppressed),
+                "baselined": len(_res.baselined),
+                "inline_suppressed": len(_res.inline_suppressed),
+                "files_analyzed": len(_proj.modules),
+            })
+        except Exception as e:  # noqa: BLE001 — bench must not die on lint
+            detail.append({"config": "static_analysis",
+                           "error": f"{type(e).__name__}: {e}"})
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_DETAIL.json"), "w") as f:
             json.dump(detail, f, indent=1)

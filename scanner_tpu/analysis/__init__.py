@@ -1,0 +1,12 @@
+"""Offline analyses of the scanner_tpu codebase itself.
+
+`analysis.static` is the repo-native static-analysis suite
+(`tools/scanner_check.py` / the `scanner-check` console script): AST
+passes that enforce the program properties the engine's correctness and
+performance story depend on — tracer safety of jitted/device-kernel
+code, lock-order discipline in the threaded pipeline, and the
+code↔docs↔wiring contracts (metric catalog, env vars, config keys,
+fault sites, RPC surface).  See docs/static-analysis.md.
+"""
+
+from . import static  # noqa: F401

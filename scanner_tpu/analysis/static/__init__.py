@@ -1,0 +1,36 @@
+"""Repo-native static analysis (scanner-check).
+
+Three pass families over the scanner_tpu source:
+
+  * tracer.py      — SC101–SC105: tracer safety + shape-stable dispatch
+  * concurrency.py — SC201–SC203: lock order, blocking-under-lock,
+                     unguarded shared writes
+  * contracts.py   — SC301–SC307: metric/env/config/fault/RPC contracts
+
+Run via `python tools/scanner_check.py`, the `scanner-check` console
+script, or programmatically::
+
+    from scanner_tpu.analysis.static import run_analysis
+    findings = run_analysis(["scanner_tpu/"])
+
+The tier-1 gate (tests/test_static_analysis.py) fails on any finding
+not inline-suppressed or baselined with a justification.  Docs:
+docs/static-analysis.md.
+"""
+
+from .core import (AnalysisPass, BaselineError, Finding, ModuleInfo,
+                   Project, find_repo_root, load_baseline,
+                   split_findings, write_baseline)
+from .tracer import TracerSafetyPass
+from .concurrency import ConcurrencyPass
+from .contracts import ContractPass
+from .cli import (DEFAULT_BASELINE, all_passes, analyze, main,
+                  run_analysis)
+
+__all__ = [
+    "AnalysisPass", "BaselineError", "Finding", "ModuleInfo", "Project",
+    "TracerSafetyPass", "ConcurrencyPass", "ContractPass",
+    "find_repo_root", "load_baseline", "split_findings",
+    "write_baseline", "all_passes", "analyze", "run_analysis", "main",
+    "DEFAULT_BASELINE",
+]

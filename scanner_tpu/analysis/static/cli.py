@@ -1,0 +1,176 @@
+"""scanner-check CLI.
+
+    scanner-check [paths...]            # human output, exit 1 on findings
+    scanner-check --json                # machine output (CI, bench.py)
+    scanner-check --write-baseline      # accept current findings
+    scanner-check --list-codes          # what the passes check
+
+Invoked as `python tools/scanner_check.py`, the `scanner-check` console
+script, or the tier-1 gate test
+(tests/test_static_analysis.py::test_repo_is_clean).  Default target is
+the scanner_tpu package of the repo the CLI runs from; default baseline
+is tools/scanner_check_baseline.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from .core import (BaselineError, Finding, Project, find_repo_root,
+                   load_baseline, split_findings, write_baseline)
+from .tracer import TracerSafetyPass
+from .concurrency import ConcurrencyPass
+from .contracts import ContractPass
+
+DEFAULT_BASELINE = os.path.join("tools", "scanner_check_baseline.json")
+
+
+def all_passes():
+    return [TracerSafetyPass(), ConcurrencyPass(), ContractPass()]
+
+
+def analyze(paths: Sequence[str], root: Optional[str] = None,
+            select: Optional[Sequence[str]] = None
+            ) -> "tuple[Project, List[Finding]]":
+    """THE run protocol, shared by the CLI, bench.py, and the tests:
+    build the Project, seed findings with parse errors, run every pass,
+    optionally filter to code prefixes, sort.  Returns the project too
+    (split_findings needs it for inline-suppression lookup)."""
+    project = Project(paths, root=root)
+    findings: List[Finding] = list(project.parse_errors)
+    for p in all_passes():
+        findings.extend(p.run(project))
+    if select:
+        findings = [f for f in findings
+                    if any(f.code.startswith(s) for s in select)]
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return project, findings
+
+
+def run_analysis(paths: Sequence[str], root: Optional[str] = None,
+                 select: Optional[Sequence[str]] = None) -> List[Finding]:
+    """analyze() without the project — raw findings, suppression not
+    yet applied."""
+    return analyze(paths, root=root, select=select)[1]
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="scanner-check",
+        description="scanner_tpu repo-native static analysis "
+                    "(docs/static-analysis.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to analyze (default: the repo's "
+                         "scanner_tpu/ package)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (docs/, tests/ context); default: "
+                         "auto-detected from the first path")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default <root>/"
+                         f"{DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (show everything)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept current unsuppressed findings into the "
+                         "baseline (keeps existing justifications; new "
+                         "entries need one before the file loads again)")
+    ap.add_argument("--justification", default="TODO: justify",
+                    help="justification recorded for NEW baseline "
+                         "entries with --write-baseline")
+    ap.add_argument("--select", action="append", default=None,
+                    metavar="CODE",
+                    help="only run/report codes with this prefix "
+                         "(repeatable): --select SC2 --select SC301")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--list-codes", action="store_true",
+                    help="list finding codes and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_codes:
+        for p in all_passes():
+            print(f"[{p.name}]")
+            for code, desc in sorted(p.codes.items()):
+                print(f"  {code}  {desc}")
+        return 0
+
+    if args.paths:
+        paths = args.paths
+        root = args.root or find_repo_root(paths[0])
+    else:
+        root = args.root or find_repo_root(
+            os.path.dirname(os.path.abspath(__file__)))
+        paths = [os.path.join(root, "scanner_tpu")]
+
+    if args.write_baseline and args.select:
+        # a selected subset cannot see the other codes' findings, so a
+        # rewrite would silently drop their (justified) baseline entries
+        print("scanner-check: --write-baseline cannot be combined with "
+              "--select (it would erase baseline entries outside the "
+              "selection)", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+    try:
+        baseline = {} if args.no_baseline else load_baseline(baseline_path)
+    except BaselineError as e:
+        print(f"scanner-check: baseline error: {e}", file=sys.stderr)
+        return 2
+
+    project, findings = analyze(paths, root=root, select=args.select)
+    res = split_findings(project, findings, baseline)
+    if args.select:
+        # a selected run can't see the other codes' findings, so their
+        # baseline entries would all look stale — don't claim they are
+        res.stale_baseline = []
+
+    if args.write_baseline:
+        new = write_baseline(baseline_path,
+                             res.unsuppressed + res.baselined,
+                             previous=baseline,
+                             justification=args.justification)
+        print(f"scanner-check: baseline written to {baseline_path} "
+              f"({len(res.unsuppressed) + len(res.baselined)} entries, "
+              f"{new} new)")
+        if new and args.justification.upper().startswith("TODO"):
+            print("scanner-check: new entries carry a TODO justification "
+                  "— edit them in or the baseline will not load",
+                  file=sys.stderr)
+        return 0
+
+    counts: dict = {}
+    for f in res.unsuppressed:
+        counts[f.code] = counts.get(f.code, 0) + 1
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in res.unsuppressed],
+            "counts": counts,
+            "baselined": len(res.baselined),
+            "inline_suppressed": len(res.inline_suppressed),
+            "stale_baseline": res.stale_baseline,
+            "files_analyzed": len(project.modules),
+        }, indent=1))
+    else:
+        for f in res.unsuppressed:
+            print(f.format())
+        bits = [f"{len(project.modules)} files",
+                f"{len(res.unsuppressed)} finding(s)"]
+        if res.baselined:
+            bits.append(f"{len(res.baselined)} baselined")
+        if res.inline_suppressed:
+            bits.append(f"{len(res.inline_suppressed)} suppressed inline")
+        if res.stale_baseline:
+            bits.append(f"{len(res.stale_baseline)} STALE baseline "
+                        "entries (prune with --write-baseline)")
+        print("scanner-check: " + ", ".join(bits))
+
+    return 1 if res.unsuppressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,566 @@
+"""Contract lints: code ↔ docs ↔ wiring drift (SC301–SC307).
+
+Operational surfaces (the metric catalog, env-var and config knobs,
+fault-injection sites, the RPC method table) are contracts: dashboards,
+deploy manifests, chaos plans, and runbooks are written against them.
+Nothing but convention keeps them in sync with the source — so these
+passes make each one checkable:
+
+  SC301  metric series registered in source but missing from the
+         docs/observability.md catalog (or catalogued but gone)
+  SC302  metric naming contract: `scanner_tpu_[a-z0-9_]+`, counters end
+         `_total`, every series carries a help string
+  SC303  `SCANNER_TPU_*` env var read in source but undocumented under
+         docs/ (or documented but never read)
+  SC304  config `[section] key` read that `config.default_config()`
+         doesn't declare, or a declared key no doc page mentions
+  SC305  fault-injection drift: `faults.inject("site")` literal not in
+         `faults.SITES`, a SITES entry with no wired hook, or a
+         NAMED_PLANS clause naming an unknown site
+  SC306  RPC drift: a client `.call("Method")` no server registers, or
+         a registered handler nothing in the repo ever invokes
+  SC307  RPC classification: every registered handler needs an
+         `RPC_CONTRACTS` entry (timeout class + idempotency — what the
+         retry/backoff layer is allowed to do with it)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import AnalysisPass, Finding, ModuleInfo, Project
+from .tracer import dotted_name
+
+_SERIES_RE = re.compile(r"scanner_tpu_[a-z0-9_]*[a-z0-9]")
+_SERIES_OK_RE = re.compile(r"scanner_tpu_[a-z0-9_]+\Z")
+_ENV_RE = re.compile(r"SCANNER_TPU_[A-Z0-9_]*[A-Z0-9]")
+# prometheus exposition suffixes a doc may legitimately mention
+_EXPOSITION_SUFFIXES = ("_bucket", "_sum", "_count")
+
+_REG_KINDS = ("counter", "gauge", "histogram")
+
+
+def _const_str(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _read_doc(project: Project, name: str) -> str:
+    p = os.path.join(project.root, "docs", name)
+    if os.path.exists(p):
+        with open(p, encoding="utf-8") as f:
+            return f.read()
+    return ""
+
+
+# ---------------------------------------------------------------------------
+# metric registrations
+# ---------------------------------------------------------------------------
+
+class _Registration:
+    def __init__(self, mod: ModuleInfo, node: ast.Call, kind: str,
+                 name: Optional[str], help_arg: Optional[ast.AST]):
+        self.mod = mod
+        self.node = node
+        self.kind = kind
+        self.name = name
+        self.help_arg = help_arg
+
+
+def _metric_registrations(mod: ModuleInfo) -> List[_Registration]:
+    out = []
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _REG_KINDS):
+            continue
+        base = node.func.value
+        if isinstance(base, ast.Call):
+            base_ok = (dotted_name(base.func) or "").split(".")[-1] \
+                == "registry"
+        else:
+            # module-level singleton idiom: _REGISTRY.gauge(...)
+            base_ok = (dotted_name(base) or "").split(".")[-1] \
+                .lower().lstrip("_") == "registry"
+        if not base_ok:
+            continue
+        name = _const_str(node.args[0]) if node.args else None
+        help_arg = node.args[1] if len(node.args) > 1 else None
+        out.append(_Registration(mod, node, node.func.attr, name,
+                                 help_arg))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# env reads
+# ---------------------------------------------------------------------------
+
+def _env_reads(mod: ModuleInfo) -> List[Tuple[str, ast.AST]]:
+    """(var, node) for every SCANNER_TPU_* read through os.environ /
+    environ / env (.get / [] / .pop)."""
+    out: List[Tuple[str, ast.AST]] = []
+
+    def is_env_base(e: ast.AST) -> bool:
+        d = dotted_name(e) or ""
+        return d.split(".")[-1] in ("environ",) or d in ("env",)
+
+    for node in ast.walk(mod.tree):
+        var: Optional[str] = None
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute) \
+                and node.func.attr in ("get", "pop") \
+                and is_env_base(node.func.value) and node.args:
+            var = _const_str(node.args[0])
+        elif isinstance(node, ast.Subscript) and is_env_base(node.value):
+            var = _const_str(node.slice)
+        if var and _ENV_RE.fullmatch(var):
+            out.append((var, node))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# config reads
+# ---------------------------------------------------------------------------
+
+def _default_config_keys(mod: ModuleInfo) -> Set[Tuple[str, str]]:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.FunctionDef) \
+                and node.name == "default_config":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Return) and isinstance(
+                        sub.value, ast.Dict):
+                    keys: Set[Tuple[str, str]] = set()
+                    for sk, sv in zip(sub.value.keys, sub.value.values):
+                        sec = _const_str(sk)
+                        if sec is None or not isinstance(sv, ast.Dict):
+                            continue
+                        for kk in sv.keys:
+                            k = _const_str(kk)
+                            if k is not None:
+                                keys.add((sec, k))
+                    return keys
+    return set()
+
+
+def _config_reads(mod: ModuleInfo) -> List[Tuple[str, str, ast.AST]]:
+    """(section, key, node) for config dict reads:
+    cfg["sec"]["key"], cfg.get("sec", {}).get("key", d), and one level
+    of local aliasing (n = cfg["sec"]; n.get("key"))."""
+    out: List[Tuple[str, str, ast.AST]] = []
+
+    def is_cfg_base(e: ast.AST) -> bool:
+        if isinstance(e, ast.Attribute) and e.attr == "config":
+            return True
+        return isinstance(e, ast.Name) and e.id in ("cfg", "config")
+
+    def section_of(e: ast.AST) -> Optional[str]:
+        """'storage' if e is <cfg-base>["storage"] or
+        <cfg-base>.get("storage", ...)"""
+        if isinstance(e, ast.Subscript) and is_cfg_base(e.value):
+            return _const_str(e.slice)
+        if isinstance(e, ast.Call) and isinstance(e.func, ast.Attribute) \
+                and e.func.attr in ("get", "setdefault") \
+                and is_cfg_base(e.func.value) and e.args:
+            return _const_str(e.args[0])
+        return None
+
+    for fn in [n for n in ast.walk(mod.tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+        aliases: Dict[str, str] = {}
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name):
+                sec = section_of(sub.value)
+                if sec is not None:
+                    aliases[sub.targets[0].id] = sec
+
+        def base_section(e: ast.AST) -> Optional[str]:
+            sec = section_of(e)
+            if sec is not None:
+                return sec
+            if isinstance(e, ast.Name):
+                return aliases.get(e.id)
+            return None
+
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Subscript):
+                sec = base_section(sub.value)
+                key = _const_str(sub.slice)
+                if sec is not None and key is not None:
+                    out.append((sec, key, sub))
+            elif isinstance(sub, ast.Call) and isinstance(
+                    sub.func, ast.Attribute) and sub.func.attr == "get" \
+                    and sub.args:
+                sec = base_section(sub.func.value)
+                key = _const_str(sub.args[0])
+                if sec is not None and key is not None:
+                    out.append((sec, key, sub))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fault sites
+# ---------------------------------------------------------------------------
+
+def _module_tuple(mod: ModuleInfo, name: str) -> Optional[List[str]]:
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and stmt.targets[0].id == name \
+                and isinstance(stmt.value, (ast.Tuple, ast.List)):
+            vals = [_const_str(e) for e in stmt.value.elts]
+            return [v for v in vals if v is not None]
+    return None
+
+
+def _module_str_dict(mod: ModuleInfo, name: str
+                     ) -> Optional[Dict[str, str]]:
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and stmt.targets[0].id == name \
+                and isinstance(stmt.value, ast.Dict):
+            out = {}
+            for k, v in zip(stmt.value.keys, stmt.value.values):
+                ks, vs = _const_str(k), _const_str(v)
+                if ks is not None and vs is not None:
+                    out[ks] = vs
+            return out
+    return None
+
+
+# ---------------------------------------------------------------------------
+# rpc surface
+# ---------------------------------------------------------------------------
+
+def _rpc_registrations(mod: ModuleInfo) -> List[Tuple[str, ast.AST]]:
+    """(method_name, dict_key_node) from RpcServer(service, {...})."""
+    out: List[Tuple[str, ast.AST]] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) \
+                and (dotted_name(node.func) or "").split(".")[-1] \
+                == "RpcServer" and len(node.args) >= 2 \
+                and isinstance(node.args[1], ast.Dict):
+            for k in node.args[1].keys:
+                name = _const_str(k)
+                if name is not None:
+                    out.append((name, k))
+    return out
+
+
+def _rpc_invocations(mod: ModuleInfo) -> List[Tuple[str, ast.AST]]:
+    out: List[Tuple[str, ast.AST]] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute) \
+                and node.func.attr in ("call", "try_call") and node.args:
+            name = _const_str(node.args[0])
+            if name is not None:
+                out.append((name, node))
+    return out
+
+
+_AUX_CALL_RE = re.compile(r"\.(?:try_)?call\(\s*['\"]([A-Za-z_][\w]*)")
+
+
+# ---------------------------------------------------------------------------
+# the pass
+# ---------------------------------------------------------------------------
+
+class ContractPass(AnalysisPass):
+    name = "contracts"
+    codes = {
+        "SC301": "metric series out of sync with docs/observability.md",
+        "SC302": "metric naming/help contract violation",
+        "SC303": "SCANNER_TPU_* env var out of sync with docs/",
+        "SC304": "config key read undeclared or undocumented",
+        "SC305": "fault-injection site drift (SITES vs wired hooks)",
+        "SC306": "RPC method drift (called vs registered)",
+        "SC307": "RPC handler missing RPC_CONTRACTS classification",
+    }
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        out.extend(self._metrics(project))
+        out.extend(self._env_vars(project))
+        out.extend(self._config_keys(project))
+        out.extend(self._fault_sites(project))
+        out.extend(self._rpc_surface(project))
+        return out
+
+    # -- SC301 / SC302 ---------------------------------------------------
+
+    def _metrics(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        doc = _read_doc(project, "observability.md")
+        doc_names = {n for n in _SERIES_RE.findall(doc)}
+        registered: Dict[str, _Registration] = {}
+        for mod in project.modules:
+            for reg in _metric_registrations(mod):
+                if reg.name is None:
+                    # dynamic name: can't check statically — flag it,
+                    # the whole catalog idea depends on literal names
+                    out.append(mod.finding(
+                        "SC302",
+                        f"{reg.kind}() with a non-literal series name — "
+                        "series must be static so the catalog lint can "
+                        "see them", reg.node))
+                    continue
+                registered.setdefault(reg.name, reg)
+                if not _SERIES_OK_RE.fullmatch(reg.name):
+                    out.append(mod.finding(
+                        "SC302",
+                        f"series `{reg.name}` does not match "
+                        "scanner_tpu_[a-z0-9_]+", reg.node))
+                elif reg.kind == "counter" \
+                        and not reg.name.endswith("_total"):
+                    out.append(mod.finding(
+                        "SC302",
+                        f"counter `{reg.name}` should end `_total`",
+                        reg.node))
+                help_str = _const_str(reg.help_arg)
+                if help_str is None or not help_str.strip():
+                    out.append(mod.finding(
+                        "SC302",
+                        f"series `{reg.name}` lacks a help string",
+                        reg.node))
+                if doc and reg.name not in doc_names:
+                    out.append(mod.finding(
+                        "SC301",
+                        f"series `{reg.name}` is not catalogued in "
+                        "docs/observability.md", reg.node))
+        if doc and registered:
+            base_doc_names = set()
+            for n in doc_names:
+                for suf in _EXPOSITION_SUFFIXES:
+                    if n.endswith(suf) and n[:-len(suf)] in doc_names:
+                        break
+                else:
+                    base_doc_names.add(n)
+            for name in sorted(base_doc_names - set(registered)):
+                for suf in _EXPOSITION_SUFFIXES:
+                    if name.endswith(suf) and name[:-len(suf)] \
+                            in registered:
+                        break
+                else:
+                    out.append(Finding(
+                        code="SC301",
+                        message=f"docs/observability.md catalogues "
+                                f"`{name}` but no source registers it",
+                        path="docs/observability.md", line=1, scope="",
+                        snippet=name))
+        return out
+
+    # -- SC303 -----------------------------------------------------------
+
+    def _env_vars(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        docs = project.docs_text()
+        doc_vars = set(_ENV_RE.findall(docs))
+        read_vars: Set[str] = set()
+        for mod in project.modules:
+            for var, node in _env_reads(mod):
+                read_vars.add(var)
+                if docs and var not in doc_vars:
+                    out.append(mod.finding(
+                        "SC303",
+                        f"env var `{var}` is read here but documented "
+                        "nowhere under docs/ — knobs nobody can find "
+                        "don't exist", node))
+        if docs and read_vars:
+            # vars also appear in code as manifest WRITES (deploy.py) and
+            # plain mentions; only flag doc vars never read anywhere in
+            # the analyzed source or auxiliary text
+            aux = project.aux_source_text() + "".join(
+                m.source for m in project.modules)
+            for var in sorted(doc_vars - read_vars):
+                if var not in aux:
+                    out.append(Finding(
+                        code="SC303",
+                        message=f"docs mention env var `{var}` but "
+                                "nothing reads it",
+                        path="docs", line=1, scope="", snippet=var))
+        return out
+
+    # -- SC304 -----------------------------------------------------------
+
+    def _config_keys(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        cfg_mod = None
+        for m in project.modules:
+            if m.relpath.endswith("config.py") \
+                    and _default_config_keys(m):
+                cfg_mod = m
+                break
+        if cfg_mod is None:
+            return out
+        declared = _default_config_keys(cfg_mod)
+        docs = project.docs_text()
+        # reads anywhere in the analyzed tree must be declared keys
+        # (master_address-style alternates must still be declared or
+        # documented)
+        declared_keys = {k for _s, k in declared}
+        for mod in project.modules:
+            for sec, key, node in _config_reads(mod):
+                if (sec, key) in declared:
+                    continue
+                if key in declared_keys:
+                    continue  # cross-section helper access patterns
+                if docs and re.search(rf"\b{re.escape(key)}\b", docs):
+                    continue  # undeclared but documented alternate
+                out.append(mod.finding(
+                    "SC304",
+                    f"config read `[{sec}] {key}` is neither declared "
+                    "in config.default_config() nor documented under "
+                    "docs/", node))
+        if docs:
+            for sec, key in sorted(declared):
+                if not re.search(rf"\b{re.escape(key)}\b", docs):
+                    out.append(cfg_mod.finding(
+                        "SC304",
+                        f"config key `[{sec}] {key}` is declared in "
+                        "default_config() but no docs/ page mentions it",
+                        cfg_mod.tree))
+        return out
+
+    # -- SC305 -----------------------------------------------------------
+
+    def _fault_sites(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        fmod = project.module("util/faults.py")
+        if fmod is None:
+            return out
+        sites = _module_tuple(fmod, "SITES")
+        if not sites:
+            return out
+        site_set = set(sites)
+        hooked: Set[str] = set()
+        for mod in project.modules:
+            if mod is fmod:
+                continue
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) \
+                        and (dotted_name(node.func) or "").split(".")[-1] \
+                        == "inject" and node.args:
+                    site = _const_str(node.args[0])
+                    if site is None:
+                        continue
+                    hooked.add(site)
+                    if site not in site_set:
+                        out.append(mod.finding(
+                            "SC305",
+                            f"faults.inject({site!r}) names a site "
+                            "missing from faults.SITES — install() will "
+                            "reject every plan targeting it", node))
+        for site in sites:
+            if site not in hooked:
+                out.append(fmod.finding(
+                    "SC305",
+                    f"faults.SITES entry `{site}` has no wired "
+                    "inject() hook — plans targeting it arm nothing "
+                    "and chaos tests pass vacuously", fmod.tree))
+        plans = _module_str_dict(fmod, "NAMED_PLANS") or {}
+        data_sites = _module_tuple(fmod, "DATA_SITES") or []
+        for name, plan in plans.items():
+            for clause in plan.split(";"):
+                site = clause.strip().split(":", 1)[0]
+                if site and site not in site_set:
+                    out.append(fmod.finding(
+                        "SC305",
+                        f"NAMED_PLANS[{name!r}] targets unknown site "
+                        f"`{site}`", fmod.tree))
+        for site in data_sites:
+            if site not in site_set:
+                out.append(fmod.finding(
+                    "SC305",
+                    f"DATA_SITES entry `{site}` is not in SITES",
+                    fmod.tree))
+        return out
+
+    # -- SC306 / SC307 ---------------------------------------------------
+
+    def _rpc_surface(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        registered: Dict[str, Tuple[ModuleInfo, ast.AST]] = {}
+        for mod in project.modules:
+            for name, node in _rpc_registrations(mod):
+                registered[name] = (mod, node)
+        if not registered:
+            return out
+        invoked: Set[str] = set()
+        for mod in project.modules:
+            for name, node in _rpc_invocations(mod):
+                invoked.add(name)
+                if name not in registered:
+                    out.append(mod.finding(
+                        "SC306",
+                        f"RPC `{name}` is called here but no RpcServer "
+                        "registers a handler for it (typo or dead "
+                        "method?)", node))
+        invoked |= set(_AUX_CALL_RE.findall(project.aux_source_text()))
+        # indirection idiom: wait_for_server(addr, svc, method="Ping")
+        # invokes via a parameter — count string defaults of args named
+        # `method` as invocations
+        for mod in project.modules:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                a = node.args
+                pos = a.args[len(a.args) - len(a.defaults):]
+                for arg, dflt in list(zip(pos, a.defaults)) + [
+                        (ka, kd) for ka, kd in zip(a.kwonlyargs,
+                                                   a.kw_defaults)
+                        if kd is not None]:
+                    if arg.arg == "method":
+                        s = _const_str(dflt)
+                        if s:
+                            invoked.add(s)
+        for name, (mod, node) in sorted(registered.items()):
+            if name not in invoked:
+                out.append(mod.finding(
+                    "SC306",
+                    f"RPC handler `{name}` is registered but never "
+                    "invoked by any client in the repo (incl. tests/ "
+                    "and tools/)", node))
+        # SC307: classification table
+        contracts: Optional[Dict[str, ast.AST]] = None
+        cmod: Optional[ModuleInfo] = None
+        for mod in project.modules:
+            for stmt in mod.tree.body:
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) \
+                        == 1 and isinstance(stmt.targets[0], ast.Name) \
+                        and stmt.targets[0].id == "RPC_CONTRACTS" \
+                        and isinstance(stmt.value, ast.Dict):
+                    contracts = {}
+                    for k in stmt.value.keys:
+                        ks = _const_str(k)
+                        if ks is not None:
+                            contracts[ks] = k
+                    cmod = mod
+        if contracts is None:
+            anchor_mod, anchor_node = next(iter(registered.values()))
+            out.append(anchor_mod.finding(
+                "SC307",
+                "RPC handlers are registered but no RPC_CONTRACTS "
+                "table declares their timeout/idempotency classes — "
+                "the retry layer is flying blind", anchor_node))
+            return out
+        for name, (mod, node) in sorted(registered.items()):
+            if name not in contracts:
+                out.append(mod.finding(
+                    "SC307",
+                    f"RPC handler `{name}` has no RPC_CONTRACTS entry "
+                    "(timeout class + idempotency)", node))
+        for name in sorted(contracts):
+            if name not in registered:
+                assert cmod is not None
+                out.append(cmod.finding(
+                    "SC307",
+                    f"RPC_CONTRACTS entry `{name}` matches no "
+                    "registered handler", contracts[name]))
+        return out

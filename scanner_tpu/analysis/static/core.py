@@ -1,0 +1,362 @@
+"""Pass framework for the repo-native static analyzer (scanner-check).
+
+The engine promises its users that scheduling, shape stability, and
+fault tolerance are the engine's problem — which means the properties
+those promises rest on must hold *of the engine's own source*.  This
+module is the skeleton that lets each property be written as a small
+AST pass:
+
+  * `ModuleInfo` — one parsed source file: AST with parent/scope maps,
+    raw lines, inline-suppression lookup;
+  * `Project` — the set of modules under analysis plus repo context the
+    contract passes need (docs text, repo root);
+  * `AnalysisPass` — base class; a pass walks the project and returns
+    `Finding`s, each tagged with a stable code (SCxxx);
+  * suppression — inline (`# scanner-check: disable=SC202 reason`) for
+    single sites, or a committed JSON baseline whose entries carry
+    line-number-independent fingerprints plus a mandatory one-line
+    justification (reviewed like code).
+
+Passes live in tracer.py / concurrency.py / contracts.py; the CLI in
+cli.py (tools/scanner_check.py and the `scanner-check` console script
+both call it).  docs/static-analysis.md is the user-facing page.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Finding", "ModuleInfo", "Project", "AnalysisPass",
+    "load_baseline", "write_baseline", "BaselineError",
+    "split_findings", "find_repo_root",
+]
+
+# inline suppression: a trailing comment on the offending line —
+#   x = np.sum(y)  # scanner-check: disable=SC101 host reduction is intended
+_SUPPRESS_RE = re.compile(
+    r"#\s*scanner-check:\s*disable=([A-Z0-9,\s]+?)(?:\s+\S.*)?$")
+# whole-file opt-out (generated files, vendored code) in the first lines
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*scanner-check:\s*disable-file=([A-Z0-9,\s]+?)(?:\s+\S.*)?$")
+_FILE_PRAGMA_WINDOW = 10
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer hit.  `fingerprint` is stable under unrelated edits:
+    it hashes the *snippet text* (whitespace-collapsed), not the line
+    number, so a baseline survives code moving around it."""
+
+    code: str          # e.g. "SC202"
+    message: str
+    path: str          # repo-relative, forward slashes
+    line: int          # 1-based
+    scope: str         # enclosing Class.method / function qualname, or ""
+    snippet: str = ""  # source line the finding anchors to
+
+    @property
+    def fingerprint(self) -> str:
+        norm = " ".join(self.snippet.split())
+        h = hashlib.sha1(
+            f"{self.code}|{self.path}|{self.scope}|{norm}".encode()
+        ).hexdigest()[:12]
+        return f"{self.code}:{self.path}:{self.scope or '<module>'}:{h}"
+
+    def format(self) -> str:
+        where = f" [{self.scope}]" if self.scope else ""
+        return f"{self.path}:{self.line}: {self.code} {self.message}{where}"
+
+    def to_dict(self) -> dict:
+        return {"code": self.code, "message": self.message,
+                "path": self.path, "line": self.line, "scope": self.scope,
+                "snippet": self.snippet, "fingerprint": self.fingerprint}
+
+
+class ModuleInfo:
+    """One parsed python file plus the lookups every pass needs."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        self._scopes: Dict[ast.AST, str] = {}
+        self._index(self.tree, None, ())
+        self._file_suppressed = self._file_pragmas()
+
+    @classmethod
+    def parse(cls, path: str, root: str) -> "ModuleInfo":
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        return cls(path, os.path.relpath(path, root), src)
+
+    def _index(self, node: ast.AST, parent: Optional[ast.AST],
+               scope: Tuple[str, ...]) -> None:
+        if parent is not None:
+            self._parents[node] = parent
+        self._scopes[node] = ".".join(scope)
+        child_scope = scope
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            child_scope = scope + (node.name,)
+        for child in ast.iter_child_nodes(node):
+            self._index(child, node, child_scope)
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def scope_of(self, node: ast.AST) -> str:
+        """Qualname of the class/function enclosing `node` (the node's
+        own name included when it is itself a def/class)."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            base = self._scopes.get(node, "")
+            return f"{base}.{node.name}" if base else node.name
+        return self._scopes.get(node, "")
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def _file_pragmas(self) -> Set[str]:
+        codes: Set[str] = set()
+        for text in self.lines[:_FILE_PRAGMA_WINDOW]:
+            m = _SUPPRESS_FILE_RE.search(text)
+            if m:
+                codes.update(c.strip() for c in m.group(1).split(",")
+                             if c.strip())
+        return codes
+
+    def suppressed(self, code: str, lineno: int) -> bool:
+        """Inline suppression on the finding's own line (or the file
+        pragma).  `ALL` disables every code."""
+        if self._file_suppressed & {code, "ALL"}:
+            return True
+        m = _SUPPRESS_RE.search(self.line_text(lineno))
+        if not m:
+            return False
+        codes = {c.strip() for c in m.group(1).split(",")}
+        return bool(codes & {code, "ALL"})
+
+    def finding(self, code: str, message: str, node: ast.AST) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(code=code, message=message, path=self.relpath,
+                       line=line, scope=self.scope_of(node),
+                       snippet=self.line_text(line).strip())
+
+
+def find_repo_root(start: str) -> str:
+    """Walk up from `start` to the checkout root (setup.py/pytest.ini)."""
+    d = os.path.abspath(start)
+    if os.path.isfile(d):
+        d = os.path.dirname(d)
+    while True:
+        if any(os.path.exists(os.path.join(d, probe))
+               for probe in ("setup.py", "pytest.ini", ".git")):
+            return d
+        up = os.path.dirname(d)
+        if up == d:
+            return os.path.abspath(start)
+        d = up
+
+
+def _collect_py(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"
+                               and not d.startswith(".")]
+                out.extend(os.path.join(dirpath, f)
+                           for f in filenames if f.endswith(".py"))
+        elif p.endswith(".py"):
+            out.append(p)
+    return sorted(set(os.path.abspath(p) for p in out))
+
+
+class Project:
+    """Everything a pass may look at: the parsed modules plus repo-level
+    context (docs, auxiliary source trees) for the contract passes."""
+
+    def __init__(self, paths: Sequence[str], root: Optional[str] = None):
+        files = _collect_py(paths)
+        if not files and not root:
+            raise ValueError(f"no python files under {list(paths)}")
+        self.root = os.path.abspath(
+            root if root is not None
+            else find_repo_root(files[0] if files else "."))
+        self.modules: List[ModuleInfo] = []
+        self.parse_errors: List[Finding] = []
+        for f in files:
+            try:
+                self.modules.append(ModuleInfo.parse(f, self.root))
+            except SyntaxError as e:
+                rel = os.path.relpath(f, self.root).replace(os.sep, "/")
+                self.parse_errors.append(Finding(
+                    code="SC001", message=f"file does not parse: {e.msg}",
+                    path=rel, line=e.lineno or 1, scope=""))
+        self._docs_text: Optional[str] = None
+        self._aux_sources: Optional[str] = None
+
+    def module(self, rel_suffix: str) -> Optional[ModuleInfo]:
+        """Find a module by repo-relative path suffix
+        (e.g. 'util/faults.py')."""
+        for m in self.modules:
+            if m.relpath.endswith(rel_suffix):
+                return m
+        return None
+
+    def docs_text(self) -> str:
+        """Concatenated markdown under <root>/docs — the documentation
+        side of every code↔docs contract."""
+        if self._docs_text is None:
+            parts = []
+            docs = os.path.join(self.root, "docs")
+            if os.path.isdir(docs):
+                for name in sorted(os.listdir(docs)):
+                    if name.endswith(".md"):
+                        with open(os.path.join(docs, name),
+                                  encoding="utf-8") as f:
+                            parts.append(f.read())
+            self._docs_text = "\n".join(parts)
+        return self._docs_text
+
+    def aux_source_text(self) -> str:
+        """Raw text of tests/ and tools/ (not AST-analyzed — they are
+        consumers, not the analyzed surface) so contract passes can tell
+        'registered but unused anywhere' from 'used only by tests'."""
+        if self._aux_sources is None:
+            parts = []
+            for sub in ("tests", "tools", "examples"):
+                d = os.path.join(self.root, sub)
+                if not os.path.isdir(d):
+                    continue
+                for dirpath, dirnames, filenames in os.walk(d):
+                    dirnames[:] = [x for x in dirnames
+                                   if x != "__pycache__"]
+                    for fn in filenames:
+                        if fn.endswith(".py"):
+                            with open(os.path.join(dirpath, fn),
+                                      encoding="utf-8") as f:
+                                parts.append(f.read())
+            self._aux_sources = "\n".join(parts)
+        return self._aux_sources
+
+
+class AnalysisPass:
+    """Base class: subclasses set `name`, document their `codes`, and
+    implement run().  Finding codes are the stable public surface —
+    suppressions and baselines refer to them, so codes are never
+    renumbered."""
+
+    name: str = ""
+    codes: Dict[str, str] = {}
+
+    def run(self, project: Project) -> List[Finding]:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+class BaselineError(Exception):
+    pass
+
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    """fingerprint -> entry.  Every entry must carry a non-empty
+    one-line justification: the baseline is a reviewed list of accepted
+    exceptions, not a dumping ground."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    entries = doc.get("entries", [])
+    out: Dict[str, dict] = {}
+    for e in entries:
+        fp = e.get("fingerprint")
+        if not fp:
+            raise BaselineError(f"{path}: entry without fingerprint: {e}")
+        just = (e.get("justification") or "").strip()
+        if not just or just.upper().startswith("TODO"):
+            raise BaselineError(
+                f"{path}: entry {fp} lacks a justification — every "
+                "baselined finding needs a one-line reason")
+        out[fp] = e
+    return out
+
+
+def write_baseline(path: str, findings: Iterable[Finding],
+                   previous: Optional[Dict[str, dict]] = None,
+                   justification: str = "TODO: justify") -> int:
+    """(Re)write the baseline from `findings`, keeping justifications of
+    entries that persist from `previous`.  Returns the number of NEW
+    entries (which carry the placeholder/bulk `justification` and must
+    be edited before load_baseline will accept the file, unless a real
+    justification was passed)."""
+    previous = previous or {}
+    entries, new = [], 0
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.code)):
+        old = previous.get(f.fingerprint)
+        if old is None:
+            new += 1
+        entries.append({
+            "fingerprint": f.fingerprint,
+            "code": f.code,
+            "path": f.path,
+            "scope": f.scope,
+            "message": f.message,
+            "justification": (old or {}).get("justification",
+                                             justification),
+        })
+    doc = {"comment": "scanner-check accepted findings; every entry "
+                      "needs a one-line justification "
+                      "(docs/static-analysis.md)",
+           "entries": entries}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    return new
+
+
+@dataclass
+class SplitResult:
+    unsuppressed: List[Finding] = field(default_factory=list)
+    inline_suppressed: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    stale_baseline: List[str] = field(default_factory=list)
+
+
+def split_findings(project: Project, findings: Sequence[Finding],
+                   baseline: Optional[Dict[str, dict]] = None
+                   ) -> SplitResult:
+    """Partition raw findings into actionable / inline-suppressed /
+    baselined, and report baseline entries that no longer match
+    anything (stale — they should be pruned)."""
+    baseline = baseline or {}
+    by_path = {m.relpath: m for m in project.modules}
+    res = SplitResult()
+    seen_fps: Set[str] = set()
+    for f in findings:
+        seen_fps.add(f.fingerprint)
+        mod = by_path.get(f.path)
+        if mod is not None and mod.suppressed(f.code, f.line):
+            res.inline_suppressed.append(f)
+        elif f.fingerprint in baseline:
+            res.baselined.append(f)
+        else:
+            res.unsuppressed.append(f)
+    res.stale_baseline = sorted(set(baseline) - seen_fps)
+    return res

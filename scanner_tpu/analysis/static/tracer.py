@@ -1,0 +1,480 @@
+"""Tracer-safety / recompile lints (SC101–SC105).
+
+Jitted code is *traced*: python runs once with abstract values, and
+anything that escapes the tracer — a numpy reduction, an `if` on a
+data-dependent value, a wall-clock read — either crashes at trace time,
+silently bakes a stale constant into the executable, or (worst) forces
+a fresh XLA compile per call shape.  The engine's whole perf story
+(bucketed dispatch, the 8→3 executable reduction, persistent cache
+hits) assumes kernels are pure, shape-stable functions; these passes
+make that assumption reviewable.
+
+Codes
+  SC101  numpy call on a traced value inside jitted code
+  SC102  host control flow / concretization (`if`/`while`/`bool()`/
+         `int()`) on a traced value
+  SC103  nondeterminism inside jitted code (wall clock, `random`,
+         `np.random`, uuid, os.urandom)
+  SC104  mutable module global captured inside jitted code (trace-time
+         snapshot goes stale; mutation never reaches the executable)
+  SC105  raw-shape jitted call: a device-kernel `execute()` outside the
+         engine's bucketed dispatch, or a jitted function called with a
+         variable-length slice (every length mints an executable)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import AnalysisPass, Finding, ModuleInfo, Project
+
+# attributes of a traced array that are static (python values) at trace
+# time — touching them is how shape-dependent code SHOULD branch
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "sharding",
+                 "aval", "weak_type"}
+# builtins whose result is static even on traced args
+_STATIC_FUNCS = {"len", "isinstance", "issubclass", "type", "range",
+                 "hasattr", "getattr", "enumerate"}
+# traced-value methods returning static python values
+_STATIC_METHODS = {"item"}  # .item() concretizes — errors loudly on its own
+
+# dotted-suffix of a tracing wrapper -> indices of its function args
+_FN_ARG_WRAPPERS = {
+    "jit": (0,), "pmap": (0,), "vmap": (0,),
+    "shard_map": (0,),
+    "lax.scan": (0,),
+    "lax.while_loop": (0, 1),
+    "lax.fori_loop": (2,),
+    "lax.cond": (1, 2),
+    "lax.switch": (1,),
+    "pallas_call": (0,),
+    "checkpoint": (0,), "remat": (0,),
+}
+
+
+def _wrapper_fn_indices(name: Optional[str]) -> Optional[Tuple[int, ...]]:
+    """Function-arg indices if `name` (a dotted call target) is a
+    tracing wrapper; matched on trailing dotted components so jax.jit /
+    jax.lax.scan / pl.pallas_call all resolve however they're aliased."""
+    if not name:
+        return None
+    parts = name.split(".")
+    for pat, idxs in _FN_ARG_WRAPPERS.items():
+        pp = pat.split(".")
+        if parts[-len(pp):] == pp:
+            return idxs
+    return None
+
+_CLOCK_CALLS = {"time.time", "time.perf_counter", "time.monotonic",
+                "time.time_ns", "time.perf_counter_ns",
+                "datetime.datetime.now", "datetime.datetime.utcnow",
+                "datetime.now", "datetime.utcnow",
+                "uuid.uuid4", "uuid.uuid1", "os.urandom"}
+_MUTATOR_METHODS = {"append", "extend", "add", "update", "pop", "popitem",
+                    "remove", "discard", "clear", "insert", "setdefault"}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.lax.scan' for nested Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _import_aliases(mod: ModuleInfo) -> Dict[str, str]:
+    """local name -> dotted module/object it refers to."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def _resolve(mod_aliases: Dict[str, str], dotted: Optional[str]
+             ) -> Optional[str]:
+    """Rewrite the leading alias of a dotted name to its import target:
+    np.random.rand -> numpy.random.rand."""
+    if not dotted:
+        return None
+    head, _, rest = dotted.partition(".")
+    target = mod_aliases.get(head)
+    if target is None:
+        return dotted
+    return f"{target}.{rest}" if rest else target
+
+
+def _static_argnames(call: ast.Call) -> Set[str]:
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    names.add(n.value)
+    return names
+
+
+def _static_argnums(call: ast.Call) -> Set[int]:
+    nums: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg in ("static_argnums", "static_argnum"):
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    nums.add(n.value)
+    return nums
+
+
+class _JitContext:
+    def __init__(self, fn: ast.FunctionDef, static_names: Set[str],
+                 reason: str):
+        self.fn = fn
+        self.static_names = static_names
+        self.reason = reason  # what marked it jitted, for messages
+
+
+def _find_jit_contexts(mod: ModuleInfo, aliases: Dict[str, str]
+                       ) -> List[_JitContext]:
+    """Functions whose bodies run under a JAX trace: jit/pmap/vmap
+    decorated, functools.partial(jax.jit, ...) decorated, or passed by
+    name/position into jit wrappers (shard_map, lax control flow,
+    pallas_call)."""
+    defs_by_name: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.FunctionDef):
+            defs_by_name.setdefault(node.name, node)
+
+    ctxs: Dict[ast.FunctionDef, _JitContext] = {}
+
+    def mark(fn: ast.FunctionDef, static: Set[str], reason: str) -> None:
+        if fn not in ctxs:
+            ctxs[fn] = _JitContext(fn, static, reason)
+        else:
+            ctxs[fn].static_names |= static
+
+    def nums_to_names(fn: ast.FunctionDef, nums: Set[int]) -> Set[str]:
+        args = [a.arg for a in fn.args.args]
+        return {args[i] for i in nums if 0 <= i < len(args)}
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                d = _resolve(aliases, dotted_name(dec))
+                if d and d.split(".")[-1] in ("jit", "pmap", "vmap"):
+                    mark(node, set(), d)
+                elif isinstance(dec, ast.Call):
+                    inner = _resolve(aliases, dotted_name(dec.func))
+                    if inner and inner.split(".")[-1] == "partial" \
+                            and dec.args:
+                        wrapped = _resolve(aliases,
+                                           dotted_name(dec.args[0]))
+                        if wrapped and wrapped.split(".")[-1] in (
+                                "jit", "pmap"):
+                            static = _static_argnames(dec) | nums_to_names(
+                                node, _static_argnums(dec))
+                            mark(node, static, wrapped)
+                    elif inner and inner.split(".")[-1] in ("jit", "pmap",
+                                                            "vmap"):
+                        static = _static_argnames(dec) | nums_to_names(
+                            node, _static_argnums(dec))
+                        mark(node, static, inner)
+        elif isinstance(node, ast.Call):
+            d = dotted_name(node.func)
+            idxs = _wrapper_fn_indices(d) \
+                or _wrapper_fn_indices(_resolve(aliases, d))
+            if not idxs:
+                continue
+            for i in idxs:
+                if i < len(node.args) and isinstance(node.args[i],
+                                                     ast.Name):
+                    fn = defs_by_name.get(node.args[i].id)
+                    if fn is not None:
+                        static = _static_argnames(node) \
+                            | nums_to_names(fn, _static_argnums(node))
+                        mark(fn, static, d or "wrapper")
+    return list(ctxs.values())
+
+
+class _TracedExpr:
+    """Conservative 'does this expression carry a traced value'
+    evaluator over a set of known-traced local names."""
+
+    def __init__(self, traced: Set[str]):
+        self.traced = traced
+
+    def check(self, node: Optional[ast.AST]) -> bool:
+        if node is None:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.traced
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.check(node.value)
+        if isinstance(node, ast.Subscript):
+            # x[i] is traced if x is; shape[0] is static because .shape
+            # already returned False above
+            return self.check(node.value)
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func)
+            if fname in _STATIC_FUNCS:
+                return False
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr in _STATIC_METHODS:
+                    return False
+                if self.check(node.func.value):
+                    return True
+            return any(self.check(a) for a in node.args) or any(
+                self.check(kw.value) for kw in node.keywords)
+        if isinstance(node, (ast.BinOp,)):
+            return self.check(node.left) or self.check(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.check(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.check(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            return self.check(node.left) or any(
+                self.check(c) for c in node.comparators)
+        if isinstance(node, ast.IfExp):
+            return self.check(node.body) or self.check(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.check(e) for e in node.elts)
+        if isinstance(node, ast.Starred):
+            return self.check(node.value)
+        return False
+
+
+def _mutable_globals(mod: ModuleInfo) -> Set[str]:
+    """Module-level names bound to mutable containers AND mutated from
+    inside some function body (import-time population — the decorator
+    registry pattern — is fine: it happens before any trace)."""
+    mutable: Set[str] = set()
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            v = stmt.value
+            is_mut = isinstance(v, (ast.List, ast.Dict, ast.Set,
+                                    ast.ListComp, ast.DictComp,
+                                    ast.SetComp))
+            if isinstance(v, ast.Call):
+                ctor = dotted_name(v.func) or ""
+                is_mut = ctor.split(".")[-1] in (
+                    "list", "dict", "set", "defaultdict", "deque",
+                    "Counter", "OrderedDict", "bytearray")
+            if is_mut:
+                mutable.add(stmt.targets[0].id)
+    if not mutable:
+        return set()
+    mutated: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.AugAssign) and isinstance(
+                    sub.target, ast.Name) and sub.target.id in mutable:
+                mutated.add(sub.target.id)
+            elif isinstance(sub, (ast.Assign,)):
+                for t in sub.targets:
+                    if isinstance(t, ast.Subscript) and isinstance(
+                            t.value, ast.Name) and t.value.id in mutable:
+                        mutated.add(t.value.id)
+            elif isinstance(sub, ast.Call) and isinstance(
+                    sub.func, ast.Attribute) \
+                    and sub.func.attr in _MUTATOR_METHODS \
+                    and isinstance(sub.func.value, ast.Name) \
+                    and sub.func.value.id in mutable:
+                mutated.add(sub.func.value.id)
+    return mutable & mutated
+
+
+class TracerSafetyPass(AnalysisPass):
+    name = "tracer"
+    codes = {
+        "SC101": "numpy call on a traced value inside jitted code",
+        "SC102": "host control flow / concretization on a traced value",
+        "SC103": "nondeterminism (clock/random) inside jitted code",
+        "SC104": "mutable module global captured inside jitted code",
+        "SC105": "raw-shape jitted call bypassing bucketed dispatch",
+    }
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in project.modules:
+            aliases = _import_aliases(mod)
+            mut_globals = _mutable_globals(mod)
+            jitted_names: Set[str] = set()
+            for ctx in _find_jit_contexts(mod, aliases):
+                jitted_names.add(ctx.fn.name)
+                out.extend(self._check_context(mod, aliases, ctx,
+                                               mut_globals))
+            # names rebound from jit wrappers: f = jax.jit(g)
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Assign) and isinstance(
+                        node.value, ast.Call):
+                    d = _resolve(aliases, dotted_name(node.value.func))
+                    if d and d.split(".")[-1] in ("jit", "pmap"):
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                jitted_names.add(t.id)
+            out.extend(self._check_raw_shape_calls(mod, jitted_names))
+        return out
+
+    # -- SC101..SC104 over one jit context ------------------------------
+
+    def _check_context(self, mod: ModuleInfo, aliases: Dict[str, str],
+                       ctx: _JitContext, mut_globals: Set[str]
+                       ) -> List[Finding]:
+        fn = ctx.fn
+        out: List[Finding] = []
+        params = {a.arg for a in (fn.args.args + fn.args.posonlyargs
+                                  + fn.args.kwonlyargs)}
+        if fn.args.vararg:
+            params.add(fn.args.vararg.arg)
+        traced = params - ctx.static_names
+        te = _TracedExpr(traced)
+
+        # nested defs trace too; their params are (slices of) tracers
+        body_nodes = list(ast.walk(fn))
+        for sub in body_nodes:
+            if isinstance(sub, ast.FunctionDef) and sub is not fn:
+                traced.update(a.arg for a in sub.args.args)
+
+        # two propagation sweeps: handles simple forward def-use chains
+        # plus one level of later-defined helper use
+        for _ in range(2):
+            for sub in body_nodes:
+                if isinstance(sub, ast.Assign) and te.check(sub.value):
+                    for t in sub.targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                traced.add(n.id)
+                elif isinstance(sub, ast.AugAssign) and isinstance(
+                        sub.target, ast.Name):
+                    if te.check(sub.value) or sub.target.id in traced:
+                        traced.add(sub.target.id)
+                elif isinstance(sub, ast.For) and te.check(sub.iter):
+                    for n in ast.walk(sub.target):
+                        if isinstance(n, ast.Name):
+                            traced.add(n.id)
+
+        for sub in body_nodes:
+            if isinstance(sub, (ast.If, ast.While)) and te.check(sub.test):
+                kind = "while" if isinstance(sub, ast.While) else "if"
+                out.append(mod.finding(
+                    "SC102",
+                    f"host `{kind}` on traced value inside jitted "
+                    f"`{fn.name}` — use jnp.where/lax.cond (or branch on "
+                    ".shape/.ndim, which are static)", sub))
+            elif isinstance(sub, ast.Assert) and te.check(sub.test):
+                out.append(mod.finding(
+                    "SC102",
+                    f"assert on traced value inside jitted `{fn.name}` "
+                    "concretizes at trace time", sub))
+            elif isinstance(sub, ast.Call):
+                fname = dotted_name(sub.func)
+                resolved = _resolve(aliases, fname) or ""
+                root = (fname or "").split(".")[0]
+                root_target = aliases.get(root, root)
+                if fname in ("bool", "int", "float") and any(
+                        te.check(a) for a in sub.args):
+                    out.append(mod.finding(
+                        "SC102",
+                        f"`{fname}()` concretizes a traced value inside "
+                        f"jitted `{fn.name}`", sub))
+                elif root_target == "numpy" or resolved.startswith(
+                        "numpy."):
+                    if ".random" in f".{resolved}" or (
+                            fname or "").startswith(f"{root}.random."):
+                        out.append(mod.finding(
+                            "SC103",
+                            f"`{fname}` inside jitted `{fn.name}`: host "
+                            "RNG is drawn once at trace time — use "
+                            "jax.random with an explicit key", sub))
+                    elif any(te.check(a) for a in sub.args) or any(
+                            te.check(kw.value) for kw in sub.keywords):
+                        out.append(mod.finding(
+                            "SC101",
+                            f"`{fname}` applied to a traced value inside "
+                            f"jitted `{fn.name}` — numpy silently "
+                            "concretizes (ConcretizationTypeError at "
+                            "best, a baked-in constant at worst); use "
+                            "jnp", sub))
+                elif resolved in _CLOCK_CALLS or (
+                        fname or "") in _CLOCK_CALLS:
+                    out.append(mod.finding(
+                        "SC103",
+                        f"`{fname}` inside jitted `{fn.name}` is evaluated "
+                        "once at trace time (stale constant in the "
+                        "executable)", sub))
+                elif root_target == "random" and "." in (fname or ""):
+                    out.append(mod.finding(
+                        "SC103",
+                        f"`{fname}` inside jitted `{fn.name}`: host RNG "
+                        "inside a trace — use jax.random", sub))
+            elif isinstance(sub, ast.Global):
+                out.append(mod.finding(
+                    "SC104",
+                    f"`global {', '.join(sub.names)}` inside jitted "
+                    f"`{fn.name}`: writes never reach the compiled "
+                    "executable", sub))
+            elif isinstance(sub, ast.Name) and isinstance(
+                    sub.ctx, ast.Load) and sub.id in mut_globals \
+                    and sub.id not in traced and sub.id not in params:
+                out.append(mod.finding(
+                    "SC104",
+                    f"mutable module global `{sub.id}` read inside jitted "
+                    f"`{fn.name}` — captured as a trace-time snapshot; "
+                    "later mutations are silently ignored (pass it as an "
+                    "argument instead)", sub))
+        return out
+
+    # -- SC105 ----------------------------------------------------------
+
+    def _check_raw_shape_calls(self, mod: ModuleInfo,
+                               jitted_names: Set[str]) -> List[Finding]:
+        out: List[Finding] = []
+        in_engine_dispatch = mod.relpath.endswith("engine/evaluate.py")
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            # kernel.execute() outside engine/evaluate.py: the ONLY
+            # blessed device-kernel call path is bucketed dispatch
+            if isinstance(f, ast.Attribute) and f.attr == "execute" \
+                    and isinstance(f.value, ast.Attribute) \
+                    and f.value.attr == "kernel" \
+                    and not in_engine_dispatch:
+                out.append(mod.finding(
+                    "SC105",
+                    "direct device-kernel execute() outside "
+                    "engine/evaluate.py bypasses the bucket ladder — "
+                    "every novel call shape mints an XLA executable",
+                    node))
+                continue
+            # jitted_fn(x[:k]) with a non-constant slice bound: the call
+            # shape varies with k, defeating shape-stable dispatch
+            callee = f.id if isinstance(f, ast.Name) else None
+            if callee in jitted_names:
+                for a in node.args:
+                    if isinstance(a, ast.Subscript) and isinstance(
+                            a.slice, ast.Slice):
+                        bounds = (a.slice.lower, a.slice.upper)
+                        if any(b is not None and not isinstance(
+                                b, ast.Constant) for b in bounds):
+                            out.append(mod.finding(
+                                "SC105",
+                                f"jitted `{callee}` called with a "
+                                "variable-length slice — each length is "
+                                "a fresh (shape, dtype) signature / XLA "
+                                "compile; round up via "
+                                "engine.evaluate.bucket_for", node))
+                            break
+        return out

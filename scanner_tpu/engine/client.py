@@ -197,6 +197,18 @@ class Client:
         from ..util.metrics import merge_snapshots, registry
         return merge_snapshots({"client": registry().snapshot()})
 
+    def shutdown_cluster(self, workers: bool = True) -> int:
+        """Remotely stop the cluster this client is attached to: the
+        master forwards Shutdown to every registered worker (unless
+        workers=False), then stops itself — blocking start_master /
+        start_worker processes exit 0.  Returns the number of workers
+        that acknowledged.  Cluster mode only."""
+        if self._cluster is None:
+            raise ScannerException(
+                "shutdown_cluster requires cluster mode "
+                "(Client(master=...))")
+        return self._cluster.shutdown_cluster(workers=workers)
+
     # -- data management ----------------------------------------------------
 
     def ingest_videos(self, named_paths: Sequence, inplace: bool = False,

@@ -59,6 +59,34 @@ MAX_TRANSIENT_FAILURES = 25
 MASTER_SERVICE = "scanner.Master"
 WORKER_SERVICE = "scanner.Worker"
 
+# The wire contract of every registered RPC handler (both services):
+# the client-side deadline a caller should use, and whether the handler
+# is IDEMPOTENT — safe to blind-retry because a duplicate delivery
+# cannot double-apply (non-idempotent methods mutate queue/strike/
+# profile state and must only ride the UNAVAILABLE-only retry path,
+# where the request provably never reached the server).  scanner-check
+# SC307 enforces that this table and the registered handler dicts stay
+# in sync; new handlers must be classified here to land.
+RPC_CONTRACTS = {
+    "Ping":             {"timeout_s": PING_TIMEOUT, "idempotent": True},
+    "RegisterWorker":   {"timeout_s": 30.0, "idempotent": False},
+    "UnregisterWorker": {"timeout_s": PING_TIMEOUT, "idempotent": True},
+    "Heartbeat":        {"timeout_s": PING_TIMEOUT, "idempotent": True},
+    "NewJob":           {"timeout_s": 120.0, "idempotent": False},
+    "GetJob":           {"timeout_s": 30.0, "idempotent": True},
+    "NextWork":         {"timeout_s": 30.0, "idempotent": False},
+    "StartedWork":      {"timeout_s": 30.0, "idempotent": False},
+    "EvalDone":         {"timeout_s": 30.0, "idempotent": True},
+    "FinishedWork":     {"timeout_s": 30.0, "idempotent": False},
+    "FailedWork":       {"timeout_s": 30.0, "idempotent": False},
+    "GetJobStatus":     {"timeout_s": 30.0, "idempotent": True},
+    "GetMetrics":       {"timeout_s": 30.0, "idempotent": True},
+    "PokeWatchdog":     {"timeout_s": 30.0, "idempotent": True},
+    "PostProfile":      {"timeout_s": 30.0, "idempotent": False},
+    "GetProfiles":      {"timeout_s": 30.0, "idempotent": True},
+    "Shutdown":         {"timeout_s": PING_TIMEOUT, "idempotent": True},
+}
+
 _mlog = get_logger("master")
 _wlog = get_logger("worker")
 
@@ -790,8 +818,37 @@ class Master:
             return {"profiles": list(bulk.profiles) if bulk else []}
 
     def _rpc_shutdown(self, req: dict) -> dict:
+        """Remote cluster stop (Client.shutdown_cluster / blocking
+        start_master deployments).  Forwards Shutdown to every live
+        registered worker first (unless workers=False) — their blocking
+        wait_for_shutdown loops exit 0 — then releases this master's
+        own wait_for_shutdown.  Best-effort fan-out with the ping
+        deadline: an unreachable worker is already dead or draining."""
+        notified = 0
+        if req.get("workers", True):
+            from concurrent import futures as _fut
+
+            with self._lock:
+                targets = [w.address for w in self._workers.values()
+                           if w.active and w.address]
+
+            def poke(addr: str) -> bool:
+                c = rpc.RpcClient(addr, WORKER_SERVICE,
+                                  timeout=PING_TIMEOUT)
+                try:
+                    return c.try_call("Shutdown", retries=0) is not None
+                finally:
+                    c.close()
+
+            if targets:
+                # concurrent like _rpc_get_metrics: a fleet of
+                # unreachable workers each costs PING_TIMEOUT — serially
+                # that would blow the caller's Shutdown deadline
+                with _fut.ThreadPoolExecutor(
+                        max_workers=min(16, len(targets))) as pool:
+                    notified = sum(pool.map(poke, targets))
         self._shutdown.set()
-        return {"ok": True}
+        return {"ok": True, "workers_notified": notified}
 
     # -- bulk checkpoint / recovery -----------------------------------------
 
@@ -952,9 +1009,14 @@ class Master:
             if remaining:
                 bulk.queue[j] = deque(remaining)
                 bulk.job_rr.append(j)
-        self._bulk = bulk
-        self._history[bulk.bulk_id] = bulk
-        self._next_bulk_id = max(self._next_bulk_id, bulk.bulk_id + 1)
+        # published under the lock: _recover_bulk normally runs before
+        # the RPC server exists, but nothing in its signature promises
+        # that — and handler threads read these fields under _lock
+        with self._lock:
+            self._bulk = bulk
+            self._history[bulk.bulk_id] = bulk
+            self._next_bulk_id = max(self._next_bulk_id,
+                                     bulk.bulk_id + 1)
         # tasks finished before the crash may complete whole jobs (or the
         # whole bulk, if the crash hit between last-task and cleanup)
         for j in list(bulk.job_tasks):
@@ -1616,6 +1678,15 @@ class ClusterClient:
 
     def job_status(self, bulk_id: Optional[int] = None) -> dict:
         return self.master.call("GetJobStatus", bulk_id=bulk_id)
+
+    def shutdown_cluster(self, workers: bool = True) -> int:
+        """Stop the master — and, by default, every registered worker —
+        via the Shutdown RPC (the counterpart of blocking
+        start_master/start_worker deployments, whose wait_for_shutdown
+        loops exit on it).  Returns how many workers acknowledged."""
+        reply = self.master.call("Shutdown", workers=workers,
+                                 timeout=30.0)
+        return int(reply.get("workers_notified", 0))
 
     def close(self) -> None:
         self._watchdog_stop.set()

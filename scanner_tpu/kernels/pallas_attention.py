@@ -36,6 +36,16 @@ except Exception:  # pragma: no cover
 
 NEG_INF = -1e30
 
+# vma (varying-mesh-axes) tracking is a newer-jax feature: there,
+# ShapeDtypeStruct takes a `vma=` kwarg the ring path must set when
+# calling inside shard_map.  Old releases have no vma tracking at all —
+# the kwarg must simply be dropped (probed once, version-static).
+try:
+    jax.ShapeDtypeStruct((), jnp.float32, vma=frozenset())
+    _HAVE_VMA = True
+except TypeError:
+    _HAVE_VMA = False
+
 
 def _flash_kernel(offs_ref,                      # SMEM (2,): q_off, k_off
                   q_ref, k_ref, v_ref,           # VMEM tiles
@@ -121,7 +131,7 @@ def flash_block_update(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     bk = _block_size(Tk, block_k)
     offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
                       jnp.asarray(k_off, jnp.int32)])
-    vkw = {} if vma is None else {"vma": frozenset(vma)}
+    vkw = {} if vma is None or not _HAVE_VMA else {"vma": frozenset(vma)}
     grid = (BH, Tq // bq, Tk // bk)
     kern = functools.partial(_flash_kernel, causal=causal,
                              block_q=bq, block_k=bk)

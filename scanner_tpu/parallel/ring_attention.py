@@ -18,7 +18,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ..util.jaxenv import axis_size as _axis_size
+from ..util.jaxenv import pvary as _pvary
+from ..util.jaxenv import shard_map
 
 # single source of truth: the pallas kernel's masked-row guards compare
 # the m carry this module initializes against the same sentinel
@@ -46,7 +48,7 @@ def _ring_attention_block(q, k, v, axis_name: str, causal: bool,
     (B, H, Tl, block_k) instead of (B, Tl, Tl) per step — the long-T
     memory bound that makes ring attention worthwhile in the first
     place."""
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, Tl, H, D = q.shape
     s = scale if scale is not None else (D ** -0.5)
@@ -57,7 +59,7 @@ def _ring_attention_block(q, k, v, axis_name: str, causal: bool,
     # accumulators: running max m, normalizer l, weighted value sum acc.
     # pcast marks them device-varying over the ring axis so the fori_loop
     # carry types match (shard_map vma tracking).
-    vary = lambda x: jax.lax.pcast(x, (axis_name,), to="varying")
+    vary = lambda x: _pvary(x, (axis_name,))
     m0 = vary(jnp.full((B, H, Tl), NEG_INF, jnp.float32))
     l0 = vary(jnp.zeros((B, H, Tl), jnp.float32))
     acc0 = vary(jnp.zeros((B, H, Tl, D), jnp.float32))
@@ -118,7 +120,7 @@ def _ring_attention_block_pallas(q, k, v, axis_name: str, causal: bool,
     logits stay in VMEM, the online-softmax update fuses with both MXU
     matmuls.  Exactness is identical to the XLA path."""
     from ..kernels.pallas_attention import flash_block_update
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     B, Tl, H, D = q.shape
     s = scale if scale is not None else (D ** -0.5)
@@ -126,7 +128,7 @@ def _ring_attention_block_pallas(q, k, v, axis_name: str, causal: bool,
     qf = jnp.transpose(q.astype(jnp.float32) * s, (0, 2, 1, 3)) \
         .reshape(B * H, Tl, D)
 
-    vary = lambda x: jax.lax.pcast(x, (axis_name,), to="varying")
+    vary = lambda x: _pvary(x, (axis_name,))
     m0 = vary(jnp.full((B * H, Tl), NEG_INF, jnp.float32))
     l0 = vary(jnp.zeros((B * H, Tl), jnp.float32))
     acc0 = vary(jnp.zeros((B * H, Tl, D), jnp.float32))

@@ -28,7 +28,8 @@ from typing import Optional
 
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ..util.jaxenv import axis_size as _axis_size
+from ..util.jaxenv import shard_map
 
 from .ring_attention import reference_attention
 
@@ -37,7 +38,7 @@ def _ulysses_block(q, k, v, axis_name: str, causal: bool,
                    scale: Optional[float]):
     """Local computation: q,k,v are (B, Tl, H, D) time-blocks of a
     sequence sharded over axis_name."""
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     H = q.shape[2]
     if H % n:
         raise ValueError(

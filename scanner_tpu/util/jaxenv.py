@@ -95,6 +95,68 @@ def enable_compilation_cache(cache_dir: Optional[str] = None
     return path
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: Optional[bool]
+              = None, **kwargs):
+    """Version-compat ``shard_map``: new jax exports it as
+    ``jax.shard_map`` (replication checking via ``check_vma``); older
+    releases only have ``jax.experimental.shard_map.shard_map`` (same
+    knob spelled ``check_rep``).  All parallel/* modules import from
+    here so a jax upgrade/downgrade never breaks import-time collection
+    again (the ``from jax import shard_map`` regression)."""
+    import jax
+    impl = getattr(jax, "shard_map", None)
+    if impl is None:
+        from jax.experimental.shard_map import shard_map as impl
+    import inspect
+    try:
+        params = inspect.signature(impl).parameters
+    except (TypeError, ValueError):
+        params = {}
+    if "check_vma" in params:
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+    elif "check_rep" in params:
+        # Pre-vma jax: callers here are written against vma semantics
+        # (pvary marks, which are identity on this version), so the old
+        # replication checker cannot follow their carries — it trips a
+        # known false mismatch under remat/scan whose upstream-advised
+        # workaround IS check_rep=False.  Translate: explicit request
+        # passes through, unspecified disables the legacy checker.
+        kwargs["check_rep"] = bool(check_vma) if check_vma is not None \
+            else False
+    return impl(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                **kwargs)
+
+
+def axis_size(axis_name) -> int:
+    """Version-compat static mesh-axis size inside shard_map/pmap traced
+    code: new jax has ``jax.lax.axis_size``; on older releases
+    ``jax.core.axis_frame(name)`` returns the bound size directly.
+    Companion to the `shard_map` shim above — parallel/* imports both
+    from here."""
+    import jax
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    from jax import core
+    return core.axis_frame(axis_name)
+
+
+def pvary(x, axis_names):
+    """Version-compat device-variance marking for shard_map carries:
+    new jax tracks varying-mesh-axes (vma) and wants loop carries marked
+    via ``jax.lax.pvary`` (earlier spelled ``pcast(..., to="varying")``);
+    old releases have no vma tracking, so marking is a no-op identity."""
+    import jax
+    fn = getattr(jax.lax, "pvary", None)
+    if fn is not None:
+        return fn(x, axis_names)
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is not None:
+        return fn(x, axis_names, to="varying")
+    return x
+
+
 def force_cpu_platform(n_devices: Optional[int] = None) -> None:
     """Force THIS process's JAX onto the CPU backend.
 

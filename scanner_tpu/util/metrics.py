@@ -127,7 +127,9 @@ class _GaugeChild:
         self._lock = threading.Lock()
 
     def set(self, v: float) -> None:
-        self._value = float(v)
+        # single GIL-atomic slot store; only the read-modify-write
+        # paths (inc/dec) need the lock
+        self._value = float(v)  # scanner-check: disable=SC203
 
     def inc(self, n: float = 1.0) -> None:
         with self._lock:

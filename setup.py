@@ -34,5 +34,12 @@ setup(
         # config.py falls back to tomli where stdlib tomllib is absent
         'tomli; python_version < "3.11"',
     ],
+    entry_points={
+        "console_scripts": [
+            # repo-native static analysis (docs/static-analysis.md);
+            # tools/scanner_check.py is the in-checkout equivalent
+            "scanner-check=scanner_tpu.analysis.static.cli:main",
+        ],
+    },
     cmdclass={"build_py": BuildWithNative},
 )

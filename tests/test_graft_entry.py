@@ -18,6 +18,7 @@ def test_entry_compiles():
     assert np.isfinite(np.asarray(out, np.float32)).all()
 
 
+@pytest.mark.slow  # ~2 min: full sharded train step over the virtual mesh
 def test_dryrun_multichip():
     # n=8 exercises all three mesh axes (dp/sp/tp); smaller n collapse
     # axes to 1 and were verified manually (they also triple suite time)

@@ -90,6 +90,7 @@ def test_heatmaps_to_keypoints():
     assert kp[0][2] == 5.0
 
 
+@pytest.mark.slow  # multi-minute XLA compile of the full multi-chip train step on CPU
 def test_sharded_train_step_dp_sp_tp():
     """Full multi-chip training step on the virtual 8-device mesh:
     dp=2 (batch) x sp=2 (ring-attention time) x tp=2 (channels+experts)."""
@@ -101,6 +102,7 @@ def test_sharded_train_step_dp_sp_tp():
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow  # multi-minute XLA compile of the full multi-chip train step on CPU
 def test_train_checkpoint_roundtrip(tmp_path):
     import jax
     from scanner_tpu.models.checkpoint import TrainCheckpointer
@@ -334,6 +336,7 @@ def test_embedding_shipped_weights_recall():
     assert recall >= 0.75, f"recall@1 {recall:.2f}"
 
 
+@pytest.mark.slow  # multi-minute XLA compile of the full multi-chip train step on CPU
 def test_attention_scheme_selection():
     """attn_scheme (or SCANNER_TPU_ATTN) selects the sequence-parallel
     attention for the sharded train step; all three schemes (XLA ring,
@@ -480,6 +483,7 @@ def test_seg_shipped_weights_segment(tmp_path):
         sc2.stop()
 
 
+@pytest.mark.slow  # multi-minute XLA compile of the full multi-chip train step on CPU
 def test_remat_train_step_matches():
     """remat=True (jax.checkpoint on backbone + temporal blocks) is the
     same math: first-step loss and the second-step loss after one update

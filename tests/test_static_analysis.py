@@ -1,0 +1,512 @@
+"""scanner-check static-analysis suite tests.
+
+Three layers:
+  * fixture snippets per pass family — known-bad code must produce the
+    expected finding codes, the clean twin must produce none (the
+    analyzer's own regression suite);
+  * suppression/baseline round-trip — inline pragmas, baseline
+    fingerprint stability, mandatory justifications, stale detection;
+  * the tier-1 GATE — the analyzer over the whole scanner_tpu package
+    must report zero unsuppressed findings (the repo stays lint-clean
+    the same way it stays test-green).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from scanner_tpu.analysis.static import (BaselineError, all_passes,
+                                         analyze, load_baseline,
+                                         run_analysis, split_findings,
+                                         write_baseline)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _analyze(root, *relfiles):
+    return analyze([os.path.join(root, f) for f in relfiles]
+                   if relfiles else [str(root)], root=str(root))
+
+
+def _codes(findings):
+    return sorted(f.code for f in findings)
+
+
+def _write(root, rel, src):
+    path = os.path.join(str(root), rel)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(textwrap.dedent(src))
+    return path
+
+
+# ---------------------------------------------------------------------------
+# pass framework basics
+# ---------------------------------------------------------------------------
+
+def test_codes_are_unique_and_documented():
+    seen = {}
+    for p in all_passes():
+        assert p.name
+        for code, desc in p.codes.items():
+            assert code.startswith("SC") and desc
+            assert code not in seen, f"{code} claimed by two passes"
+            seen[code] = p.name
+    assert len(seen) >= 15
+
+
+def test_syntax_error_is_a_finding(tmp_path):
+    _write(tmp_path, "broken.py", "def f(:\n")
+    proj, findings = _analyze(tmp_path)
+    assert [f.code for f in proj.parse_errors] == ["SC001"]
+
+
+# ---------------------------------------------------------------------------
+# family 1: tracer safety
+# ---------------------------------------------------------------------------
+
+TRACER_BAD = """
+    import time
+    import random
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    _CACHE = {}
+
+    def poke(v):
+        _CACHE["k"] = v
+
+    @jax.jit
+    def kern(x):
+        if x > 0:                     # SC102
+            y = np.sum(x)             # SC101
+        else:
+            y = jnp.sum(x)
+        t = time.time()               # SC103
+        r = np.random.rand(3)         # SC103
+        s = _CACHE.get("scale", 1.0)  # SC104
+        return y * t * s + r.sum()
+
+    _jf = jax.jit(kern)
+
+    def call(frames, k):
+        return _jf(frames[:k])        # SC105
+"""
+
+TRACER_CLEAN = """
+    import functools
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    TABLE = {"a": 1}   # never mutated from a function: fine to capture
+
+    @functools.partial(jax.jit, static_argnames=("bins",))
+    def kern(x, bins):
+        if bins > 2:                  # static arg: fine
+            return jnp.sum(x)
+        if x.ndim == 3:               # shape access: static, fine
+            return x.mean()
+        h = np.zeros(4)               # numpy on constants: fine
+        return x + h[0] + TABLE["a"]
+
+    def host_path(x):
+        return np.sum(x)              # not jitted: numpy is fine
+
+    _jf = jax.jit(kern)
+
+    def call(frames):
+        return _jf(frames, 4)         # full batch, no ragged slice
+"""
+
+
+def test_tracer_bad_fixture(tmp_path):
+    _write(tmp_path, "bad.py", TRACER_BAD)
+    _, findings = _analyze(tmp_path)
+    counts = {c: _codes(findings).count(c) for c in set(_codes(findings))}
+    assert counts.get("SC101") == 1
+    assert counts.get("SC102") == 1
+    assert counts.get("SC103") == 2
+    assert counts.get("SC104") == 1
+    assert counts.get("SC105") == 1
+
+
+def test_tracer_clean_fixture(tmp_path):
+    _write(tmp_path, "clean.py", TRACER_CLEAN)
+    _, findings = _analyze(tmp_path)
+    assert not [f for f in findings if f.code.startswith("SC1")], \
+        [f.format() for f in findings]
+
+
+def test_tracer_scan_body_and_kernel_execute(tmp_path):
+    _write(tmp_path, "scanny.py", """
+        import time
+        import jax
+
+        def body(carry, x):
+            t = time.time()           # SC103: scan body is traced
+            return carry + x * t, x
+
+        def drive(xs, ev):
+            import jax.numpy as jnp
+            out = jax.lax.scan(body, jnp.zeros(()), xs)
+            return out, ev.kernel.execute(xs)   # SC105: raw execute()
+    """)
+    _, findings = _analyze(tmp_path)
+    assert "SC103" in _codes(findings)
+    assert "SC105" in _codes(findings)
+
+
+# ---------------------------------------------------------------------------
+# family 2: concurrency
+# ---------------------------------------------------------------------------
+
+CONC_BAD = """
+    import threading
+    import time
+
+    class Svc:
+        def __init__(self):
+            self.a = threading.Lock()
+            self.b = threading.Lock()
+            self.n = 0
+
+        def ab(self):
+            with self.a:
+                with self.b:          # SC201 (vs ba)
+                    self.n = 1
+
+        def ba(self):
+            with self.b:
+                with self.a:
+                    return self.n
+
+        def reenter(self):
+            with self.a:
+                self.ab()             # SC201 self-deadlock
+
+        def slow(self):
+            with self.a:
+                time.sleep(0.5)       # SC202
+
+        def bare(self):
+            self.n = 2                # SC203
+"""
+
+CONC_CLEAN = """
+    import threading
+    import queue
+
+    class Svc:
+        def __init__(self):
+            self.a = threading.RLock()
+            self.b = threading.Lock()
+            self.n = 0
+            self.q = queue.Queue()
+
+        def ab(self):
+            with self.a:
+                with self.b:
+                    self.n = 1
+
+        def ab2(self):
+            with self.a:              # same order: fine
+                with self.b:
+                    return self.n
+
+        def reenter(self):
+            with self.a:
+                self.ab()             # RLock: reentry is fine
+
+        def bounded(self):
+            with self.b:
+                return self.q.get(timeout=0.25)   # bounded: fine
+
+        def read_only(self):
+            return self.n             # read, not write: fine
+"""
+
+
+def test_concurrency_bad_fixture(tmp_path):
+    _write(tmp_path, "svc.py", CONC_BAD)
+    _, findings = _analyze(tmp_path)
+    codes = _codes(findings)
+    assert codes.count("SC201") == 2   # ABBA + self-deadlock
+    assert "SC202" in codes
+    assert "SC203" in codes
+
+
+def test_concurrency_clean_fixture(tmp_path):
+    _write(tmp_path, "svc.py", CONC_CLEAN)
+    _, findings = _analyze(tmp_path)
+    assert not [f for f in findings if f.code.startswith("SC2")], \
+        [f.format() for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# family 3: contracts (synthetic mini-repo)
+# ---------------------------------------------------------------------------
+
+def _contract_repo(tmp_path):
+    _write(tmp_path, "setup.py", "# root marker\n")
+    _write(tmp_path, "docs/observability.md", """
+        | `scanner_tpu_good_total` | counter | documented |
+        | `scanner_tpu_ghost_total` | counter | documented but unregistered |
+    """)
+    _write(tmp_path, "docs/guide.md", """
+        `SCANNER_TPU_DOCUMENTED` is a knob.  `[net] port` is config.
+        The key `port` is documented here.
+    """)
+    _write(tmp_path, "pkg/config.py", """
+        def default_config():
+            return {"net": {"port": 1}}
+    """)
+    _write(tmp_path, "pkg/util/faults.py", """
+        SITES = ("rpc.call", "storage.write")
+        NAMED_PLANS = {"p1": "rpc.call:raise", "p2": "nosuch.site:crash"}
+        ACTIVE = False
+
+        def inject(site, data=None, detail=""):
+            return data
+    """)
+    _write(tmp_path, "pkg/m.py", """
+        import os
+        from .util import faults as _faults
+
+        def registry():
+            return None
+
+        M_GOOD = registry().counter("scanner_tpu_good_total", "ok")
+        M_UNDOC = registry().counter("scanner_tpu_undoc_total", "x")
+        M_BAD = registry().counter("BadName", "x")
+        M_NOTOT = registry().counter("scanner_tpu_rows", "x")
+        M_NOHELP = registry().gauge("scanner_tpu_depth", "")
+
+        def knobs(cfg):
+            a = os.environ.get("SCANNER_TPU_DOCUMENTED")
+            b = os.environ.get("SCANNER_TPU_SECRET")
+            return a, b, cfg["net"]["port"], cfg["net"]["missing"]
+
+        def hooks(data):
+            data = _faults.inject("rpc.call", data)
+            return _faults.inject("typo.site", data)
+
+        class RpcServer:
+            def __init__(self, name, methods, port=0):
+                pass
+
+        def serve(handler):
+            return RpcServer("svc", {"Reg": handler})
+
+        def client(c):
+            return c.call("NotRegistered")
+    """)
+    return tmp_path
+
+
+def test_contract_fixture_codes(tmp_path):
+    _contract_repo(tmp_path)
+    _, findings = _analyze(tmp_path, "pkg")
+    by_code = {}
+    for f in findings:
+        by_code.setdefault(f.code, []).append(f)
+
+    msgs = [f.message for f in by_code.get("SC301", [])]
+    assert any("scanner_tpu_undoc_total" in m for m in msgs)
+    assert any("scanner_tpu_ghost_total" in m for m in msgs)
+    # name pattern + counter-_total + empty help
+    assert len(by_code.get("SC302", [])) == 3
+    msgs = [f.message for f in by_code.get("SC303", [])]
+    assert any("SCANNER_TPU_SECRET" in m for m in msgs)
+    assert not any("SCANNER_TPU_DOCUMENTED" in m for m in msgs)
+    msgs = [f.message for f in by_code.get("SC304", [])]
+    assert any("missing" in m for m in msgs)
+    assert not any("[net] port" in m for m in msgs)
+    msgs = [f.message for f in by_code.get("SC305", [])]
+    assert any("typo.site" in m for m in msgs)          # unknown inject
+    assert any("storage.write" in m for m in msgs)      # unwired site
+    assert any("nosuch.site" in m for m in msgs)        # bad named plan
+    msgs = [f.message for f in by_code.get("SC306", [])]
+    assert any("NotRegistered" in m for m in msgs)      # called, no server
+    assert any("`Reg`" in m for m in msgs)              # registered, dead
+    assert by_code.get("SC307"), "missing RPC_CONTRACTS must be flagged"
+
+
+def test_contract_rpc_contracts_table_both_directions(tmp_path):
+    _write(tmp_path, "setup.py", "# root\n")
+    _write(tmp_path, "pkg/rpcmod.py", """
+        RPC_CONTRACTS = {
+            "Reg": {"timeout_s": 1.0, "idempotent": True},
+            "Phantom": {"timeout_s": 1.0, "idempotent": True},
+        }
+
+        class RpcServer:
+            def __init__(self, name, methods, port=0):
+                pass
+
+        def serve(h):
+            return RpcServer("svc", {"Reg": h, "Unclassified": h})
+
+        def client(c):
+            c.call("Reg")
+            c.call("Unclassified")
+    """)
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC307"]
+    assert any("Unclassified" in m for m in msgs)
+    assert any("Phantom" in m for m in msgs)
+    assert not any("`Reg`" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline round-trip
+# ---------------------------------------------------------------------------
+
+SLEEPY = """
+    import threading
+    import time
+
+    class S:
+        def __init__(self):
+            self.l = threading.Lock()
+
+        def slow(self):
+            with self.l:
+                time.sleep(1)
+"""
+
+
+def test_inline_suppression(tmp_path):
+    _write(tmp_path, "s.py", SLEEPY.replace(
+        "time.sleep(1)",
+        "time.sleep(1)  # scanner-check: disable=SC202 test shim"))
+    proj, findings = _analyze(tmp_path)
+    res = split_findings(proj, findings)
+    assert not res.unsuppressed
+    assert [f.code for f in res.inline_suppressed] == ["SC202"]
+
+
+def test_file_level_suppression(tmp_path):
+    _write(tmp_path, "s.py",
+           "# scanner-check: disable-file=SC202\n" + textwrap.dedent(
+               SLEEPY))
+    proj, findings = _analyze(tmp_path)
+    res = split_findings(proj, findings)
+    assert not res.unsuppressed and res.inline_suppressed
+
+
+def test_baseline_round_trip(tmp_path):
+    _write(tmp_path, "s.py", SLEEPY)
+    proj, findings = _analyze(tmp_path)
+    res = split_findings(proj, findings)
+    assert [f.code for f in res.unsuppressed] == ["SC202"]
+
+    bl_path = str(tmp_path / "baseline.json")
+    new = write_baseline(bl_path, res.unsuppressed)
+    assert new == 1
+    # placeholder justification must be rejected
+    with pytest.raises(BaselineError):
+        load_baseline(bl_path)
+    doc = json.load(open(bl_path))
+    doc["entries"][0]["justification"] = "intentional for the test"
+    json.dump(doc, open(bl_path, "w"))
+    baseline = load_baseline(bl_path)
+
+    # baselined finding no longer reported...
+    res2 = split_findings(proj, findings, baseline)
+    assert not res2.unsuppressed
+    assert [f.code for f in res2.baselined] == ["SC202"]
+
+    # ...and the fingerprint survives the code MOVING (line shift)
+    _write(tmp_path, "s.py", "# a new leading comment\n\n"
+           + textwrap.dedent(SLEEPY))
+    proj3, findings3 = _analyze(tmp_path)
+    res3 = split_findings(proj3, findings3, baseline)
+    assert not res3.unsuppressed and res3.baselined
+
+    # fixing the code makes the entry STALE (prunable), not silent
+    _write(tmp_path, "s.py", textwrap.dedent(SLEEPY).replace(
+        "time.sleep(1)", "pass"))
+    proj4, findings4 = _analyze(tmp_path)
+    res4 = split_findings(proj4, findings4, baseline)
+    assert not res4.unsuppressed
+    assert len(res4.stale_baseline) == 1
+
+    # re-writing keeps existing justifications
+    _write(tmp_path, "s.py", SLEEPY)
+    proj5, findings5 = _analyze(tmp_path)
+    res5 = split_findings(proj5, findings5)
+    assert write_baseline(bl_path, res5.unsuppressed,
+                          previous=baseline) == 0
+    assert load_baseline(bl_path)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_json_and_exit_codes(tmp_path):
+    _write(tmp_path, "setup.py", "# root\n")
+    bad = _write(tmp_path, "pkg/s.py", SLEEPY)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "scanner_check.py"),
+         bad, "--root", str(tmp_path), "--json"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["counts"] == {"SC202": 1}
+    assert doc["findings"][0]["path"] == "pkg/s.py"
+
+    clean = _write(tmp_path, "pkg/ok.py", "x = 1\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "scanner_check.py"),
+         clean, "--root", str(tmp_path)],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    # --write-baseline under --select must refuse: a selected run can't
+    # see other codes' findings, so a rewrite would erase their entries
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "scanner_check.py"),
+         bad, "--root", str(tmp_path), "--select", "SC3",
+         "--write-baseline"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 2 and "erase" in r.stderr, \
+        r.stdout + r.stderr
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate
+# ---------------------------------------------------------------------------
+
+def test_repo_is_clean():
+    """THE gate: scanner-check over the whole package reports zero
+    unsuppressed findings.  A new finding means: fix it, or suppress it
+    inline / baseline it WITH a one-line justification (reviewed like
+    code).  load_baseline() already rejects justification-less entries,
+    so a clean pass here also certifies the baseline's hygiene."""
+    baseline_path = os.path.join(REPO, "tools",
+                                 "scanner_check_baseline.json")
+    baseline = load_baseline(baseline_path)   # raises on TODO entries
+    pkg = os.path.join(REPO, "scanner_tpu")
+    proj, findings = analyze([pkg], root=REPO)
+    res = split_findings(proj, findings, baseline)
+    assert not res.unsuppressed, \
+        "scanner-check found new issues:\n" + "\n".join(
+            f.format() for f in res.unsuppressed)
+    assert not res.stale_baseline, \
+        ("baseline entries no longer match any finding — prune them "
+         f"(tools/scanner_check.py --write-baseline): "
+         f"{res.stale_baseline}")
+
+
+def test_run_analysis_select():
+    findings = run_analysis([os.path.join(REPO, "scanner_tpu")],
+                            root=REPO, select=["SC2"])
+    assert all(f.code.startswith("SC2") for f in findings)

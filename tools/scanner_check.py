@@ -1,0 +1,23 @@
+#!/usr/bin/env python3
+"""Repo-native static analysis CLI (thin wrapper).
+
+    python tools/scanner_check.py scanner_tpu/
+    python tools/scanner_check.py --json
+    python tools/scanner_check.py --list-codes
+
+The implementation lives in scanner_tpu/analysis/static/ (the
+`scanner-check` console script points there too); this wrapper only
+makes the repo checkout importable when invoked directly.  See
+docs/static-analysis.md.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from scanner_tpu.analysis.static.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
