@@ -295,12 +295,20 @@ def main():
         # (recompiles, retries, bytes moved, chunk-wait seconds)
         # alongside fps — the attribution PERF.md round 3 had to
         # reconstruct from traces ships with every bench run
-        from scanner_tpu.util.metrics import registry
+        from scanner_tpu.util.metrics import labeled_samples, registry
         snap = registry().snapshot()
 
         def per_op(series: str) -> dict:
-            return {s["labels"].get("op", "_"): s["value"]
-                    for s in snap.get(series, {}).get("samples", [])}
+            # sum across the remaining labels (these series carry a
+            # `device` label since the multichip round: last-sample-wins
+            # would report one arbitrary chip's count and mask a
+            # recompile storm confined to another); the per-device
+            # breakdown ships in the `multichip` digest below
+            out: dict = {}
+            for s in snap.get(series, {}).get("samples", []):
+                k = s["labels"].get("op", "_")
+                out[k] = out.get(k, 0) + s["value"]
+            return out
 
         # shape-stability digest: with bucketed dispatch (PERF.md §5)
         # recompiles must sit at ladder size per op whatever the task
@@ -311,6 +319,31 @@ def main():
             "pad_rows": per_op("scanner_tpu_op_pad_rows_total"),
             "precompile_seconds":
                 per_op("scanner_tpu_op_precompile_seconds"),
+        })
+
+        def per_labels(series: str) -> dict:
+            return labeled_samples(snap, series)
+
+        # multichip digest: did the bench's bulks actually spread across
+        # this host's chips (evaluator affinity, PERF.md §6)?  tasks and
+        # busy seconds per assigned device, plus per-(op, device)
+        # executable counts — a chip at 0 while siblings climb is the
+        # regression this series exists to catch
+        try:
+            import jax
+            n_dev = len(jax.local_devices())
+        except Exception:  # noqa: BLE001
+            n_dev = None
+        detail.append({
+            "config": "multichip",
+            "n_devices": n_dev,
+            "affinity": os.environ.get(
+                "SCANNER_TPU_DEVICE_AFFINITY", "1") not in ("0", "false"),
+            "device_tasks": per_labels("scanner_tpu_device_tasks_total"),
+            "device_busy_seconds":
+                per_labels("scanner_tpu_device_busy_seconds_total"),
+            "recompiles_by_device":
+                per_labels("scanner_tpu_op_recompiles_total"),
         })
         detail.append({"config": "metrics_registry", "snapshot": snap})
         # static-analysis digest: finding counts per code ride with every

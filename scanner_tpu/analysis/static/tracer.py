@@ -20,6 +20,12 @@ Codes
   SC105  raw-shape jitted call: a device-kernel `execute()` outside the
          engine's bucketed dispatch, or a jitted function called with a
          variable-length slice (every length mints an executable)
+  SC106  default-chip device placement inside engine/kernels code:
+         `jax.devices()[0]` / `jax.local_devices()[0]` pins, or a bare
+         `device_put` without an explicit device — under evaluator
+         affinity every placement must name its chip (or thread the
+         instance's device through), else N-1 chips idle while chip 0
+         takes every stdlib kernel
 """
 
 from __future__ import annotations
@@ -303,6 +309,7 @@ class TracerSafetyPass(AnalysisPass):
         "SC103": "nondeterminism (clock/random) inside jitted code",
         "SC104": "mutable module global captured inside jitted code",
         "SC105": "raw-shape jitted call bypassing bucketed dispatch",
+        "SC106": "default-chip device placement in engine/kernels code",
     }
 
     def run(self, project: Project) -> List[Finding]:
@@ -325,6 +332,7 @@ class TracerSafetyPass(AnalysisPass):
                             if isinstance(t, ast.Name):
                                 jitted_names.add(t.id)
             out.extend(self._check_raw_shape_calls(mod, jitted_names))
+            out.extend(self._check_device_affinity(mod, aliases))
         return out
 
     # -- SC101..SC104 over one jit context ------------------------------
@@ -477,4 +485,47 @@ class TracerSafetyPass(AnalysisPass):
                                 "compile; round up via "
                                 "engine.evaluate.bucket_for", node))
                             break
+        return out
+
+    # -- SC106 ----------------------------------------------------------
+
+    def _check_device_affinity(self, mod: ModuleInfo,
+                               aliases: Dict[str, str]) -> List[Finding]:
+        """Engine/kernels code must never hard-pin the default chip:
+        evaluator affinity (engine/evaluate.py assigned_device) hands
+        every call site an explicit device, and `jax.devices()[0]` or a
+        bare `device_put(x)` silently routes work back to chip 0 —
+        exactly the N-1-chips-idle failure the affinity work removed.
+        Passing a possibly-None device variable is fine (placement was
+        decided upstream); omitting the argument is not."""
+        parts = mod.relpath.replace("\\", "/").split("/")
+        if "engine" not in parts and "kernels" not in parts:
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Subscript) and isinstance(
+                    node.value, ast.Call):
+                resolved = _resolve(
+                    aliases, dotted_name(node.value.func)) or ""
+                if resolved in ("jax.devices", "jax.local_devices") \
+                        and isinstance(node.slice, ast.Constant):
+                    out.append(mod.finding(
+                        "SC106",
+                        f"`{resolved}()[...]` pins a fixed chip inside "
+                        "engine/kernels code — use the evaluator's "
+                        "assigned device (engine.evaluate"
+                        ".assigned_device) or jax.default_backend() "
+                        "for platform probes", node))
+            elif isinstance(node, ast.Call):
+                resolved = _resolve(aliases, dotted_name(node.func)) or ""
+                if resolved == "jax.device_put" \
+                        and len(node.args) < 2 \
+                        and not any(kw.arg == "device"
+                                    for kw in node.keywords):
+                    out.append(mod.finding(
+                        "SC106",
+                        "bare `jax.device_put(x)` dispatches to the "
+                        "default chip — pass the target device "
+                        "explicitly (ColumnBatch.to_device(device=...) "
+                        "/ the instance's assigned_device)", node))
         return out

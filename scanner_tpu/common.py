@@ -84,6 +84,14 @@ class PerfParams:
 
     work_packet_size: int = 16
     io_packet_size: int = 64
+    # Evaluator pipeline instances per node.  None resolves at job launch
+    # (engine/evaluate.py default_pipeline_instances): one device-affine
+    # instance per local chip on multi-device accelerator hosts —
+    # instance i owns chip i, stages its tasks' inputs there and runs
+    # the shared jitted kernels on it — and 1 elsewhere.  An explicit
+    # value here (or on the Client/Worker constructor) always wins;
+    # SCANNER_TPU_DEVICE_AFFINITY=0 disables the per-chip resolution
+    # and pinning entirely (the A/B lever).
     pipeline_instances_per_node: Optional[int] = None
     load_sparsity_threshold: int = 8
     queue_size_per_pipeline: int = 4

@@ -138,7 +138,10 @@ class ClusterConfig:
     image: str = "scanner-tpu:latest"
     db_path: str = "/data/db"      # or gs://bucket/db for the GCS backend
     master_port: int = 5000
-    pipeline_instances: int = 1
+    # None = workers resolve one device-affine pipeline instance per
+    # local chip (engine/evaluate.py default_pipeline_instances); an
+    # explicit int — including 1 — is used as given
+    pipeline_instances: Optional[int] = None
     log_level: str = "info"
     autoscale: bool = False
     max_workers: Optional[int] = None

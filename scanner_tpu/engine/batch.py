@@ -201,18 +201,54 @@ class ColumnBatch:
 
     # -- device movement ------------------------------------------------
 
-    def to_device(self) -> "ColumnBatch":
-        """Host -> default device, one async transfer for the whole batch.
+    def to_device(self, device=None) -> "ColumnBatch":
+        """Host -> device, one async transfer for the whole batch.
+        `device` targets a specific chip (evaluator affinity: instance
+        *i* stages to chip *i*); None keeps jax's default placement.  A
+        batch already on device is re-staged only when it sits on a
+        DIFFERENT chip than the requested one — the copy the old
+        implicit-default path used to trigger silently inside the jitted
+        call now happens here, visibly, and only when asked for.
         A convert-marked batch ships its WIRE format (that is the point:
         1.5 B/px over the link, convert on device via converted())."""
         if isinstance(self.data, np.ndarray):
             import jax
             t0 = time.time()
-            data = jax.device_put(self.data)
+            data = jax.device_put(self.data, device)
             _M_H2D_SECONDS.inc(time.time() - t0)
             _M_H2D_BYTES.inc(self.data.nbytes)
             return ColumnBatch(self.rows, data,
                                self.nulls, convert=self.convert)
+        if device is not None and _is_jax(self.data):
+            cur = None
+            devs = getattr(self.data, "devices", None)
+            if callable(devs):
+                try:
+                    cur = set(devs())
+                except Exception:  # noqa: BLE001 — version drift
+                    cur = None
+            if cur is not None and cur != {device}:
+                import jax
+                return ColumnBatch(self.rows,
+                                   jax.device_put(self.data, device),
+                                   self.nulls, convert=self.convert)
+        return self
+
+    def prefetch_host(self) -> "ColumnBatch":
+        """Start this batch's device->host copy WITHOUT blocking (the
+        async half of the sink fetch): called at eval-done so the ~180 ms
+        d2h latency of task k rides under the evaluation of task k+1
+        instead of serializing inside the saver (PERF.md §1/§6).  The
+        later to_host() then finds the transfer done (or in flight) and
+        returns quickly.  No-op for host data; best-effort on jax
+        versions without copy_to_host_async."""
+        if _is_jax(self.data):
+            fn = getattr(self.data, "copy_to_host_async", None)
+            if fn is not None:
+                try:
+                    fn()
+                except Exception:  # noqa: BLE001 — purely an overlap hint
+                    pass
         return self
 
     def to_host(self) -> "ColumnBatch":

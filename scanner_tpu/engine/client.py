@@ -80,7 +80,11 @@ class Client:
                  workers: Optional[List[str]] = None,
                  num_load_workers: int = 2,
                  num_save_workers: int = 2,
-                 pipeline_instances: int = 1,
+                 # None = resolve at job launch: one device-affine
+                 # instance per local chip on multi-device hosts
+                 # (engine/evaluate.py default_pipeline_instances).  An
+                 # explicit value — including 1 — always wins.
+                 pipeline_instances: Optional[int] = None,
                  decoder_threads: int = 1,
                  config_path: Optional[str] = None,
                  storage_options: Optional[Dict[str, Any]] = None,
@@ -151,11 +155,14 @@ class Client:
         self.streams = StreamsGenerator()
         self.io = IOGenerator(self)
         self.partitioner = TaskPartitioner()
+        # None stays None here (the per-job resolution in run() reads
+        # it); the long-lived executor itself just needs a concrete int
+        self._pipeline_instances_arg = pipeline_instances
         self._executor = LocalExecutor(
             self._db, self._profiler,
             num_load_workers=num_load_workers,
             num_save_workers=num_save_workers,
-            pipeline_instances=pipeline_instances,
+            pipeline_instances=pipeline_instances or 1,
             decoder_threads=decoder_threads)
 
     # -- context manager ----------------------------------------------------
@@ -316,6 +323,12 @@ class Client:
                                       show_progress)
             self._job_profiles[job_id] = profs
             return job_id
+        # instance-count resolution: explicit kwarg > PerfParams >
+        # explicit Client(pipeline_instances=) — any of which wins as
+        # given, including 1 — and only a fully-unset count resolves to
+        # one device-affine instance per local chip on multi-chip hosts
+        # (engine/evaluate.py default_pipeline_instances)
+        from .evaluate import default_pipeline_instances
         ex = LocalExecutor(
             self._db, prof,
             num_load_workers=self._executor.num_load_workers,
@@ -323,8 +336,9 @@ class Client:
             decoder_threads=self._executor.decoder_threads,
             pipeline_instances=kw.get(
                 "pipeline_instances",
-                perf.pipeline_instances_per_node
-                or self._executor.pipeline_instances))
+                default_pipeline_instances(
+                    perf.pipeline_instances_per_node
+                    or self._pipeline_instances_arg)))
         ex.run(outputs, perf, cache_mode=cache_mode,
                show_progress=show_progress)
         self._job_profiles[job_id] = [prof]

@@ -58,22 +58,24 @@ _M_OP_SECONDS = _mx.registry().counter(
     labels=["op"])
 _M_OP_RECOMPILES = _mx.registry().counter(
     "scanner_tpu_op_recompiles_total",
-    "New input (shape, dtype) signatures seen per op — each one forces "
-    "an XLA recompile of a jitted kernel; a climbing count means shape "
-    "churn.  With bucketed dispatch this is bounded by the op's "
-    "bucket-ladder size.",
-    labels=["op"])
+    "New input (device, shape, dtype) signatures seen per op — each one "
+    "forces an XLA recompile of a jitted kernel; a climbing count means "
+    "shape churn.  With bucketed dispatch this is bounded by the op's "
+    "bucket-ladder size PER CHIP (evaluator affinity compiles each "
+    "ladder once per assigned device).",
+    labels=["op", "device"])
 _M_OP_PAD_ROWS = _mx.registry().counter(
     "scanner_tpu_op_pad_rows_total",
     "Edge-repeat padding rows added by bucketed dispatch to round tail "
     "chunks up to a bucket shape (padding waste; the price of never "
-    "re-tracing).",
-    labels=["op"])
+    "re-tracing), per op and assigned device.",
+    labels=["op", "device"])
 _M_OP_PRECOMPILE = _mx.registry().gauge(
     "scanner_tpu_op_precompile_seconds",
     "Seconds the setup-time warm-up spent precompiling this device "
-    "op's bucket ladder (overlapped with the first task's decode).",
-    labels=["op"])
+    "op's bucket ladder on its assigned chip (overlapped with the "
+    "first task's decode).",
+    labels=["op", "device"])
 
 Elem = Any  # np.ndarray | bytes | arbitrary python object | NullElement
 ColKey = Tuple[int, str]  # (node id, column name)
@@ -94,6 +96,110 @@ def _accel_backend() -> bool:
         import jax
         _BACKEND = jax.default_backend()
     return _BACKEND != "cpu"
+
+
+# ---------------------------------------------------------------------------
+# Multi-chip evaluator affinity
+# ---------------------------------------------------------------------------
+#
+# The reference scales by pinning one kernel-group instance per GPU
+# (KernelConfig.devices, worker.cpp pipeline instance spin-up); the TPU
+# analogue is one pipeline instance per local chip.  Evaluator instance
+# *i* owns chip *i mod n_devices*: its stdlib device-kernel calls stage
+# inputs to THAT chip (committed jax arrays pull the jitted computation
+# onto their device), its bucket-ladder warm-up compiles there, and the
+# recompile proxy keys per (device, shape, dtype) so the ladder bound
+# holds per chip.  Model kernels keep dp-sharding across the instance's
+# device partition (all chips when one instance runs, the reference
+# behavior; one chip each when instances == chips).
+
+
+def _affinity_enabled() -> bool:
+    """SCANNER_TPU_DEVICE_AFFINITY=0 restores default-chip dispatch for
+    every pipeline instance (the pre-affinity behavior; the multichip
+    equivalence tests A/B against it)."""
+    return os.environ.get("SCANNER_TPU_DEVICE_AFFINITY", "1") \
+        not in ("0", "false")
+
+
+def kernel_devices() -> Optional[List[Any]]:
+    """This host's jax devices, when kernels should see them: always on
+    accelerator backends; on the CPU backend only with
+    SCANNER_TPU_KERNEL_DEVICES=all, so dryruns/tests exercise the
+    multi-chip paths on a virtual multi-device host.  None = kernels run
+    wherever jax defaults to (single-device host semantics)."""
+    if os.environ.get("SCANNER_TPU_KERNEL_DEVICES") == "all" \
+            or _accel_backend():
+        import jax
+        return list(jax.local_devices())
+    return None
+
+
+def _device_staging_enabled() -> bool:
+    """Whether ColumnBatch data is staged onto jax devices for device
+    kernels.  On by nature on accelerator backends; the virtual
+    multi-device host (SCANNER_TPU_KERNEL_DEVICES=all) opts in so the
+    per-chip staging/dispatch paths are testable on CPU."""
+    return _accel_backend() \
+        or os.environ.get("SCANNER_TPU_KERNEL_DEVICES") == "all"
+
+
+def assigned_device(instance: int) -> Optional[Any]:
+    """The chip pipeline instance `instance` owns — chip `instance mod
+    n_devices`, independent of the instance count (instance_devices'
+    partitions always lead with this same chip, so the two mappings
+    agree for any count) — or None when placement should stay with
+    jax's default device (affinity off, host backend without virtual
+    devices, or a single chip).  Used by both the evaluator (kernel
+    staging/warm-up) and the executor (TaskItem device assignment at
+    enqueue time): one mapping, two sides of the handoff."""
+    if not _affinity_enabled():
+        return None
+    devs = kernel_devices()
+    if not devs or len(devs) <= 1:
+        return None
+    return devs[instance % len(devs)]
+
+
+def instance_devices(instance: int, instances: int = 1
+                     ) -> Optional[List[Any]]:
+    """Device list instance `instance`'s kernels see (the dp-shard set
+    for model kernels).  One instance keeps the whole host's chips
+    (today's DataParallelApply behavior); N instances partition them so
+    concurrent instances never shard over each other's chips."""
+    devs = kernel_devices()
+    if not devs:
+        return None
+    if not _affinity_enabled() or len(devs) <= 1 or instances <= 1:
+        return devs
+    if instances <= len(devs):
+        return devs[instance::instances]
+    return [devs[instance % len(devs)]]
+
+
+def default_pipeline_instances(configured: Optional[int] = None) -> int:
+    """Resolve the pipeline-instance count for this node: an explicit
+    setting wins — ANY explicit value, including 1 (a user bounding
+    memory or serializing evaluation must not be overridden) — and only
+    an unset count (None/0) becomes one instance per local chip on
+    multi-device hosts (the tentpole default: a v5e-8 worker runs 8
+    device-affine instances instead of contending for chip 0), else 1.
+    SCANNER_TPU_DEVICE_AFFINITY=0 disables the per-chip resolution."""
+    if configured:
+        return int(configured)
+    devs = kernel_devices() if _affinity_enabled() else None
+    if devs and len(devs) > 1:
+        return len(devs)
+    return 1
+
+
+def device_label(device: Optional[Any]) -> str:
+    """Stable metrics label for a jax device ("tpu:3"); "default" when
+    placement is jax's choice (affinity off / single chip)."""
+    if device is None:
+        return "default"
+    return f"{getattr(device, 'platform', 'dev')}:" \
+           f"{getattr(device, 'id', 0)}"
 
 
 # ---------------------------------------------------------------------------
@@ -196,7 +302,8 @@ class KernelInstance:
     """One live kernel with its stream/state bookkeeping."""
 
     def __init__(self, node: O.OpNode, profiler: Profiler,
-                 devices: Optional[List[Any]] = None):
+                 devices: Optional[List[Any]] = None,
+                 device: Optional[Any] = None):
         assert node.spec is not None and node.spec.kernel_factory is not None
         self.node = node
         self.spec = node.spec
@@ -205,6 +312,11 @@ class KernelInstance:
                              devices=devices or [])
         self.kernel = self.spec.kernel_factory(cfg, **node.init_args)
         self.profiler = profiler
+        # the chip this instance's calls are pinned to (evaluator
+        # affinity); None = jax default placement.  Committed inputs on
+        # this device pull the shared jitted functions onto it.
+        self.device = device
+        self.dev_label = device_label(device)
         self._cur_stream: Tuple[int, int] = (-1, -1)  # (job, slice group)
         self._last_row: Optional[int] = None
         self._did_setup = False
@@ -293,13 +405,24 @@ class KernelInstance:
                 args = self._example_args(b, h, w)
                 if args is None:
                     return
+                if self.device is not None:
+                    # warm THIS instance's chip: committed example
+                    # inputs compile the ladder executable for the
+                    # device the real calls will run on (the persistent
+                    # compilation cache dedups the XLA work across
+                    # same-kind chips)
+                    import jax
+                    args = [jax.device_put(a, self.device)
+                            if isinstance(a, np.ndarray) else a
+                            for a in args]
                 try:
                     self.kernel.execute(*args)
                 except Exception:  # noqa: BLE001 — warm-up is best-effort
                     _log.debug("precompile of %s at batch %d failed",
                                self.node.name, b, exc_info=True)
                     return
-            _M_OP_PRECOMPILE.labels(op=self.node.name).set(
+            _M_OP_PRECOMPILE.labels(op=self.node.name,
+                                    device=self.dev_label).set(
                 time.time() - t0)
         finally:
             with self._warm_lock:
@@ -328,22 +451,23 @@ class TaskEvaluator:
     def __init__(self, info: A.GraphInfo, profiler: Profiler,
                  devices: Optional[List[Any]] = None,
                  skip_fetch_resources: bool = False,
-                 precompile: Optional[Tuple[int, int, int]] = None):
+                 precompile: Optional[Tuple[int, int, int]] = None,
+                 instance: int = 0, instances: int = 1):
         self.info = info
         self.profiler = profiler
+        # device affinity: this pipeline instance owns ONE chip (instance
+        # i of P -> chip i mod n); all its stdlib device-kernel calls
+        # stage and run there.  `devices` (the dp-shard set for model
+        # kernels — models/infer.py DataParallelApply) defaults to this
+        # instance's partition of the host's chips: the whole host for a
+        # single instance (the reference's one-GPU-per-instance pinning,
+        # adapted), a disjoint slice each when instances run per chip.
+        # SCANNER_TPU_KERNEL_DEVICES=all extends both to the CPU backend
+        # so dryruns/tests exercise them on a virtual multi-device host.
+        self.instance = instance
+        self.device = assigned_device(instance)
         if devices is None:
-            import os
-
-            # hand every kernel this host's chips: model kernels dp-shard
-            # their batches across them (models/infer.py), the TPU
-            # equivalent of the reference pinning one GPU per instance.
-            # SCANNER_TPU_KERNEL_DEVICES=all extends this to the CPU
-            # backend so dryruns/tests exercise the dp-sharded kernel
-            # path on a virtual multi-device host.
-            if os.environ.get("SCANNER_TPU_KERNEL_DEVICES") == "all" \
-                    or _accel_backend():
-                import jax
-                devices = list(jax.local_devices())
+            devices = instance_devices(instance, instances)
         self.kernels: Dict[int, KernelInstance] = {}
         for n in info.ops:
             if not n.is_builtin:
@@ -351,7 +475,10 @@ class TaskEvaluator:
                 # explicitly pinned to CPU must not dp-shard onto TPU
                 n_devs = devices \
                     if n.effective_device() == DeviceType.TPU else None
-                ki = KernelInstance(n, profiler, n_devs)
+                ki = KernelInstance(
+                    n, profiler, n_devs,
+                    device=self.device
+                    if n.effective_device() == DeviceType.TPU else None)
                 self.kernels[n.id] = ki
         for ki in self.kernels.values():
             ki.setup(fetch=not skip_fetch_resources)
@@ -531,13 +658,17 @@ class TaskEvaluator:
         # Device staging: a device kernel gets its inputs moved host->device
         # ONCE per task column (async, whole batch); a host kernel gets
         # device inputs fetched once.  Updated in the store so sibling
-        # consumers of the same column reuse the placement.
+        # consumers of the same column reuse the placement.  The target is
+        # THIS instance's assigned chip: committed inputs pull the shared
+        # jitted kernel functions onto it, and a batch the loader
+        # pre-staged for this instance is already there (to_device no-ops
+        # instead of silently copying cross-chip).
         is_device_kernel = (n.effective_device() == DeviceType.TPU
-                            and _accel_backend())
+                            and _device_staging_enabled())
         for i, (c, b) in enumerate(zip(in_cols, in_batches)):
             if is_device_kernel and isinstance(b.data, np.ndarray) \
                     and b.data.dtype != object:
-                b = b.to_device()
+                b = b.to_device(ki.device)
             elif not is_device_kernel:
                 b = b.to_host()
             # resolve a pending wire-format conversion (YUV420 staged at
@@ -717,18 +848,24 @@ class TaskEvaluator:
                                         [live,
                                          np.repeat(live[-1:], pad)])
                                     _M_OP_PAD_ROWS.labels(
-                                        op=n.name).inc(pad)
+                                        op=n.name,
+                                        device=ki.dev_label).inc(pad)
                             args = call_args_for(exec_sel)
-                            # a never-seen arg (shape, dtype) signature
-                            # means XLA compiles a fresh executable for
-                            # a jitted kernel — surface it live
-                            sig = tuple(
+                            # a never-seen arg (device, shape, dtype)
+                            # signature means XLA compiles a fresh
+                            # executable for a jitted kernel — surface it
+                            # live.  The device is part of the key: each
+                            # assigned chip compiles its own ladder, and
+                            # the CI ladder-bound guard holds per chip.
+                            sig = (ki.dev_label,) + tuple(
                                 (tuple(a.shape), str(a.dtype))
                                 if is_array_data(a) else len(a)
                                 for a in args)
                             if sig not in ki._shape_sigs:
                                 ki._shape_sigs.add(sig)
-                                _M_OP_RECOMPILES.labels(op=n.name).inc()
+                                _M_OP_RECOMPILES.labels(
+                                    op=n.name,
+                                    device=ki.dev_label).inc()
                             res = ki.kernel.execute(*args)
                             if pad:
                                 res = _strip_pad(res, len(live),

@@ -71,6 +71,20 @@ _M_DECODE_SECONDS = _mx.registry().counter(
     "scanner_tpu_decode_seconds_total",
     "Seconds spent decoding video frames, per loader thread.",
     labels=["loader"])
+# per-chip utilization under evaluator affinity: every chip of a
+# multi-device host should take tasks and accumulate busy seconds; a
+# chip stuck at zero while siblings climb = an instance wedged or an
+# assignment bug ("default" = affinity off / single device)
+_M_DEV_TASKS = _mx.registry().counter(
+    "scanner_tpu_device_tasks_total",
+    "Tasks evaluated per assigned device (pipeline-instance affinity: "
+    "instance i stages and runs on chip i mod n_devices).",
+    labels=["device"])
+_M_DEV_BUSY = _mx.registry().counter(
+    "scanner_tpu_device_busy_seconds_total",
+    "Evaluate-stage wall seconds per assigned device — the per-chip "
+    "utilization series (busy/elapsed per chip ~ affinity efficiency).",
+    labels=["device"])
 
 _SENTINEL = object()
 _CHUNK_DONE = object()   # streaming producer: all chunks delivered
@@ -113,6 +127,12 @@ class TaskItem:
     # master-assigned attempt id (cluster mode): distinguishes re-issues
     # of the same task after a timeout revocation
     attempt: int = 0
+    # device affinity: the pipeline instance this task was assigned to at
+    # enqueue time and that instance's chip — recorded BEFORE loading so
+    # the loader's device staging targets the chip that will actually
+    # evaluate the task (a mismatch would silently copy cross-chip)
+    instance: int = 0
+    device: Optional[Any] = None
     # work-packet streaming (PerfParams.stream_work_packets): the task's
     # per-chunk plans, the loader->evaluator chunk queue, and the abort
     # handshake (evaluator failure must unblock a producing loader)
@@ -577,12 +597,39 @@ class LocalExecutor:
                                     evaluator_factory, close_evaluators,
                                     show_progress, total, precompile)
         qsize = queue_size or 4
-        eval_q: "queue.Queue" = queue.Queue(maxsize=qsize)
+        # stateful affinity: kernel state lives in ONE instance's kernels,
+        # so a chained run serializes evaluation (the reference pins a
+        # job's packets to one worker for the same reason).  One loader
+        # too: with N loaders, a decode-time inversion hands the
+        # evaluator task t+1 before t and every inversion costs a
+        # StateCarryMiss reload+recompute — per-task decode parallelism
+        # stays available via decoder_threads.
+        n_evals = 1 if self._chains else self.pipeline_instances
+        n_loaders = 1 if self._chains else self.num_load_workers
+        # Device-affine routing: when instances own distinct chips, each
+        # gets its OWN queue and the loader assigns each task to the
+        # least-loaded instance (round-robin tie-break) at enqueue time
+        # — the assignment is recorded on the TaskItem before loading so
+        # device staging targets the chip that will evaluate the task.
+        # A chained run (n_evals=1) or a single-chip host keeps today's
+        # shared queue (any instance takes any task).
+        from .evaluate import assigned_device, device_label
+        inst_devices = [assigned_device(i) for i in range(n_evals)]
+        if n_evals > 1 and any(d is not None for d in inst_devices):
+            eval_qs: List["queue.Queue"] = [queue.Queue(maxsize=qsize)
+                                            for _ in range(n_evals)]
+        else:
+            shared_q: "queue.Queue" = queue.Queue(maxsize=qsize)
+            eval_qs = [shared_q] * n_evals
+        uniq_qs = list({id(q): q for q in eval_qs}.values())
         save_q: "queue.Queue" = queue.Queue(maxsize=qsize)
         # live depth gauges sample the queues at scrape time; the last
         # pipeline to start owns the gauge (concurrent pipelines in one
         # process share the process registry)
-        depth_fns = {"evaluate": eval_q.qsize, "save": save_q.qsize}
+        depth_fns = {
+            "evaluate": lambda: sum(q.qsize() for q in uniq_qs),
+            "save": save_q.qsize,
+        }
         for stage, fn in depth_fns.items():
             _M_QDEPTH.labels(stage=stage).set_function(fn)
         errors: List[BaseException] = []
@@ -606,6 +653,28 @@ class LocalExecutor:
         # loader cache: (thread, job, node) -> DecoderAutomata
         tls = threading.local()
 
+        # Enqueue-time instance assignment: fixes which evaluator — and
+        # therefore which chip — a task runs on, BEFORE the loader
+        # stages its columns.  Least-loaded queue wins so one slow task
+        # can't head-of-line-block the whole pipeline (strict
+        # round-robin would keep feeding the slow instance until its
+        # queue filled and the loader stalled while other chips
+        # drained); a rotating start index breaks qsize ties fairly, so
+        # an idle pipeline still spreads tasks across every chip.
+        assign_lock = threading.Lock()
+        assign_counter = [0]
+
+        def assign_instance(w: TaskItem) -> None:
+            with assign_lock:
+                start = assign_counter[0] % n_evals
+                assign_counter[0] += 1
+            best = min(
+                range(n_evals),
+                key=lambda k: (eval_qs[(start + k) % n_evals].qsize(), k))
+            idx = (start + best) % n_evals
+            w.instance = idx
+            w.device = inst_devices[idx]
+
         def loader():
             try:
                 try:
@@ -616,6 +685,7 @@ class LocalExecutor:
                         if w == "wait":
                             time.sleep(0.2)
                             continue
+                        assign_instance(w)
                         try:
                             self.load_task(info, w, tls)
                         except Exception as e:  # noqa: BLE001
@@ -624,7 +694,7 @@ class LocalExecutor:
                         placed = False
                         while not stop.is_set():
                             try:
-                                eval_q.put(w, timeout=0.25)
+                                eval_qs[w.instance].put(w, timeout=0.25)
                                 placed = True
                                 break
                             except queue.Full:
@@ -647,10 +717,12 @@ class LocalExecutor:
                 return evaluator_factory(idx, skip_fetch)
             return TaskEvaluator(info, self.profiler,
                                  skip_fetch_resources=skip_fetch,
-                                 precompile=precompile)
+                                 precompile=precompile,
+                                 instance=idx, instances=n_evals)
 
         def evaluator(evaluator_idx: int):
             te = None
+            my_q = eval_qs[evaluator_idx]
             import types
             fb_tls = types.SimpleNamespace()  # fallback reload decoders
             try:
@@ -663,9 +735,9 @@ class LocalExecutor:
                     fetch_done.set()
                 while not stop.is_set():
                     try:
-                        w: TaskItem = eval_q.get(timeout=0.25)
+                        w: TaskItem = my_q.get(timeout=0.25)
                     except queue.Empty:
-                        if loaders_done.is_set() and eval_q.empty():
+                        if loaders_done.is_set() and my_q.empty():
                             break
                         continue
                     if w is _SENTINEL:
@@ -685,9 +757,16 @@ class LocalExecutor:
                             else:
                                 w.results = self._evaluate_with_fallback(
                                     info, te, w, fb_tls)
-                        _M_STAGE_SECONDS.labels(stage="evaluate").inc(
-                            time.time() - t0)
+                        # start the sink d2h now: the copy rides under
+                        # the NEXT task's evaluation instead of blocking
+                        # the saver (~180 ms per fetch over the tunnel)
+                        self._prefetch_results(w)
+                        dt = time.time() - t0
+                        _M_STAGE_SECONDS.labels(stage="evaluate").inc(dt)
                         _M_STAGE_TASKS.labels(stage="evaluate").inc()
+                        lbl = device_label(w.device)
+                        _M_DEV_TASKS.labels(device=lbl).inc()
+                        _M_DEV_BUSY.labels(device=lbl).inc(dt)
                         w.elements = None
                     except Exception as e:  # noqa: BLE001
                         task_failed(w, e)
@@ -746,15 +825,6 @@ class LocalExecutor:
         loaders_done = threading.Event()
         evals_done = threading.Event()
 
-        # stateful affinity: kernel state lives in ONE instance's kernels,
-        # so a chained run serializes evaluation (the reference pins a
-        # job's packets to one worker for the same reason).  One loader
-        # too: with N loaders, a decode-time inversion hands the
-        # evaluator task t+1 before t and every inversion costs a
-        # StateCarryMiss reload+recompute — per-task decode parallelism
-        # stays available via decoder_threads.
-        n_evals = 1 if self._chains else self.pipeline_instances
-        n_loaders = 1 if self._chains else self.num_load_workers
         loaders = [threading.Thread(target=loader, name=f"load-{i}")
                    for i in range(n_loaders)]
         evals = [threading.Thread(target=evaluator, args=(i,),
@@ -795,6 +865,7 @@ class LocalExecutor:
                     ) -> int:
         """The NO_PIPELINING path: every stage inline on this thread."""
         import types
+        from .evaluate import device_label
         tls = types.SimpleNamespace()
         fb_tls = types.SimpleNamespace()  # carry-miss fallback decoders
         if evaluator_factory is not None:
@@ -810,6 +881,9 @@ class LocalExecutor:
                 if w == "wait":
                     time.sleep(0.2)
                     continue
+                # single inline instance: staging still targets its
+                # assigned chip so serial runs match the threaded path
+                w.device = te.device
                 # Error routing mirrors the threaded path stage by stage:
                 # load / evaluate(+on_start) / save(+on_done) failures are
                 # task failures (on_task_error may absorb them), while an
@@ -836,9 +910,13 @@ class LocalExecutor:
                         else:
                             w.results = self._evaluate_with_fallback(
                                 info, te, w, fb_tls)
-                    _M_STAGE_SECONDS.labels(stage="evaluate").inc(
-                        time.time() - t0)
+                    self._prefetch_results(w)
+                    dt = time.time() - t0
+                    _M_STAGE_SECONDS.labels(stage="evaluate").inc(dt)
                     _M_STAGE_TASKS.labels(stage="evaluate").inc()
+                    lbl = device_label(w.device)
+                    _M_DEV_TASKS.labels(device=lbl).inc()
+                    _M_DEV_BUSY.labels(device=lbl).inc(dt)
                     w.elements = None
                 except Exception as e:  # noqa: BLE001
                     if on_task_error is not None and on_task_error(w, e):
@@ -1197,16 +1275,19 @@ class LocalExecutor:
         serializing at the front of the evaluate stage (PERF.md §3: h2d is
         a first-order term over the tunnel).  Only columns whose every
         first non-builtin consumer is a device kernel are staged — staging
-        a host-kernel input would add a device->host round-trip."""
-        from .evaluate import _accel_backend
-        if not _accel_backend():
+        a host-kernel input would add a device->host round-trip.  The
+        target is the chip of the instance this task was assigned to at
+        enqueue time (w.device): staging to the default chip for a task
+        that instance 3 will evaluate would force a cross-chip copy."""
+        from .evaluate import _device_staging_enabled
+        if not _device_staging_enabled():
             return
         cols = w.elements if elements is None else elements
         for nid, b in cols.items():
             if self._column_device_bound(info, nid) \
                     and isinstance(b.data, np.ndarray) \
                     and b.data.dtype != object:
-                cols[nid] = b.to_device()
+                cols[nid] = b.to_device(w.device)
 
     def _yuv_device_wire(self, info: A.GraphInfo, node_id: int) -> bool:
         """Should this video column decode to YUV420 wire format?  Yes
@@ -1424,12 +1505,34 @@ class LocalExecutor:
                                                   item_idx), blobs)
 
     @staticmethod
+    def _async_sink_fetch_enabled() -> bool:
+        """SCANNER_TPU_ASYNC_SINK_FETCH=0 opts out of starting sink
+        device->host copies at eval-done (the fetch then blocks in the
+        saver, the pre-affinity behavior; the ordering test A/Bs it)."""
+        import os
+        return os.environ.get("SCANNER_TPU_ASYNC_SINK_FETCH", "1") \
+            not in ("0", "false")
+
+    def _prefetch_results(self, w: TaskItem) -> None:
+        """Kick off the async device->host copy of every sink batch the
+        moment evaluation finishes — hung off the TaskItem before it
+        enters save_q, so task k's ~180 ms d2h latency rides under task
+        k+1's evaluation instead of serializing inside the saver."""
+        if not w.results or not self._async_sink_fetch_enabled():
+            return
+        for b in w.results.values():
+            if isinstance(b, ColumnBatch):
+                b.prefetch_host()
+
+    @staticmethod
     def _sink_rows(batch, start: int, end: int) -> List[Any]:
         """Materialize a sink ColumnBatch's rows [start, end) as host
-        elements (one device fetch; array rows become views).  The
-        contiguous range takes ColumnBatch.take_range's direct-slice
-        fast path — no index materialization or positions lookup."""
-        return batch.take_range(start, end).elements()
+        elements (array rows become views).  The whole batch is fetched
+        FIRST — completing the async copy _prefetch_results started at
+        eval-done (a device-side slice would be a fresh array the
+        prefetch never covered) — then the contiguous range takes
+        ColumnBatch.take_range's direct-slice fast path on host."""
+        return batch.to_host().take_range(start, end).elements()
 
     @staticmethod
     def _is_encodable(rows: List[Any]) -> bool:

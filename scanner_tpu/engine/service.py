@@ -1214,7 +1214,9 @@ class Worker:
     def __init__(self, master_address: str, db_path: str, port: int = 0,
                  storage_type: str = "posix",
                  num_load_workers: int = 2, num_save_workers: int = 2,
-                 pipeline_instances: int = 1,
+                 # None = one device-affine instance per local chip on
+                 # multi-chip hosts (resolved per bulk); explicit wins
+                 pipeline_instances: Optional[int] = None,
                  decoder_threads: int = 1,
                  coordinator=None,
                  metrics_port: Optional[int] = None,
@@ -1253,11 +1255,14 @@ class Worker:
             self.metrics_server = MetricsServer(
                 port=metrics_port, statusz=self._statusz,
                 healthz=lambda: {"role": "worker"}, host=metrics_host)
-        self.executor = LocalExecutor(self.db, self.profiler,
-                                      num_load_workers=num_load_workers,
-                                      num_save_workers=num_save_workers,
-                                      pipeline_instances=pipeline_instances,
-                                      decoder_threads=decoder_threads)
+        self.executor = LocalExecutor(
+            self.db, self.profiler,
+            num_load_workers=num_load_workers,
+            num_save_workers=num_save_workers,
+            # the per-bulk resolution (_ensure_bulk) overwrites this;
+            # the executor field itself just needs a concrete int
+            pipeline_instances=pipeline_instances or 1,
+            decoder_threads=decoder_threads)
         rpc.wait_for_server(master_address, MASTER_SERVICE)
         # dial the master only AFTER it provably listens: a gRPC channel
         # first dialed against a not-yet-listening address can wedge in
@@ -1424,11 +1429,16 @@ class Worker:
         self.executor.profiler = self.profiler
         # the job's PerfParams drive this node's pipeline shape (reference
         # worker.cpp:1467 pipeline instance spin-up from job params); an
-        # unset knob restores the worker's constructor default rather than
-        # inheriting the previous bulk's override
+        # unset knob restores the worker's constructor default — which on
+        # a multi-chip host resolves to one device-affine pipeline
+        # instance per local chip (engine/evaluate.py
+        # default_pipeline_instances; SCANNER_TPU_DEVICE_AFFINITY=0
+        # keeps the literal default)
+        from .evaluate import default_pipeline_instances
         self.executor.pipeline_instances = int(
             getattr(perf, "pipeline_instances_per_node", None)
-            or self._default_pipeline_instances)
+            or default_pipeline_instances(
+                self._default_pipeline_instances))
         self._queue_size = int(getattr(perf, "queue_size_per_pipeline", 4))
         info, jobs = self.executor.prepare_readonly(outputs, perf)
         # stateful task affinity: incremental plans when the master's
@@ -1548,7 +1558,11 @@ class Worker:
                         self._info, self.profiler,
                         skip_fetch_resources=skip_fetch,
                         precompile=LocalExecutor.precompile_hint(
-                            self._jobs or []))
+                            self._jobs or []),
+                        # device affinity: reused instance idx keeps
+                        # owning chip idx mod n across pipeline entries
+                        instance=idx,
+                        instances=self.executor.pipeline_instances)
                     self._evaluators[idx] = te
                 return te
 

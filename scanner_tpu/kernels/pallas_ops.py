@@ -92,6 +92,8 @@ def histogram_frames(frames: jnp.ndarray, bins: int = 16,
 
 def on_tpu() -> bool:
     try:
-        return jax.devices()[0].platform == "tpu"
+        # default_backend, not devices()[0]: a platform probe must not
+        # look like a chip pin (scanner-check SC106 device-affinity lint)
+        return jax.default_backend() == "tpu"
     except Exception:  # pragma: no cover
         return False

@@ -92,8 +92,14 @@ def device_trace(profiler, out_dir: Optional[str] = None):
         finally:
             try:
                 jax.profiler.stop_trace()
+                # t0/t1 bound the capture window on the host wall clock;
+                # consumers align against THIS window, not the host
+                # profiler's first span — under the level-2 python
+                # tracer, trace start can precede the first stage span
+                # by many seconds (thread bootstrap, instrumented
+                # setup), which is trace content, not misalignment
                 profiler.device_traces.append(
-                    {"dir": trace_dir, "t0": t0})
+                    {"dir": trace_dir, "t0": t0, "t1": time.time()})
             except Exception as e:  # noqa: BLE001
                 _log.warning("jax.profiler.stop_trace failed: %s", e)
     finally:

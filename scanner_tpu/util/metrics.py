@@ -394,6 +394,18 @@ def registry() -> MetricsRegistry:
     return _REGISTRY
 
 
+def labeled_samples(snapshot: Dict[str, dict], series: str
+                    ) -> Dict[str, float]:
+    """Flatten one series of a snapshot to {sorted-label-json: value}.
+    The stable keying the per-device utilization digests compare across
+    runs and processes (bench.py `multichip`, tools/tpu_window.py, the
+    tests/test_multichip.py equivalence suite): label order never leaks
+    into the key, so `{"device": "tpu:3", "op": "Histogram"}` is the
+    same sample wherever it was produced."""
+    return {json.dumps(s["labels"], sort_keys=True): s["value"]
+            for s in snapshot.get(series, {}).get("samples", [])}
+
+
 # process start time: lets consumers turn since-start counter values
 # into rates without a second poll (standard Prometheus practice)
 _REGISTRY.gauge(

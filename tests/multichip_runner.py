@@ -1,0 +1,150 @@
+"""Child process for tests/test_multichip.py: run the equivalence suite
+on a virtual multi-device host and dump output hashes + metrics.
+
+Spawned with cpu_only_env(n_devices=N) + SCANNER_TPU_KERNEL_DEVICES=all
+so the CPU backend exposes N virtual chips and the engine's device
+staging / evaluator-affinity paths engage exactly as they do on a real
+multi-chip worker.  Usage:
+
+    python multichip_runner.py <video_path> <out_json>
+
+Env knobs the parent sets: XLA_FLAGS (virtual device count),
+SCANNER_TPU_KERNEL_DEVICES=all, JAX_PLATFORMS=cpu.
+"""
+
+import hashlib
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+
+def _hash_rows(rows) -> list:
+    """Stable per-row digests: arrays hash shape+dtype+bytes, NullElement
+    hashes to 'null', plain values repr — bit-exactness across runs is
+    exactly digest equality."""
+    from scanner_tpu import NullElement
+    out = []
+    for e in rows:
+        if isinstance(e, NullElement):
+            out.append("null")
+        elif isinstance(e, np.ndarray) or hasattr(e, "shape"):
+            a = np.ascontiguousarray(np.asarray(e))
+            h = hashlib.sha256()
+            h.update(str(a.shape).encode())
+            h.update(str(a.dtype).encode())
+            h.update(a.tobytes())
+            out.append(h.hexdigest())
+        else:
+            out.append(repr(e))
+    return out
+
+
+def main() -> int:
+    video, out_path = sys.argv[1], sys.argv[2]
+    from scanner_tpu import (CacheMode, Client, DeviceType, FrameType,
+                             Kernel, NamedStream, NamedVideoStream,
+                             PerfParams, register_op)
+    import scanner_tpu.kernels  # noqa: F401  (registers Histogram)
+    from scanner_tpu.util.metrics import labeled_samples, registry
+    from typing import Any, Sequence
+    import jax
+
+    @register_op(device=DeviceType.TPU, stencil=[-1, 0], batch=8)
+    class McStencil(Kernel):
+        """Stencil device kernel (2-frame window sum) — numpy-bodied so
+        it is bit-exact however many chips run it."""
+
+        def execute(self, frame: Sequence[Sequence[FrameType]]
+                    ) -> Sequence[Any]:
+            a = np.asarray(frame, np.int64)
+            return a.reshape(len(a), -1).sum(axis=1)
+
+    @register_op(device=DeviceType.TPU, batch=16, unbounded_state=True)
+    class McTracker(Kernel):
+        """Unbounded-state chain kernel: running pixel-sum accumulator.
+        Under stateful_task_affinity its tasks serialize onto ONE
+        instance and therefore one chip — the invariant this suite
+        pins."""
+
+        def __init__(self, config):
+            super().__init__(config)
+            self._acc = 0
+
+        def reset(self):
+            self._acc = 0
+
+        def execute(self, frame: Sequence[FrameType]) -> Sequence[Any]:
+            f = np.asarray(frame, np.int64).reshape(len(frame), -1)
+            out = []
+            for i in range(len(f)):
+                self._acc += int(f[i].sum()) % 100003
+                out.append(self._acc)
+            return out
+
+    root = tempfile.mkdtemp(prefix="mc_")
+    sc = Client(db_path=os.path.join(root, "db"))
+    sc.ingest_videos([("mc", video)])
+
+    def snap_series(name):
+        return labeled_samples(registry().snapshot(), name)
+
+    def run(name, build, affinity=True, wp=8, io=16):
+        os.environ["SCANNER_TPU_DEVICE_AFFINITY"] = "1" if affinity else "0"
+        before_rc = snap_series("scanner_tpu_op_recompiles_total")
+        before_dev = snap_series("scanner_tpu_device_tasks_total")
+        frame = sc.io.Input([NamedVideoStream(sc, "mc")])
+        col, perf_kw = build(frame)
+        out = NamedStream(sc, name)
+        sc.run(sc.io.Output(col, [out]), PerfParams.manual(wp, io, **perf_kw),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+        after_rc = snap_series("scanner_tpu_op_recompiles_total")
+        after_dev = snap_series("scanner_tpu_device_tasks_total")
+        return {
+            "rows": _hash_rows(list(out.load())),
+            "recompiles_delta": {
+                k: after_rc.get(k, 0) - before_rc.get(k, 0)
+                for k in after_rc},
+            "device_tasks_delta": {
+                k: after_dev.get(k, 0) - before_dev.get(k, 0)
+                for k in after_dev},
+        }
+
+    results = {
+        "n_devices": len(jax.local_devices()),
+        "runs": {
+            # stateless jitted stdlib op (the flagship Histogram)
+            "hist": run("hist", lambda f: (sc.ops.Histogram(frame=f), {})),
+            # stencil windows across chunk/task boundaries
+            "stencil": run(
+                "stencil", lambda f: (sc.ops.McStencil(frame=f), {})),
+            # stateful chain: serializes onto one instance/chip
+            "chain": run(
+                "chain",
+                lambda f: (sc.ops.McTracker(frame=f),
+                           {"stateful_task_affinity": True})),
+            # null-interleaved geometry through the bucketed call
+            "nulls": run(
+                "nulls",
+                lambda f: (sc.ops.Histogram(
+                    frame=sc.streams.RepeatNull(
+                        sc.streams.Range(f, [(0, 12)]), [3])), {})),
+            # the A/B lever: affinity off must restore default-chip
+            # dispatch (every task on the "default" label), same results
+            "hist_no_affinity": run(
+                "hist_na",
+                lambda f: (sc.ops.Histogram(frame=f), {}),
+                affinity=False),
+        },
+    }
+    sc.stop()
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=1)
+    print("MULTICHIP_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

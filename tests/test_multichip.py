@@ -1,0 +1,263 @@
+"""Multi-chip evaluator affinity (engine/evaluate.py assigned_device &
+friends): per-device pipeline instances + async sink fetch.
+
+Four contracts pinned here:
+
+1. **Virtual multi-device equivalence** — the same bulk runs on a 1- and
+   a 4-device virtual host (XLA host platform devices +
+   SCANNER_TPU_KERNEL_DEVICES=all, the same lever the dp-shard path
+   uses) produce bit-exact outputs for stateless, stencil,
+   stateful-chain and null-interleaved pipelines.
+2. **Spread + ladder bound** — on the 4-device host, tasks land on >= 2
+   distinct chips (per-device task counters) and each (op, device)'s
+   distinct-executable count stays within the PR 2 bucket-ladder bound;
+   SCANNER_TPU_DEVICE_AFFINITY=0 restores default-chip dispatch (the
+   A/B lever) with identical results.
+3. **Assignment plumbing** — instance i of P owns chip i mod n; the
+   stateful-chain path keeps everything on one instance's chip;
+   pipeline_instances_per_node defaults to the device count only on
+   multi-device hosts.
+4. **Async sink fetch ordering** — results are identical whether the
+   sink d2h copy was prefetched at eval-done or only happens after the
+   saver dequeues (SCANNER_TPU_ASYNC_SINK_FETCH=0).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from scanner_tpu.engine.evaluate import bucket_ladder
+from scanner_tpu.util.jaxenv import cpu_only_env
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+RUNNER = os.path.join(HERE, "multichip_runner.py")
+N_FRAMES = 64
+W, H = 64, 48
+WP = 8  # runner's work packet: ladder is bucket_ladder(8)
+
+
+@pytest.fixture(scope="module")
+def video(tmp_path_factory):
+    from scanner_tpu import video as scv
+    root = tmp_path_factory.mktemp("multichip")
+    vid = str(root / "v.mp4")
+    scv.synthesize_video(vid, num_frames=N_FRAMES, width=W, height=H,
+                         fps=24, keyint=16)
+    return vid
+
+
+def _spawn(video, tmp_path, n_devices):
+    out = str(tmp_path / f"mc_{n_devices}.json")
+    env = cpu_only_env(n_devices=n_devices)
+    # script-by-path puts tests/ (not the repo root) on sys.path
+    env["PYTHONPATH"] = os.path.dirname(HERE) + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    env["SCANNER_TPU_KERNEL_DEVICES"] = "all"
+    env.pop("SCANNER_TPU_DEVICE_AFFINITY", None)
+    env.pop("SCANNER_TPU_BUCKETED", None)
+    r = subprocess.run(
+        [sys.executable, RUNNER, video, out],
+        env=env, cwd=os.path.dirname(HERE), capture_output=True,
+        text=True, timeout=900)
+    assert r.returncode == 0 and "MULTICHIP_OK" in r.stdout, \
+        f"runner failed (rc={r.returncode}):\n{r.stderr[-3000:]}"
+    with open(out) as f:
+        return json.load(f)
+
+
+@pytest.fixture(scope="module")
+def single(video, tmp_path_factory):
+    return _spawn(video, tmp_path_factory.mktemp("mc1"), 1)
+
+
+@pytest.fixture(scope="module")
+def quad(video, tmp_path_factory):
+    return _spawn(video, tmp_path_factory.mktemp("mc4"), 4)
+
+
+def test_virtual_hosts_have_expected_devices(single, quad):
+    assert single["n_devices"] == 1
+    assert quad["n_devices"] == 4
+
+
+@pytest.mark.parametrize("pipeline",
+                         ["hist", "stencil", "chain", "nulls"])
+def test_bit_exact_across_device_counts(single, quad, pipeline):
+    """Outputs of the 4-device run are bit-exact vs the 1-device run —
+    per-chip staging, per-chip executables and round-robin task
+    assignment change WHERE work runs, never what it computes."""
+    a = single["runs"][pipeline]["rows"]
+    b = quad["runs"][pipeline]["rows"]
+    assert a == b
+    assert len(a) > 0
+
+
+def test_tasks_spread_across_devices(quad):
+    """The 4-device bulk really uses multiple chips: the per-device task
+    counters (scanner_tpu_device_tasks_total) climb on >= 2 distinct
+    non-default devices during the stateless run (4 tasks round-robin
+    onto 4 instances)."""
+    delta = quad["runs"]["hist"]["device_tasks_delta"]
+    used = {k for k, v in delta.items()
+            if v > 0 and "default" not in k}
+    assert len(used) >= 2, delta
+
+
+def test_stateful_chain_stays_on_one_chip(quad):
+    """PR 2 invariant carried forward: a stateful-affinity chain
+    serializes onto one instance and therefore one chip."""
+    delta = quad["runs"]["chain"]["device_tasks_delta"]
+    used = {k for k, v in delta.items() if v > 0}
+    assert len(used) == 1, delta
+
+
+def test_per_device_recompiles_within_ladder(quad):
+    """Each (op, device)'s distinct-executable delta for one bulk stays
+    within the op's bucket ladder — the PR 2 CI guard, now holding PER
+    CHIP (the recompile proxy keys on (device, shape, dtype))."""
+    ladder = len(bucket_ladder(WP))
+    delta = quad["runs"]["hist"]["recompiles_delta"]
+    hist = {k: v for k, v in delta.items() if "Histogram" in k}
+    assert hist, delta
+    for labels, count in hist.items():
+        assert 0 <= count <= ladder, (labels, count, delta)
+
+
+def test_affinity_kill_switch_restores_default_dispatch(single, quad):
+    """SCANNER_TPU_DEVICE_AFFINITY=0 on the 4-device host: every task
+    evaluates under the "default" device label (no per-chip pinning)
+    and results stay identical — the acceptance A/B lever."""
+    na = quad["runs"]["hist_no_affinity"]
+    used = {k for k, v in na["device_tasks_delta"].items() if v > 0}
+    assert used and all("default" in k for k in used), used
+    assert na["rows"] == single["runs"]["hist"]["rows"]
+
+
+# ---------------------------------------------------------------------------
+# in-process unit coverage: assignment mapping + async sink fetch
+# ---------------------------------------------------------------------------
+
+def test_assigned_device_mapping(monkeypatch):
+    """instance i of P owns chip i mod n; partitions are disjoint and
+    cover the host; single instance keeps the whole dp-shard set."""
+    import scanner_tpu.engine.evaluate as ev
+
+    class _Dev:
+        def __init__(self, i):
+            self.id = i
+            self.platform = "cpu"
+
+        def __repr__(self):
+            return f"dev{self.id}"
+
+    devs = [_Dev(i) for i in range(4)]
+    monkeypatch.setattr(ev, "kernel_devices", lambda: list(devs))
+    monkeypatch.delenv("SCANNER_TPU_DEVICE_AFFINITY", raising=False)
+    assert [ev.assigned_device(i) for i in range(4)] == devs
+    assert ev.assigned_device(5) is devs[1]  # i mod n
+    # partitions: disjoint, cover all chips, lead with the owned chip
+    parts = [ev.instance_devices(i, 2) for i in range(2)]
+    assert parts[0][0] is devs[0] and parts[1][0] is devs[1]
+    flat = [d for p in parts for d in p]
+    assert sorted(d.id for d in flat) == [0, 1, 2, 3]
+    # one instance: whole host (model kernels keep dp-sharding it all)
+    assert ev.instance_devices(0, 1) == devs
+    # instance-count default: device count only when UNSET; an explicit
+    # value — including 1 (memory bound / serialized evaluation) — wins
+    assert ev.default_pipeline_instances(None) == 4
+    assert ev.default_pipeline_instances(0) == 4
+    assert ev.default_pipeline_instances(1) == 1
+    assert ev.default_pipeline_instances(2) == 2
+    # kill switch: no pinning, no device-count default
+    monkeypatch.setenv("SCANNER_TPU_DEVICE_AFFINITY", "0")
+    assert ev.assigned_device(0) is None
+    assert ev.default_pipeline_instances(None) == 1
+    assert ev.device_label(None) == "default"
+    assert ev.device_label(devs[2]) == "cpu:2"
+
+
+def _run_hist(sc, name, rows=24):
+    from scanner_tpu import CacheMode, NamedStream, NamedVideoStream, \
+        PerfParams
+    frame = sc.io.Input([NamedVideoStream(sc, "af")])
+    ranged = sc.streams.Range(frame, [(0, rows)])
+    out = NamedStream(sc, name)
+    sc.run(sc.io.Output(sc.ops.Histogram(frame=ranged), [out]),
+           PerfParams.manual(8, 16), cache_mode=CacheMode.Overwrite,
+           show_progress=False)
+    return list(out.load())
+
+
+@pytest.fixture()
+def af_client(tmp_path):
+    from scanner_tpu import Client
+    from scanner_tpu import video as scv
+    import scanner_tpu.kernels  # noqa: F401
+    vid = str(tmp_path / "v.mp4")
+    scv.synthesize_video(vid, num_frames=24, width=W, height=H, fps=24)
+    sc = Client(db_path=str(tmp_path / "db"))
+    sc.ingest_videos([("af", vid)])
+    yield sc
+    sc.stop()
+
+
+def test_async_sink_fetch_ordering(af_client, monkeypatch):
+    """Async-fetch A/B: with the prefetch disabled the d2h only happens
+    after the saver dequeues — results must be identical either way, and
+    the prefetch hook must actually fire on device-staged sink batches
+    when enabled (in-process via the 1-device virtual staging path)."""
+    from scanner_tpu.engine.batch import ColumnBatch
+
+    monkeypatch.setenv("SCANNER_TPU_KERNEL_DEVICES", "all")
+    calls = []
+    orig = ColumnBatch.prefetch_host
+
+    def spy(self):
+        calls.append(type(self.data).__module__)
+        return orig(self)
+
+    monkeypatch.setattr(ColumnBatch, "prefetch_host", spy)
+    monkeypatch.setenv("SCANNER_TPU_ASYNC_SINK_FETCH", "1")
+    rows_async = _run_hist(af_client, "af_async")
+    assert calls, "prefetch_host never fired with async fetch enabled"
+    n_async = len(calls)
+
+    # fetch-after-dequeue ordering: the saver pulls the task before any
+    # copy was started; correctness must not depend on the prefetch
+    monkeypatch.setenv("SCANNER_TPU_ASYNC_SINK_FETCH", "0")
+    rows_sync = _run_hist(af_client, "af_sync")
+    assert len(calls) == n_async, "prefetch fired despite opt-out"
+
+    assert len(rows_async) == len(rows_sync) == 24
+    for a, b in zip(rows_async, rows_sync):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_prefetch_host_is_safe_on_host_data():
+    """prefetch_host is a no-op (not an error) for host batches and
+    returns self for chaining."""
+    from scanner_tpu.engine.batch import ColumnBatch
+    b = ColumnBatch(np.arange(4), np.zeros((4, 3), np.uint8))
+    assert b.prefetch_host() is b
+    lst = ColumnBatch(np.arange(2), [b"x", b"y"])
+    assert lst.prefetch_host() is lst
+
+
+def test_to_device_targets_explicit_device(monkeypatch):
+    """ColumnBatch.to_device(device=...) commits the batch to the named
+    chip (the satellite: staging must never rely on the implicit
+    default device); re-staging to the same chip is a no-op."""
+    import jax
+    dev = jax.local_devices()[0]
+    from scanner_tpu.engine.batch import ColumnBatch
+    b = ColumnBatch(np.arange(4), np.arange(12, dtype=np.uint8
+                                            ).reshape(4, 3))
+    d = b.to_device(dev)
+    assert set(d.data.devices()) == {dev}
+    assert d.to_device(dev) is d  # already there: no copy
+    back = d.to_host()
+    assert np.array_equal(back.data, b.data)

@@ -143,6 +143,51 @@ def test_tracer_clean_fixture(tmp_path):
         [f.format() for f in findings]
 
 
+# SC106 scope is engine/kernels code: the same snippet is bad inside an
+# engine/ directory and invisible outside it (host tooling may pin chips)
+AFFINITY_BAD = """
+    import jax
+    from jax import local_devices
+
+    def stage(x):
+        d = jax.devices()[0]              # SC106: fixed-chip pin
+        return jax.device_put(x), d       # SC106: bare device_put
+
+    def probe():
+        return local_devices()[0]         # SC106: aliased import pin
+"""
+
+AFFINITY_CLEAN = """
+    import jax
+
+    def stage(x, device):
+        # explicit (possibly-None) device: placement decided upstream
+        return jax.device_put(x, device)
+
+    def probe():
+        return jax.default_backend() == "tpu"   # platform probe, no pin
+
+    def enumerate_chips():
+        return list(jax.local_devices())        # whole list: no pin
+"""
+
+
+def test_affinity_bad_fixture_in_engine_scope(tmp_path):
+    _write(tmp_path, "engine/bad_dev.py", AFFINITY_BAD)
+    _, findings = _analyze(tmp_path)
+    assert _codes(findings).count("SC106") == 3, \
+        [f.format() for f in findings]
+
+
+def test_affinity_clean_fixture_and_scope(tmp_path):
+    _write(tmp_path, "kernels/clean_dev.py", AFFINITY_CLEAN)
+    # identical bad code OUTSIDE engine/kernels scope: not SC106's beat
+    _write(tmp_path, "tools/pinner.py", AFFINITY_BAD)
+    _, findings = _analyze(tmp_path)
+    assert "SC106" not in _codes(findings), \
+        [f.format() for f in findings]
+
+
 def test_tracer_scan_body_and_kernel_execute(tmp_path):
     _write(tmp_path, "scanny.py", """
         import time

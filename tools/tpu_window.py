@@ -277,6 +277,70 @@ sc.stop()
 """
 
 
+_MC_UTIL = r"""
+import json, os, tempfile, time
+import jax
+from scanner_tpu import (CacheMode, Client, NamedStream, NamedVideoStream,
+                         PerfParams)
+import scanner_tpu.kernels
+from scanner_tpu import video as scv
+from scanner_tpu.util.metrics import labeled_samples, registry
+
+root = tempfile.mkdtemp(prefix="mc_hw_")
+vid = os.path.join(root, "v.mp4")
+N = 384
+scv.synthesize_video(vid, num_frames=N, width=640, height=480, fps=24,
+                     keyint=32)
+sc = Client(db_path=os.path.join(root, "db"))
+sc.ingest_videos([("bench", vid)])
+
+def run(name):
+    frames = sc.io.Input([NamedVideoStream(sc, "bench")])
+    out = NamedStream(sc, name)
+    t0 = time.time()
+    sc.run(sc.io.Output(sc.ops.Histogram(frame=frames), [out]),
+           PerfParams.manual(32, 96), cache_mode=CacheMode.Overwrite,
+           show_progress=False)
+    return round(N / (time.time() - t0), 1)
+
+def series(name):
+    return labeled_samples(registry().snapshot(), name)
+
+run("mc_warm")  # compile + page-cache warmup on every chip
+base_busy = series("scanner_tpu_device_busy_seconds_total")
+base_tasks = series("scanner_tpu_device_tasks_total")
+fps_aff = run("mc_aff")
+busy = series("scanner_tpu_device_busy_seconds_total")
+tasks = series("scanner_tpu_device_tasks_total")
+os.environ["SCANNER_TPU_DEVICE_AFFINITY"] = "0"   # the A/B lever
+fps_off = run("mc_off")
+out = {
+    "n_devices": len(jax.local_devices()),
+    "fps_affinity": fps_aff,
+    "fps_no_affinity": fps_off,
+    "device_tasks": {k: tasks.get(k, 0) - base_tasks.get(k, 0)
+                     for k in tasks},
+    "device_busy_seconds": {
+        k: round(busy.get(k, 0) - base_busy.get(k, 0), 3) for k in busy},
+}
+sc.stop()
+# bank the per-device utilization digest with the round's bench
+# evidence (the same file bench.py writes its digests to)
+path = os.path.join(os.getcwd(), "BENCH_DETAIL.json")
+try:
+    detail = json.load(open(path))
+    if not isinstance(detail, list):
+        detail = [detail]
+except Exception:
+    detail = []
+detail.append({"config": "multichip_hw",
+               "clock": time.strftime("%Y-%m-%dT%H:%M:%S"), **out})
+with open(path, "w") as f:
+    json.dump(detail, f, indent=1)
+print("MULTICHIP_UTIL " + json.dumps(out))
+"""
+
+
 def tunnel_up() -> bool:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from tpu_capture import tunnel_up as probe  # same probe + env override
@@ -324,6 +388,10 @@ def main() -> int:
     results["round5_ab"] = run_step(
         "YUV-wire x streaming isolation A/B (config 1)", code=_R5_AB,
         timeout=1200, marker="R5_AB ")
+    results["multichip_util"] = run_step(
+        "per-device utilization digest + affinity A/B (-> "
+        "BENCH_DETAIL.json)", code=_MC_UTIL,
+        timeout=1200, marker="MULTICHIP_UTIL ")
     results["op_bench"] = run_step(
         "per-op device/host A/B (tools/op_bench.py -> OP_BENCH.json)",
         argv=[sys.executable, "tools/op_bench.py"], timeout=1200)
