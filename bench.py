@@ -345,6 +345,54 @@ def main():
             "recompiles_by_device":
                 per_labels("scanner_tpu_op_recompiles_total"),
         })
+        def hist_quantiles(series: str, qs=(0.5, 0.9, 0.99)) -> dict:
+            """Estimate quantiles from a snapshot histogram by linear
+            interpolation within its buckets (the same estimate
+            Prometheus's histogram_quantile makes)."""
+            e = snap.get(series)
+            if not e or not e.get("samples"):
+                return {}
+            uppers = list(e.get("uppers") or [])
+            buckets = None
+            total, ssum = 0, 0.0
+            for smp in e["samples"]:
+                b = smp.get("buckets")
+                if not b:
+                    continue
+                if buckets is None:
+                    buckets = [0.0] * len(b)
+                for i, v in enumerate(b):
+                    buckets[i] += v
+                total += smp.get("count", 0)
+                ssum += smp.get("sum", 0.0)
+            if not buckets or not total:
+                return {}
+            edges = [0.0] + uppers  # bucket i spans [edges[i], uppers[i])
+            out = {"count": int(total),
+                   "mean_s": round(ssum / total, 4)}
+            for q in qs:
+                target = q * total
+                acc = 0.0
+                val = None
+                for i, c in enumerate(buckets):
+                    if acc + c >= target and c > 0:
+                        lo = edges[i] if i < len(edges) else edges[-1]
+                        hi = uppers[i] if i < len(uppers) else lo
+                        val = lo + (hi - lo) * (target - acc) / c
+                        break
+                    acc += c
+                if val is None:  # everything in the +Inf bucket
+                    val = uppers[-1] if uppers else 0.0
+                out[f"p{int(q * 100)}_s"] = round(val, 4)
+            return out
+
+        # end-to-end per-task latency digest (enqueue -> sink-committed):
+        # the serving-mode p50/p99 seed (ROADMAP item 2) banked per
+        # round so the latency trajectory ships with the fps one
+        detail.append({
+            "config": "task_latency",
+            **hist_quantiles("scanner_tpu_task_latency_seconds"),
+        })
         detail.append({"config": "metrics_registry", "snapshot": snap})
         # static-analysis digest: finding counts per code ride with every
         # perf round, so analyzer drift (new findings, baseline growth)

@@ -537,10 +537,32 @@ class ContractPass(AnalysisPass):
                         and stmt.targets[0].id == "RPC_CONTRACTS" \
                         and isinstance(stmt.value, ast.Dict):
                     contracts = {}
-                    for k in stmt.value.keys:
+                    for k, v in zip(stmt.value.keys, stmt.value.values):
                         ks = _const_str(k)
-                        if ks is not None:
-                            contracts[ks] = k
+                        if ks is None:
+                            continue
+                        contracts[ks] = k
+                        # a present-but-incomplete entry is the same
+                        # drift SC307 exists for: the classification
+                        # must carry BOTH the deadline class and the
+                        # idempotency verdict, as dict literals the
+                        # lint can see
+                        if not isinstance(v, ast.Dict):
+                            out.append(mod.finding(
+                                "SC307",
+                                f"RPC_CONTRACTS entry `{ks}` is not a "
+                                "dict literal — timeout/idempotency "
+                                "must be statically checkable", v))
+                            continue
+                        have = {_const_str(vk) for vk in v.keys}
+                        for want in ("timeout_s", "idempotent"):
+                            if want not in have:
+                                out.append(mod.finding(
+                                    "SC307",
+                                    f"RPC_CONTRACTS entry `{ks}` lacks "
+                                    f"`{want}` (every handler needs a "
+                                    "deadline class AND an idempotency "
+                                    "verdict)", v))
                     cmod = mod
         if contracts is None:
             anchor_mod, anchor_node = next(iter(registered.values()))

@@ -45,6 +45,14 @@ def default_config() -> Dict[str, Any]:
             # per process.
             "compilation_cache_dir": "",
         },
+        "trace": {
+            # distributed-tracing span recording (util/tracing.py):
+            # task/stage/op spans, flight recorder, cross-host trace
+            # assembly.  On by default (low overhead, docs/
+            # observability.md); the SCANNER_TPU_TRACING env var
+            # overrides per process.
+            "enabled": True,
+        },
         "faults": {
             # deterministic fault-injection plan (docs/robustness.md for
             # the clause syntax; util/faults.py implements it).  "" (the
@@ -115,6 +123,12 @@ class Config:
         disabled (the default)."""
         d = self.config.get("perf", {}).get("compilation_cache_dir", "")
         return d or None
+
+    @property
+    def tracing_enabled(self) -> bool:
+        """Distributed-tracing span recording (the deployment default;
+        SCANNER_TPU_TRACING overrides per process)."""
+        return bool(self.config.get("trace", {}).get("enabled", True))
 
     @property
     def faults_plan(self) -> Optional[str]:

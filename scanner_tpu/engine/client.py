@@ -95,6 +95,14 @@ class Client:
             from ..config import Config
             cfg = Config(config_path)
             db_path = db_path or cfg.db_path
+            # [trace] enabled: the deployment-wide tracing default; the
+            # SCANNER_TPU_TRACING env var (read at import) is the
+            # per-process override and wins when set.  Applied in both
+            # directions so a later Client with an enabling config
+            # isn't stuck with an earlier one's disable.
+            if not os.environ.get("SCANNER_TPU_TRACING"):
+                from ..util import tracing
+                tracing.set_enabled(cfg.tracing_enabled)
             # explicit argument beats config beats default
             storage_type = storage_type or cfg.storage_type
             if master is None:
@@ -125,6 +133,8 @@ class Client:
         self._db.load_megafile()
         self._profiler = Profiler(node="client")
         self._job_profiles: Dict[int, List[Profiler]] = {}
+        # job id -> {"trace_id", "bulk_id"} for Client.trace()
+        self._job_traces: Dict[int, Dict[str, Any]] = {}
         self._next_job_id = 0
         self._master_address = master
         self._cluster = None
@@ -319,9 +329,30 @@ class Client:
         self._next_job_id += 1
         prof = Profiler(node=f"job{job_id}")
         if self._cluster is not None:
-            profs = self._cluster.run(outputs, perf, cache_mode,
-                                      show_progress)
+            # the job's root trace span: NewJob (and the status polls)
+            # run under it, so the master admits the bulk with this
+            # trace_id and every worker task span chains back here
+            from ..util import tracing as _tr
+            tracer = _tr.default_tracer()
+            root = _tr.open_span(tracer, "job", mode="cluster")
+            try:
+                with _tr.use_span(tracer, root):
+                    profs = self._cluster.run(outputs, perf, cache_mode,
+                                              show_progress)
+            finally:
+                _tr.close_span(tracer, root)
+                # contribute the root span so the master's assembled
+                # trace is self-contained (scanner_trace --verify walks
+                # every chain to the root without this process)
+                if root is not None \
+                        and self._cluster.last_bulk_id is not None:
+                    self._cluster.ship_spans(
+                        self._cluster.last_bulk_id,
+                        tracer.spans_for_trace(root.trace_id))
             self._job_profiles[job_id] = profs
+            self._job_traces[job_id] = {
+                "trace_id": root.trace_id if root else None,
+                "bulk_id": self._cluster.last_bulk_id}
             return job_id
         # instance-count resolution: explicit kwarg > PerfParams >
         # explicit Client(pipeline_instances=) — any of which wins as
@@ -342,6 +373,8 @@ class Client:
         ex.run(outputs, perf, cache_mode=cache_mode,
                show_progress=show_progress)
         self._job_profiles[job_id] = [prof]
+        self._job_traces[job_id] = {"trace_id": ex.last_trace_id,
+                                    "bulk_id": None}
         return job_id
 
     def load_frames(self, table: str, rows, column: str = "frame"):
@@ -354,3 +387,67 @@ class Client:
         if job_id not in self._job_profiles:
             raise ScannerException(f"no profile for job {job_id}")
         return Profile(self._job_profiles[job_id])
+
+    def trace(self, job_id: int, path: Optional[str] = None) -> str:
+        """Write ONE merged cross-host Perfetto/Chrome trace for a
+        finished job: the assembled span tree (client root → master
+        scheduling → worker task → stage → op, all under the job's
+        trace_id) plus any captured XLA device timelines — cluster
+        profiles carry their device events inline, so remote chips'
+        lanes survive the hop (util/jaxprof.py).  Returns the path
+        written.  Open in ui.perfetto.dev; `tools/scanner_trace.py` is
+        the CLI flavor and adds straggler analytics."""
+        from ..util import tracing as _tr
+        info = self._job_traces.get(job_id)
+        if info is None or not info.get("trace_id"):
+            raise ScannerException(
+                f"no trace for job {job_id} (was tracing disabled? "
+                "SCANNER_TPU_TRACING / [trace] enabled)")
+        if self._cluster is not None and info.get("bulk_id") is not None:
+            reply = self._cluster.get_trace(info["bulk_id"])
+            # the run already shipped this process's root span; merge
+            # the flight recorder anyway (dedup by span id) in case
+            # that best-effort ship was lost
+            by_id = {d["span_id"]: d for d in reply.get("spans") or []}
+            for d in _tr.default_tracer().spans_for_trace(
+                    info["trace_id"]):
+                by_id.setdefault(d["span_id"], d)
+            spans = list(by_id.values())
+        else:
+            spans = _tr.default_tracer().spans_for_trace(info["trace_id"])
+            # local spans come from the bounded flight recorder: a big
+            # job can evict its own early spans (incl. the root) — say
+            # so instead of writing a silently partial trace
+            if not any(d["name"] == "job" for d in spans):
+                import logging
+                logging.getLogger("scanner_tpu.tracing").warning(
+                    "trace for job %d is partial: the flight recorder "
+                    "(SCANNER_TPU_TRACE_RING) evicted its earliest "
+                    "spans, including the root", job_id)
+        from ..util.jaxprof import DEVICE_PID_BASE, load_device_events
+        dev: List[Dict[str, Any]] = []
+        base = DEVICE_PID_BASE
+        for p in self._job_profiles.get(job_id, []):
+            for rec in getattr(p, "device_traces", []):
+                got = load_device_events(rec, pid_base=base)
+                dev.extend(got)
+                if got:
+                    base += 1000
+        path = path or f"scanner_trace_job{job_id}.json"
+        return _tr.write_chrome_trace(spans, path, device_events=dev)
+
+    def stragglers(self, job_id: int) -> Dict[str, Any]:
+        """Straggler analytics for a job: per-stage span stats + the
+        top-N slowest tasks with their trace ids.  Cluster mode reads
+        the master's incrementally-maintained summary (also on
+        GetJobStatus and /statusz); local mode computes it from this
+        process's flight recorder."""
+        from ..util import tracing as _tr
+        info = self._job_traces.get(job_id)
+        if info is None or not info.get("trace_id"):
+            raise ScannerException(f"no trace for job {job_id}")
+        if self._cluster is not None and info.get("bulk_id") is not None:
+            reply = self._cluster.get_trace(info["bulk_id"])
+            return reply.get("stragglers") or {}
+        return _tr.straggler_summary(
+            _tr.default_tracer().spans_for_trace(info["trace_id"]))

@@ -41,6 +41,7 @@ from ..common import (DeviceType, GraphException, JobException, NullElement,
 from ..graph import analysis as A
 from ..graph import ops as O
 from ..util import metrics as _mx
+from ..util import tracing as _tracing
 from ..util.log import get_logger
 from ..util.profiler import Profiler
 from .batch import ColumnBatch, concat_batches, is_array_data
@@ -866,6 +867,12 @@ class TaskEvaluator:
                                 _M_OP_RECOMPILES.labels(
                                     op=n.name,
                                     device=ki.dev_label).inc()
+                                # a recompile inside a traced task is a
+                                # latency cliff worth pinning to the
+                                # exact op span that paid it
+                                _tracing.add_event(
+                                    "xla.recompile", op=n.name,
+                                    device=ki.dev_label)
                             res = ki.kernel.execute(*args)
                             if pad:
                                 res = _strip_pad(res, len(live),

@@ -36,6 +36,7 @@ from ..storage import metadata as md
 from ..storage.streams import NamedVideoStream, StoredStream
 from ..util import faults as _faults
 from ..util import metrics as _mx
+from ..util import tracing as _tr
 from ..util.log import get_logger
 from ..util.profiler import Profiler
 from .batch import ColumnBatch, concat_batches
@@ -85,6 +86,19 @@ _M_DEV_BUSY = _mx.registry().counter(
     "Evaluate-stage wall seconds per assigned device — the per-chip "
     "utilization series (busy/elapsed per chip ~ affinity efficiency).",
     labels=["device"])
+# end-to-end per-task latency: enqueue (task runnable — local admission
+# or master bulk admission) to sink-committed.  The seed for
+# serving-mode p50/p99 (ROADMAP item 2): under a request-shaped
+# workload each "task" is a request and this histogram IS the latency
+# SLO series.  Observed by the committing side only — the local saver,
+# or the master at FinishedWork — so cluster runs never double-count.
+_M_TASK_LATENCY = _mx.registry().histogram(
+    "scanner_tpu_task_latency_seconds",
+    "End-to-end per-task latency from enqueue to sink-committed "
+    "(local: admission to save completion; cluster: bulk admission to "
+    "FinishedWork, observed on the master).",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+             120.0, 300.0, 600.0))
 
 _SENTINEL = object()
 _CHUNK_DONE = object()   # streaming producer: all chunks delivered
@@ -127,6 +141,16 @@ class TaskItem:
     # master-assigned attempt id (cluster mode): distinguishes re-issues
     # of the same task after a timeout revocation
     attempt: int = 0
+    # distributed tracing (util/tracing.py): the parent context this
+    # task's span attaches under (local: the job root span; cluster: the
+    # master's assign span from the NextWork reply), and the open task
+    # span itself — created by the loader, resumed by each stage thread,
+    # closed after save/failure
+    trace_ctx: Optional[Any] = None
+    trace_span: Optional[Any] = None
+    # when this task became runnable; 0 = unknown (cluster workers leave
+    # it unset: the master observes end-to-end latency there)
+    enqueued_at: float = 0.0
     # device affinity: the pipeline instance this task was assigned to at
     # enqueue time and that instance's chip — recorded BEFORE loading so
     # the loader's device staging targets the chip that will actually
@@ -217,6 +241,12 @@ class LocalExecutor:
         self._chains: Dict[int, _StatefulChain] = {}
         # PerfParams.stream_work_packets, latched per run/bulk
         self._stream_opt = True
+        # span sink for this executor's task/stage/op spans; a cluster
+        # Worker swaps in its own export-enabled tracer so spans ship to
+        # the master (ShipSpans)
+        self.tracer = _tr.default_tracer()
+        # trace_id of the last local run (Client.trace reads it)
+        self.last_trace_id: Optional[str] = None
 
     # ------------------------------------------------------------------
     # Job-set preparation (reference master.cpp:1367 process_job admission)
@@ -487,6 +517,32 @@ class LocalExecutor:
                 "incremental plans, single evaluation instance",
                 len(self._chains), ", ".join(sorted(set(unbounded))))
 
+    # -- tracing glue (util/tracing.py) --------------------------------
+
+    def _task_trace_begin(self, w: TaskItem) -> None:
+        """Open the task's span (idempotent): child of its trace context
+        — the job root span locally, the master's assign span in
+        cluster mode.  No context = no span (tracing off or untraced
+        caller); every stage then runs trace-free at one None check."""
+        if w.trace_span is None and w.trace_ctx is not None:
+            w.trace_span = _tr.open_span(
+                self.tracer, "task", parent=w.trace_ctx,
+                job=w.job.job_idx, task=w.task_idx, attempt=w.attempt)
+
+    def _task_scope(self, w: TaskItem):
+        """Resume the task span on the calling stage thread, so the
+        stage/op profiler spans inside nest under it."""
+        return _tr.use_span(self.tracer, w.trace_span)
+
+    def _task_trace_end(self, w: TaskItem,
+                        status: Optional[str] = None) -> None:
+        span, w.trace_span = w.trace_span, None
+        _tr.close_span(self.tracer, span, status=status)
+        if w.enqueued_at:
+            # enqueue -> sink-committed (or terminal failure; errors are
+            # latency too in a serving SLO)
+            _M_TASK_LATENCY.observe(time.time() - w.enqueued_at)
+
     def run(self, outputs: Sequence[O.OpNode], perf: PerfParams,
             cache_mode: CacheMode = CacheMode.Error,
             show_progress: bool = False) -> List[JobContext]:
@@ -499,15 +555,28 @@ class LocalExecutor:
                 for t, rng in enumerate(job.tasks)]
         _log.info("job set prepared: %d jobs (%d skipped), %d tasks",
                   len(jobs), sum(1 for j in jobs if j.skipped), len(work))
-        if work:
-            # level >= 2: capture the XLA device timeline around the job
-            # (SURVEY §5 tracing; merged into Profile.write_trace output)
-            from ..util.jaxprof import device_trace
-            with device_trace(self.profiler):
-                self._run_pipeline(
-                    info, work, show_progress,
-                    queue_size=int(perf.queue_size_per_pipeline),
-                    precompile=self.precompile_hint(jobs))
+        # the job's root trace span: every task span of this run chains
+        # up to it under one trace_id (Client.trace assembles the tree)
+        root = _tr.open_span(self.tracer, "job",
+                             tasks=len(work), jobs=len(jobs))
+        self.last_trace_id = root.trace_id if root is not None else None
+        now = time.time()
+        for w in work:
+            if root is not None:
+                w.trace_ctx = root.context()
+            w.enqueued_at = now
+        try:
+            if work:
+                # level >= 2: capture the XLA device timeline around the
+                # job (SURVEY §5; merged into Profile.write_trace output)
+                from ..util.jaxprof import device_trace
+                with device_trace(self.profiler):
+                    self._run_pipeline(
+                        info, work, show_progress,
+                        queue_size=int(perf.queue_size_per_pipeline),
+                        precompile=self.precompile_hint(jobs))
+        finally:
+            _tr.close_span(self.tracer, root)
         for job in jobs:
             if job.skipped:
                 continue
@@ -644,6 +713,10 @@ class LocalExecutor:
         def task_failed(w: TaskItem, e: BaseException) -> None:
             """Route one task's failure; abort unless the error handler
             accepts it (cluster mode reports FailedWork and moves on)."""
+            if w.trace_span is not None:
+                w.trace_span.add_event("error", type=type(e).__name__,
+                                       message=str(e)[:200])
+            self._task_trace_end(w, status="error")
             if on_task_error is not None and on_task_error(w, e):
                 return
             _log.exception("task (%d,%d) failed; aborting pipeline",
@@ -686,8 +759,10 @@ class LocalExecutor:
                             time.sleep(0.2)
                             continue
                         assign_instance(w)
+                        self._task_trace_begin(w)
                         try:
-                            self.load_task(info, w, tls)
+                            with self._task_scope(w):
+                                self.load_task(info, w, tls)
                         except Exception as e:  # noqa: BLE001
                             task_failed(w, e)
                             continue
@@ -702,7 +777,9 @@ class LocalExecutor:
                         if placed and w.chunk_plans is not None:
                             # streaming task: decode chunks into its
                             # bounded queue while the evaluator consumes
-                            self._produce_chunks(info, w, tls, stop=stop)
+                            with self._task_scope(w):
+                                self._produce_chunks(info, w, tls,
+                                                     stop=stop)
                 finally:
                     # release decoder handles held by this loader thread
                     for auto in getattr(tls, "automata", {}).values():
@@ -746,11 +823,13 @@ class LocalExecutor:
                         if on_start is not None and on_start(w) is False:
                             if w.chunk_abort is not None:
                                 w.chunk_abort.set()  # unblock the loader
+                            self._task_trace_end(w, status="revoked")
                             continue  # revoked attempt: drop silently
                         t0 = time.time()
-                        with self.profiler.span("evaluate", level=0,
-                                                task=w.task_idx,
-                                                job=w.job.job_idx):
+                        with self._task_scope(w), \
+                                self.profiler.span("evaluate", level=0,
+                                                   task=w.task_idx,
+                                                   job=w.job.job_idx):
                             if w.chunk_q is not None:
                                 w.results = self._consume_chunks(
                                     info, te, w, fb_tls, stop=stop)
@@ -802,12 +881,19 @@ class LocalExecutor:
                         continue
                     try:
                         t0 = time.time()
-                        with self.profiler.span("save", level=0, task=w.task_idx,
-                                                job=w.job.job_idx):
-                            self._save_task(info, w)
+                        with self._task_scope(w):
+                            with self.profiler.span("save", level=0,
+                                                    task=w.task_idx,
+                                                    job=w.job.job_idx):
+                                self._save_task(info, w)
                         _M_STAGE_SECONDS.labels(stage="save").inc(
                             time.time() - t0)
                         _M_STAGE_TASKS.labels(stage="save").inc()
+                        # close the span BEFORE on_done: the cluster
+                        # worker's completion hook ships spans then sends
+                        # FinishedWork, so the master holds this task's
+                        # full chain before the bulk can finish
+                        self._task_trace_end(w)
                         if on_done is not None:
                             on_done(w)
                     except Exception as e:  # noqa: BLE001
@@ -890,14 +976,18 @@ class LocalExecutor:
                 # on_eval_done failure — cluster bookkeeping RPC, not task
                 # work — is a pipeline error and propagates (the threaded
                 # evaluator calls it outside its per-task try).
+                self._task_trace_begin(w)
                 try:
-                    self.load_task(info, w, tls)
+                    with self._task_scope(w):
+                        self.load_task(info, w, tls)
                     if on_start is not None and on_start(w) is False:
+                        self._task_trace_end(w, status="revoked")
                         continue  # revoked attempt
                     t0 = time.time()
-                    with self.profiler.span("evaluate", level=0,
-                                            task=w.task_idx,
-                                            job=w.job.job_idx):
+                    with self._task_scope(w), \
+                            self.profiler.span("evaluate", level=0,
+                                               task=w.task_idx,
+                                               job=w.job.job_idx):
                         if w.chunk_plans is not None:
                             # inline streaming on this one thread; the
                             # carry-miss fallback loads through fb_tls —
@@ -919,6 +1009,11 @@ class LocalExecutor:
                     _M_DEV_BUSY.labels(device=lbl).inc(dt)
                     w.elements = None
                 except Exception as e:  # noqa: BLE001
+                    if w.trace_span is not None:
+                        w.trace_span.add_event(
+                            "error", type=type(e).__name__,
+                            message=str(e)[:200])
+                    self._task_trace_end(w, status="error")
                     if on_task_error is not None and on_task_error(w, e):
                         continue
                     raise
@@ -926,16 +1021,23 @@ class LocalExecutor:
                     on_eval_done(w)
                 try:
                     t0 = time.time()
-                    with self.profiler.span("save", level=0,
-                                            task=w.task_idx,
-                                            job=w.job.job_idx):
-                        self._save_task(info, w)
+                    with self._task_scope(w):
+                        with self.profiler.span("save", level=0,
+                                                task=w.task_idx,
+                                                job=w.job.job_idx):
+                            self._save_task(info, w)
                     _M_STAGE_SECONDS.labels(stage="save").inc(
                         time.time() - t0)
                     _M_STAGE_TASKS.labels(stage="save").inc()
+                    self._task_trace_end(w)
                     if on_done is not None:
                         on_done(w)
                 except Exception as e:  # noqa: BLE001
+                    if w.trace_span is not None:
+                        w.trace_span.add_event(
+                            "error", type=type(e).__name__,
+                            message=str(e)[:200])
+                    self._task_trace_end(w, status="error")
                     if on_task_error is not None and on_task_error(w, e):
                         continue
                     raise
@@ -1153,6 +1255,7 @@ class LocalExecutor:
                       "self-contained", w.job.job_idx, w.task_idx,
                       plan.output_range, e)
             self.profiler.count("state_carry_miss")
+            _tr.add_event("state_carry_miss", chunk=str(plan.output_range))
             plan2 = A.derive_task_streams(
                 info, w.job.jr, plan.output_range,
                 job_idx=w.job.job_idx, task_idx=w.task_idx)
@@ -1177,6 +1280,7 @@ class LocalExecutor:
             _log.info("task (%d,%d): %s — re-running self-contained",
                       w.job.job_idx, w.task_idx, e)
             self.profiler.count("state_carry_miss")
+            _tr.add_event("state_carry_miss")
             w.plan = A.derive_task_streams(
                 info, w.job.jr, w.output_range,
                 job_idx=w.job.job_idx, task_idx=w.task_idx)

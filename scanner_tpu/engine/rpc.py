@@ -21,6 +21,7 @@ from ..common import ScannerException
 from ..storage.metadata import pack, unpack
 from ..util import faults as _faults
 from ..util import metrics as _mx
+from ..util import tracing as _tracing
 from ..util.log import get_logger
 from ..util.retry import call_with_backoff
 
@@ -49,6 +50,13 @@ _M_RPC_LATENCY = _mx.registry().histogram(
     "serialize).",
     labels=["method"])
 
+# high-frequency poll/liveness methods: their traceparent still
+# propagates (handlers can read the current context) but no server span
+# is minted per call — a 4 Hz status poll over a long bulk would churn
+# the flight recorder with nothing a timeline needs
+_SPAN_SKIP = frozenset({"Ping", "Heartbeat", "GetJobStatus",
+                        "GetMetrics", "PokeWatchdog"})
+
 
 class RpcError(ScannerException):
     pass
@@ -56,9 +64,29 @@ class RpcError(ScannerException):
 
 class _GenericService(grpc.GenericRpcHandler):
     def __init__(self, service_name: str,
-                 methods: Dict[str, Callable[[dict], dict]]):
+                 methods: Dict[str, Callable[[dict], dict]],
+                 tracer: Optional[_tracing.Tracer] = None):
         self._prefix = f"/{service_name}/"
         self._methods = methods
+        self._tracer = tracer
+
+    def _handle(self, short_name: str, method, req: dict) -> dict:
+        """Re-establish the caller's trace context around the handler:
+        the `_traceparent` payload key (injected by RpcClient.call) is
+        popped before the handler sees the request, and a server span
+        `rpc:<Method>` is opened under it — the cross-host hop in the
+        assembled task timeline."""
+        ctx = _tracing.parse_traceparent(req.pop(
+            _tracing.TRACEPARENT_KEY, None))
+        tracer = self._tracer
+        if ctx is None or tracer is None or not _tracing.enabled():
+            return method(req)
+        if short_name in _SPAN_SKIP:
+            with _tracing.use_context(tracer, ctx):
+                return method(req)
+        with _tracing.start_span(tracer, f"rpc:{short_name}",
+                                 parent=ctx):
+            return method(req)
 
     def service(self, handler_call_details):
         name = handler_call_details.method
@@ -75,7 +103,8 @@ class _GenericService(grpc.GenericRpcHandler):
             try:
                 if _faults.ACTIVE:
                     _faults.inject("rpc.server.handle", detail=short_name)
-                return pack(method(unpack(request)))
+                return pack(self._handle(short_name, method,
+                                         unpack(request)))
             except Exception as e:  # noqa: BLE001
                 # the server-side stack would otherwise be discarded:
                 # only "type: msg" crosses the wire in the INTERNAL
@@ -96,12 +125,13 @@ class RpcServer:
 
     def __init__(self, service_name: str,
                  methods: Dict[str, Callable[[dict], dict]],
-                 port: int = 0, max_workers: int = 8):
+                 port: int = 0, max_workers: int = 8,
+                 tracer: Optional[_tracing.Tracer] = None):
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
             options=GRPC_OPTIONS)
         self._server.add_generic_rpc_handlers(
-            (_GenericService(service_name, methods),))
+            (_GenericService(service_name, methods, tracer=tracer),))
         self.port = self._server.add_insecure_port(f"0.0.0.0:{port}")
         if self.port == 0:
             raise RpcError(f"could not bind port {port}")
@@ -146,6 +176,14 @@ class RpcClient:
             f"/{self._service}/{method}",
             request_serializer=lambda x: x,
             response_deserializer=lambda x: x)
+        # context propagation: the current span context (if any) rides
+        # in the payload as `_traceparent`; the server glue pops it and
+        # re-establishes the context around the handler, so one
+        # trace_id follows a job across every hop with no handler
+        # signature changing
+        tp = _tracing.current_traceparent()
+        if tp is not None:
+            payload.setdefault(_tracing.TRACEPARENT_KEY, tp)
         req = pack(payload)
 
         def attempt():

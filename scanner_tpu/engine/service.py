@@ -20,6 +20,7 @@ Differences from the reference, chosen deliberately:
 
 from __future__ import annotations
 
+import heapq
 import threading
 import time
 from collections import deque
@@ -33,12 +34,13 @@ from ..storage import Database, make_storage
 from ..storage import metadata as md
 from ..util import faults as _faults
 from ..util import metrics as _mx
+from ..util import tracing as _tracing
 from ..util.log import get_logger
 from ..util.metrics import MetricsServer, merge_snapshots
 from ..util.profiler import Profiler
 from . import rpc
 from .evaluate import TaskEvaluator
-from .executor import LocalExecutor, TaskItem
+from .executor import _M_TASK_LATENCY, LocalExecutor, TaskItem
 
 PING_INTERVAL = 1.0          # worker heartbeat period
 # per-call deadline for heartbeat/ping RPCs.  Deliberately ~2x the ping
@@ -84,8 +86,20 @@ RPC_CONTRACTS = {
     "PokeWatchdog":     {"timeout_s": 30.0, "idempotent": True},
     "PostProfile":      {"timeout_s": 30.0, "idempotent": False},
     "GetProfiles":      {"timeout_s": 30.0, "idempotent": True},
+    "ShipSpans":        {"timeout_s": 30.0, "idempotent": False},
+    "GetTrace":         {"timeout_s": 30.0, "idempotent": True},
     "Shutdown":         {"timeout_s": PING_TIMEOUT, "idempotent": True},
 }
+
+# cross-host trace assembly bounds: spans kept per bulk on the master
+# (overflow counts into the GetTrace/status `spans_dropped` field), the
+# straggler top-N surfaced on /statusz + GetJobStatus, and how many
+# RECENT bulks keep their full span store — a long-lived master serving
+# many bulks must not retain 500k dicts per historical bulk forever
+# (the straggler aggregates, which are tiny, are kept for all history)
+MAX_BULK_SPANS = 500_000
+STRAGGLER_TOP_N = 10
+SPAN_HISTORY_BULKS = 4
 
 _mlog = get_logger("master")
 _wlog = get_logger("worker")
@@ -234,6 +248,21 @@ class _BulkJob:
     finished: bool = False
     error: str = ""
     profiles: List[dict] = field(default_factory=list)
+    # distributed tracing (util/tracing.py): the job's trace_id (from
+    # the submitting client's traceparent, or minted at admission), the
+    # master-side parent span id new assign spans chain under, the
+    # assembled cross-host span store (workers ShipSpans into it), and
+    # the incrementally-maintained straggler aggregates — per-stage
+    # duration stats plus a bounded min-heap of the slowest task spans
+    # ((duration, seq, job, task, node, span_id); seq breaks duration
+    # ties so heterogenous payloads never reach tuple comparison)
+    trace_id: str = ""
+    trace_parent: str = ""
+    spans: List[dict] = field(default_factory=list)
+    span_drops: int = 0
+    span_stats: Dict[str, List[float]] = field(default_factory=dict)
+    slowest: List[Tuple] = field(default_factory=list)
+    slow_seq: int = 0
     # live-status bookkeeping: output rows per task (from the admission
     # job geometry) and cumulative rows through each pipeline stage
     # transition the master observes (NextWork->StartedWork = loaded,
@@ -312,6 +341,10 @@ class Master:
         self.db = Database(make_storage(storage_type, db_path=db_path))
         self.no_workers_timeout = no_workers_timeout
         self.enable_watchdog = enable_watchdog
+        # master-side span sink (export drained into each bulk's span
+        # store): admission/assignment spans are the cross-host glue
+        # between the client's root span and worker task spans
+        self.tracer = _tracing.Tracer(node="master", export=True)
         self._lock = threading.RLock()
         self._admit_lock = threading.Lock()
         self._workers: Dict[int, _WorkerInfo] = {}
@@ -345,8 +378,10 @@ class Master:
             "PokeWatchdog": self._rpc_poke,
             "PostProfile": self._rpc_post_profile,
             "GetProfiles": self._rpc_get_profiles,
+            "ShipSpans": self._rpc_ship_spans,
+            "GetTrace": self._rpc_get_trace,
             "Shutdown": self._rpc_shutdown,
-        }, port=port)
+        }, port=port, tracer=self.tracer)
         self.port = self._server.port
         self._server.start()
         # /metrics + /healthz + /statusz — strictly opt-in: no listener
@@ -411,6 +446,13 @@ class Master:
             with self._lock:
                 if self._bulk is not None and not self._bulk.finished:
                     return {"error": "a bulk job is already active"}
+            # one trace_id per job: the submitting client's context (the
+            # rpc:NewJob server span, re-established by the RPC glue) —
+            # or a fresh trace when the caller is untraced, so worker
+            # spans still assemble under ONE id either way
+            tctx = _tracing.current_context()
+            trace_id = tctx.trace_id if tctx else _tracing.new_trace_id()
+            trace_parent = tctx.span_id if tctx else ""
             spec = cloudpickle.loads(req["spec"])
             outputs = spec["outputs"]
             perf: PerfParams = spec["perf"]
@@ -434,7 +476,8 @@ class Master:
                     task_timeout=float(getattr(perf, "task_timeout", 0.0)),
                     checkpoint_frequency=int(
                         getattr(perf, "checkpoint_frequency", 0) or 0),
-                    sticky=sticky)
+                    sticky=sticky,
+                    trace_id=trace_id, trace_parent=trace_parent)
                 self._next_bulk_id += 1
                 for job in jobs:
                     if job.skipped:
@@ -457,6 +500,11 @@ class Master:
                 if bulk.total_tasks == 0:
                     bulk.mark_finished()
                 self._history[bulk.bulk_id] = bulk
+                # bound trace retention: only the newest
+                # SPAN_HISTORY_BULKS bulks keep full span stores; older
+                # ones keep just their (small) straggler aggregates
+                for bid in sorted(self._history)[:-SPAN_HISTORY_BULKS]:
+                    self._history[bid].spans = []
                 _mlog.info(
                     "bulk %d admitted: %d jobs, %d tasks",
                     bulk.bulk_id, len(bulk.job_tasks), bulk.total_tasks)
@@ -552,8 +600,22 @@ class Master:
                 bulk.held[wid] = bulk.held.get(wid, 0) + 1
                 _mlog.debug("task (%d,%d) assigned to worker %d "
                             "(attempt %d)", j, t, wid, attempt)
-                return {"status": "task", "job_idx": j, "task_idx": t,
-                        "attempt": attempt}
+                reply = {"status": "task", "job_idx": j, "task_idx": t,
+                         "attempt": attempt}
+                # the cross-host hop: an (instantaneous) assignment span
+                # in the job's trace whose id the worker parents its
+                # task span under — master → worker stays one unbroken
+                # chain per attempt
+                sp = _tracing.open_span(
+                    self.tracer, "master.assign",
+                    parent=_tracing.SpanContext(bulk.trace_id,
+                                                bulk.trace_parent),
+                    job=j, task=t, attempt=attempt, worker=wid) \
+                    if bulk.trace_id else None
+                if sp is not None:
+                    _tracing.close_span(self.tracer, sp)
+                    reply["traceparent"] = sp.context().traceparent()
+                return reply
             if bulk.outstanding or bulk.q_has_work():
                 return {"status": "wait"}
             return {"status": "done"}
@@ -605,6 +667,15 @@ class Master:
             bulk = self._bulk
             if bulk is None or bulk.bulk_id != req["bulk_id"]:
                 return {"ok": False}
+            # piggybacked trace spans (the worker drains its export
+            # buffer into every FinishedWork, so no second RPC rides
+            # the per-task hot path): absorbed before the revocation
+            # check — a revoked attempt's spans are still real history.
+            # The master's OWN spans drain here too: on a large bulk
+            # the assign spans would otherwise pool in the tracer's
+            # export buffer (cap 65536) until end-of-bulk and overflow.
+            self._drain_master_spans_locked()
+            self._absorb_batch_locked(bulk, req.get("spans") or ())
             # a completion only counts if this worker still holds the
             # assignment WITH the same attempt id — revoked
             # (timed-out/reassigned) attempts are ignored, the in-process
@@ -621,6 +692,9 @@ class Master:
             bulk.job_done[key[0]] = bulk.job_done.get(key[0], 0) + 1
             bulk.stage_rows["save"] += bulk.task_rows.get(key, 0)
             _M_TASKS_DONE.inc()
+            # end-to-end latency, enqueue (bulk admission made the task
+            # runnable) -> sink-committed: the serving-mode p50/p99 seed
+            _M_TASK_LATENCY.observe(time.time() - bulk.admitted_at)
             _mlog.debug("task (%d,%d) finished by worker %d "
                         "(%d/%d done)", key[0], key[1],
                         req.get("worker_id", -1), len(bulk.done),
@@ -736,6 +810,10 @@ class Master:
             "error": bulk.error,
             "num_workers": sum(1 for w in self._workers.values()
                                if w.active),
+            # straggler analytics from shipped spans: per-stage stats +
+            # top-N slowest tasks with trace ids (also on /statusz)
+            "trace_id": bulk.trace_id,
+            "stragglers": self._stragglers_locked(bulk),
         }
 
     def _rpc_job_status(self, req: dict) -> dict:
@@ -816,6 +894,114 @@ class Master:
         with self._lock:
             bulk = self._history.get(req["bulk_id"])
             return {"profiles": list(bulk.profiles) if bulk else []}
+
+    # -- trace assembly (util/tracing.py) -----------------------------------
+
+    def _absorb_span_locked(self, bulk: _BulkJob, d: dict) -> None:
+        """One shipped span into the bulk's store + the incremental
+        straggler aggregates (per-stage stats, slowest-task heap).
+        Caller holds self._lock."""
+        if len(bulk.spans) < MAX_BULK_SPANS:
+            bulk.spans.append(d)
+        else:
+            bulk.span_drops += 1
+        name = d.get("name")
+        if not isinstance(name, str):
+            return
+        dur = max(float(d.get("end") or 0.0)
+                  - float(d.get("start") or 0.0), 0.0)
+        if name in ("task", "load", "evaluate", "save") \
+                or name.startswith("evaluate:"):
+            st = bulk.span_stats.setdefault(name, [0, 0.0, 0.0])
+            st[0] += 1
+            st[1] += dur
+            st[2] = max(st[2], dur)
+        if name == "task":
+            a = d.get("attrs") or {}
+            bulk.slow_seq += 1
+            heapq.heappush(bulk.slowest, (
+                dur, bulk.slow_seq, a.get("job"), a.get("task"),
+                d.get("node"), d.get("span_id")))
+            if len(bulk.slowest) > STRAGGLER_TOP_N:
+                heapq.heappop(bulk.slowest)
+
+    def _drain_master_spans_locked(self) -> None:
+        """Move the master's own completed spans (admission, assigns,
+        per-task rpc handling) into their bulks' span stores, routed by
+        trace_id.  Caller holds self._lock."""
+        orphans = []
+        for d in self.tracer.drain_export():
+            tid = d.get("trace_id")
+            for bulk in self._history.values():
+                if bulk.trace_id == tid:
+                    self._absorb_span_locked(bulk, d)
+                    break
+            else:
+                orphans.append(d)
+        # spans for no known bulk (e.g. a pre-admission failure) are
+        # dropped — the flight recorder still holds them for a dump
+        del orphans
+
+    def _stragglers_locked(self, bulk: _BulkJob) -> dict:
+        """Straggler analytics from the incrementally-maintained
+        aggregates: per-stage critical-path stats + the top-N slowest
+        tasks with their trace ids (jump straight into the merged
+        trace).  Shape matches tracing.straggler_summary."""
+        per = {}
+        for name, (c, tot, mx) in sorted(bulk.span_stats.items()):
+            per[name] = {"count": int(c), "total_s": round(tot, 4),
+                         "max_s": round(mx, 4),
+                         "mean_s": round(tot / c, 4) if c else 0.0}
+        slow = [{"job": j, "task": t, "seconds": round(dur, 4),
+                 "node": node, "trace_id": bulk.trace_id,
+                 "span_id": sid}
+                for dur, _seq, j, t, node, sid
+                in sorted(bulk.slowest, reverse=True)]
+        return {"per_stage": per, "slowest_tasks": slow,
+                "spans": len(bulk.spans),
+                "spans_dropped": bulk.span_drops}
+
+    def _absorb_batch_locked(self, bulk: _BulkJob, spans) -> None:
+        """A shipped batch into the assembly, routed by trace_id —
+        stale buffer content from a previous bulk goes home instead of
+        polluting this trace.  Caller holds self._lock."""
+        for d in spans:
+            if isinstance(d, dict) and d.get("trace_id"):
+                if d["trace_id"] == bulk.trace_id:
+                    self._absorb_span_locked(bulk, d)
+                else:
+                    for other in self._history.values():
+                        if other.trace_id == d["trace_id"]:
+                            self._absorb_span_locked(other, d)
+                            break
+
+    def _rpc_ship_spans(self, req: dict) -> dict:
+        """Out-of-band span shipping: task-completion spans piggyback
+        on FinishedWork instead, so this carries the rest — failed
+        attempts, the worker's final flush, the client's root span."""
+        with self._lock:
+            self._touch_worker(req.get("worker_id"))
+            self._drain_master_spans_locked()
+            bulk = self._history.get(req["bulk_id"])
+            if bulk is None:
+                return {"ok": False}
+            self._absorb_batch_locked(bulk, req.get("spans") or [])
+        return {"ok": True}
+
+    def _rpc_get_trace(self, req: dict) -> dict:
+        """The assembled cross-host trace of one bulk: every shipped
+        worker span plus the master's own, and the straggler summary
+        (Client.trace / tools/scanner_trace.py)."""
+        with self._lock:
+            bulk = self._history.get(req["bulk_id"]) \
+                if req.get("bulk_id") is not None else self._bulk
+            if bulk is None:
+                return {"error": "no such bulk job"}
+            self._drain_master_spans_locked()
+            return {"trace_id": bulk.trace_id,
+                    "spans": list(bulk.spans),
+                    "spans_dropped": bulk.span_drops,
+                    "stragglers": self._stragglers_locked(bulk)}
 
     def _rpc_shutdown(self, req: dict) -> dict:
         """Remote cluster stop (Client.shutdown_cluster / blocking
@@ -957,7 +1143,10 @@ class Master:
             task_timeout=state["task_timeout"],
             checkpoint_frequency=state["checkpoint_frequency"],
             # pre-sticky checkpoints default off (missing key)
-            sticky=bool(state.get("sticky", False)))
+            sticky=bool(state.get("sticky", False)),
+            # pre-crash spans are gone with the old process; post-
+            # recovery assignments still assemble under one fresh trace
+            trace_id=_tracing.new_trace_id())
         for j, n in state["job_ntasks"].items():
             job = jobs[j]
             bulk.job_tasks[j] = {(j, t) for t in range(n)}
@@ -1237,6 +1426,10 @@ class Worker:
             initialize(coordinator)
         self.db = Database(make_storage(storage_type, db_path=db_path))
         self.profiler = Profiler(node="worker")
+        # this worker's span sink: stage/op spans land here and ship to
+        # the master in batches (ShipSpans); the node label is refined
+        # to worker<id> once registration hands out the id
+        self.tracer = _tracing.Tracer(node="worker", export=True)
         self._shutdown = threading.Event()
         # SIGTERM drain mode (start_worker wires the signal): stop
         # pulling, finish in-flight tasks, deregister, then shut down
@@ -1247,7 +1440,7 @@ class Worker:
             "GetMetrics": lambda req: {
                 "snapshot": _mx.registry().snapshot()},
             "Shutdown": self._rpc_shutdown,
-        }, port=port)
+        }, port=port, tracer=self.tracer)
         self.port = self._server.port
         self._server.start()
         self.metrics_server: Optional[MetricsServer] = None
@@ -1279,6 +1472,8 @@ class Worker:
             f"{advertise_host or 'localhost'}:{self.port}"
         self.worker_id = self.master.call(
             "RegisterWorker", address=self.advertise_address)["worker_id"]
+        self.tracer.node = f"worker{self.worker_id}"
+        self.executor.tracer = self.tracer
         _wlog.info("worker %d registered with master %s (port %d)",
                    self.worker_id, master_address, self.port)
         # cached per-bulk state
@@ -1404,12 +1599,36 @@ class Worker:
             # (threads + NextWork RPCs) in a tight loop meanwhile
             time.sleep(PING_INTERVAL / 4)
 
+    def _ship_spans(self, bulk_id: int) -> None:
+        """Drain this worker's completed trace spans and ship them to
+        the master in one ShipSpans batch — the out-of-band path
+        (failed attempts, the final flush); completion spans piggyback
+        on FinishedWork instead.  Best-effort: a failed ship loses
+        those spans from the assembled trace (the flight recorder
+        still holds them locally), never the task."""
+        spans = self.tracer.drain_export()
+        if spans:
+            self.master.try_call("ShipSpans", bulk_id=bulk_id,
+                                 worker_id=self.worker_id, spans=spans)
+
     def _post_profile(self, bulk_id: int) -> None:
         """Ship this worker's profile to the master once per bulk job
         (reference: worker profile files, worker.cpp:2067-2138)."""
         if bulk_id in self._posted_profiles:
             return
         self._posted_profiles.add(bulk_id)
+        # final span flush: whatever the per-task ships didn't cover
+        # (e.g. spans of tasks that failed mid-pipeline)
+        self._ship_spans(bulk_id)
+        # serialize the XLA device timeline INTO the profile before it
+        # crosses hosts: the trace *directory* path is meaningless on
+        # the master's filesystem (util/jaxprof.py)
+        from ..util.jaxprof import embed_device_events
+        for rec in self.profiler.device_traces:
+            try:
+                embed_device_events(rec)
+            except Exception:  # noqa: BLE001 — profile > device detail
+                _wlog.exception("embedding device trace events failed")
         self.master.try_call("PostProfile", bulk_id=bulk_id,
                              profile=self.profiler.to_dict())
 
@@ -1480,7 +1699,12 @@ class Worker:
         attempt = reply.get("attempt", 0)
         try:
             job = self._jobs[j]
-            return TaskItem(job, t, job.tasks[t], attempt=attempt)
+            ti = TaskItem(job, t, job.tasks[t], attempt=attempt)
+            # the master's assign-span context: this task's span (and
+            # everything under it) chains into the job's trace
+            ti.trace_ctx = _tracing.parse_traceparent(
+                reply.get("traceparent"))
+            return ti
         except Exception as e:  # noqa: BLE001  (job-list skew etc.)
             return ("task_error", j, t, attempt, e)
 
@@ -1532,15 +1756,20 @@ class Worker:
                 attempt=w.attempt)
 
         def on_done(w) -> None:
+            # this task's span chain piggybacks ON FinishedWork (the
+            # task span closed before on_done fired): the master holds
+            # the full chain the moment the completion — which can
+            # finish the bulk — lands, with no second per-task RPC
             self.master.try_call(
                 "FinishedWork", bulk_id=bulk_id, worker_id=self.worker_id,
                 job_idx=w.job.job_idx, task_idx=w.task_idx,
-                attempt=w.attempt)
+                attempt=w.attempt, spans=self.tracer.drain_export())
 
         def on_task_error(w, exc) -> bool:
             _wlog.exception("worker %d: task (%d,%d) failed",
                             self.worker_id, w.job.job_idx, w.task_idx,
                             exc_info=exc)
+            self._ship_spans(bulk_id)  # the error span chain ships too
             self.master.try_call(
                 "FailedWork", bulk_id=bulk_id, worker_id=self.worker_id,
                 job_idx=w.job.job_idx, task_idx=w.task_idx,
@@ -1614,6 +1843,9 @@ class ClusterClient:
         # the bulk from its checkpoint), short enough that a dead master
         # raises instead of hanging the caller forever
         self.master_down_timeout = master_down_timeout
+        # bulk id of the most recent run() (Client.trace maps its job id
+        # to the master-side bulk through this)
+        self.last_bulk_id: Optional[int] = None
         self._watchdog_stop = threading.Event()
         if enable_watchdog:
             t = threading.Thread(target=self._poke_loop, daemon=True)
@@ -1633,6 +1865,7 @@ class ClusterClient:
         if "error" in reply:
             raise JobException(reply["error"])
         bulk_id = reply["bulk_id"]
+        self.last_bulk_id = bulk_id
         last_ok = time.time()
         while True:
             # try_call: a master restarting mid-bulk (it recovers the job
@@ -1692,6 +1925,20 @@ class ClusterClient:
 
     def job_status(self, bulk_id: Optional[int] = None) -> dict:
         return self.master.call("GetJobStatus", bulk_id=bulk_id)
+
+    def get_trace(self, bulk_id: Optional[int] = None) -> dict:
+        """The master-assembled cross-host trace of a bulk: span dicts
+        from every node plus the straggler summary (GetTrace RPC)."""
+        return self.master.call("GetTrace", bulk_id=bulk_id)
+
+    def ship_spans(self, bulk_id: int, spans: List[dict]) -> None:
+        """Contribute client-side spans (the job's root) to the
+        master's assembled trace, so GetTrace dumps are self-contained
+        — a scanner_trace --verify of the bulk walks every task chain
+        to the root without needing this process.  Best-effort."""
+        if spans:
+            self.master.try_call("ShipSpans", bulk_id=bulk_id,
+                                 spans=spans)
 
     def shutdown_cluster(self, workers: bool = True) -> int:
         """Stop the master — and, by default, every registered worker —

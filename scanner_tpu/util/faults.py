@@ -249,6 +249,12 @@ class _Registry:
         for i, r in enumerate(hits):
             try:
                 _M_FAULTS.labels(site=site, mode=r.mode).inc()
+                # the injection lands on the affected task's trace span
+                # (when one is active): a chaos run's merged trace shows
+                # WHICH task ate the fault, not just that one fired
+                from . import tracing as _tracing
+                _tracing.add_event("fault.injected", site=site,
+                                   mode=r.mode, detail=detail)
                 _log.warning("injecting fault at %s: %s (detail=%r, "
                              "fire %d)", site, r.mode, detail, r.fired)
                 if r.mode == "delay":

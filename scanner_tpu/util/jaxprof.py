@@ -106,25 +106,23 @@ def device_trace(profiler, out_dir: Optional[str] = None):
         _ACTIVE.release()
 
 
-def load_device_events(rec: Dict[str, Any],
-                       pid_base: int = DEVICE_PID_BASE,
-                       include_python: bool = False
-                       ) -> List[Dict[str, Any]]:
-    """Load one recorded device trace as Chrome trace events, shifted to
-    the host wall clock and into the device pid range.
+def _devtrace_event_cap() -> int:
+    try:
+        return int(os.environ.get("SCANNER_TPU_DEVTRACE_MAX_EVENTS",
+                                  "200000") or 200000)
+    except ValueError:
+        return 200000
 
-    ``rec`` is a ``{"dir": ..., "t0": ...}`` entry from
-    ``Profiler.device_traces``.  Returns [] when the directory is gone
-    (e.g. a profile shipped from another host) — the host-side trace
-    must still be writable.  The profiler's Python-call spans (names
-    prefixed ``$``, tens of thousands per job) drown the device lanes
-    and duplicate what the host profiler already records; they are
-    dropped unless ``include_python=True``."""
+
+def _read_raw_events(rec: Dict[str, Any],
+                     include_python: bool = False) -> List[Dict[str, Any]]:
+    """Unshifted device-trace events for one capture record: the
+    embedded ``events`` list when present (a profile that crossed
+    hosts), else read from the local trace directory."""
+    if "events" in rec:
+        return rec["events"]
     files = sorted(glob.glob(
         os.path.join(rec["dir"], "**", "*.trace.json.gz"), recursive=True))
-    if not files:
-        return []
-    shift_us = rec["t0"] * 1e6
     out: List[Dict[str, Any]] = []
     for path in files:
         try:
@@ -137,10 +135,67 @@ def load_device_events(rec: Dict[str, Any],
             if not include_python and \
                     str(ev.get("name", "")).startswith("$"):
                 continue
-            ev = dict(ev)
-            if "pid" in ev:
-                ev["pid"] = pid_base + int(ev["pid"])
-            if "ts" in ev and ev.get("ph") != "M":
-                ev["ts"] = float(ev["ts"]) + shift_us
             out.append(ev)
+    return out
+
+
+def embed_device_events(rec: Dict[str, Any],
+                        max_events: Optional[int] = None
+                        ) -> Dict[str, Any]:
+    """Serialize the capture's device events INTO the record (mutates
+    and returns it) so the profile survives crossing hosts.
+
+    Cross-host fix: only the local trace *directory* path used to
+    travel with a shipped profile, so ``load_device_events`` on the
+    master returned [] and merged traces silently lost every remote
+    device timeline.  Workers call this before ``PostProfile``; bounded
+    by SCANNER_TPU_DEVTRACE_MAX_EVENTS (default 200000, longest-first
+    truncation recorded in ``events_dropped``) so a verbose capture
+    cannot blow the RPC message cap."""
+    if "events" in rec:
+        return rec
+    evs = _read_raw_events(rec)
+    cap = _devtrace_event_cap() if max_events is None else max_events
+    # Chrome 'M' metadata (process/thread names) is exempt from the
+    # cap: dur-less, a handful per capture, and dropping it would
+    # render remote device lanes as bare pid numbers
+    meta = [e for e in evs if e.get("ph") == "M"]
+    rest = [e for e in evs if e.get("ph") != "M"]
+    if len(rest) > cap:
+        # keep the longest slices: truncation should cost the noise
+        # floor, not the dominant kernels
+        rest.sort(key=lambda e: -float(e.get("dur", 0.0) or 0.0))
+        rec["events_dropped"] = len(rest) - cap
+        rest = rest[:cap]
+    rec["events"] = meta + rest
+    return rec
+
+
+def load_device_events(rec: Dict[str, Any],
+                       pid_base: int = DEVICE_PID_BASE,
+                       include_python: bool = False
+                       ) -> List[Dict[str, Any]]:
+    """Load one recorded device trace as Chrome trace events, shifted to
+    the host wall clock and into the device pid range.
+
+    ``rec`` is a ``{"dir": ..., "t0": ...}`` entry from
+    ``Profiler.device_traces``; records that crossed hosts carry their
+    events inline (``embed_device_events``) and need no filesystem.
+    Returns [] when neither embedded events nor a readable local
+    directory exist.  The profiler's Python-call spans (names prefixed
+    ``$``, tens of thousands per job) drown the device lanes and
+    duplicate what the host profiler already records; they are dropped
+    unless ``include_python=True``."""
+    raw = _read_raw_events(rec, include_python=include_python)
+    shift_us = rec["t0"] * 1e6
+    out: List[Dict[str, Any]] = []
+    for ev in raw:
+        if not include_python and str(ev.get("name", "")).startswith("$"):
+            continue
+        ev = dict(ev)
+        if "pid" in ev:
+            ev["pid"] = pid_base + int(ev["pid"])
+        if "ts" in ev and ev.get("ph") != "M":
+            ev["ts"] = float(ev["ts"]) + shift_us
+        out.append(ev)
     return out

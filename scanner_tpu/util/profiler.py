@@ -15,6 +15,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional
 
 from . import metrics as _mx
+from . import tracing as _tracing
 
 # every profiler counter event mirrors into this live series, so the
 # post-mortem trace counters and the /metrics endpoint can never
@@ -158,7 +159,7 @@ _NULL_SPAN = _NullSpan()
 
 
 class _Span:
-    __slots__ = ("prof", "name", "args", "start")
+    __slots__ = ("prof", "name", "args", "start", "_trace")
 
     def __init__(self, prof: Profiler, name: str, args):
         self.prof = prof
@@ -167,13 +168,21 @@ class _Span:
 
     def __enter__(self):
         self.start = time.time()
+        # hot paths are instrumented ONCE: when a trace context is
+        # active on this thread (util/tracing.py), the same with-block
+        # also records a distributed-trace span — the stage/op timings
+        # in the flight recorder and the profile can never disagree
+        self._trace = _tracing.begin_interval(self.name, self.args) \
+            if _tracing.enabled() else None
         return self
 
-    def __exit__(self, *exc):
+    def __exit__(self, exc_type, exc, tb):
         if self.prof._room():
             self.prof._list().append(Interval(
                 self.name, self.start, time.time(),
                 threading.current_thread().name, self.args))
+        if self._trace is not None:
+            _tracing.end_interval(self._trace, exc)
         return False
 
 

@@ -77,4 +77,10 @@ def call_with_backoff(fn: Callable[[], T], *,
             attempts += 1
             waited += delay
             _M_RETRIES.labels(site=label or "other").inc()
+            # transient retries become events on the active trace span:
+            # the merged timeline shows which task's call flapped
+            from . import tracing as _tracing
+            _tracing.add_event("retry", site=label or "other",
+                               attempt=attempts,
+                               error=f"{type(e).__name__}: {str(e)[:120]}")
             sleep(delay)

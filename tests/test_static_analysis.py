@@ -408,6 +408,43 @@ def test_contract_rpc_contracts_table_both_directions(tmp_path):
     assert not any("`Reg`" in m for m in msgs)
 
 
+def test_contract_rpc_contracts_entry_completeness(tmp_path):
+    """SC307 also rejects present-but-incomplete entries: every
+    classification needs BOTH `timeout_s` and `idempotent`, as dict
+    literals the lint can see."""
+    _write(tmp_path, "setup.py", "# root\n")
+    _write(tmp_path, "pkg/rpcmod.py", """
+        TIMEOUTS = {"timeout_s": 1.0}
+
+        RPC_CONTRACTS = {
+            "Full": {"timeout_s": 1.0, "idempotent": True},
+            "NoIdem": {"timeout_s": 1.0},
+            "NoTimeout": {"idempotent": True},
+            "NotADict": TIMEOUTS,
+        }
+
+        class RpcServer:
+            def __init__(self, name, methods, port=0):
+                pass
+
+        def serve(h):
+            return RpcServer("svc", {"Full": h, "NoIdem": h,
+                                     "NoTimeout": h, "NotADict": h})
+
+        def client(c):
+            c.call("Full")
+            c.call("NoIdem")
+            c.call("NoTimeout")
+            c.call("NotADict")
+    """)
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC307"]
+    assert any("NoIdem" in m and "idempotent" in m for m in msgs)
+    assert any("NoTimeout" in m and "timeout_s" in m for m in msgs)
+    assert any("NotADict" in m and "dict literal" in m for m in msgs)
+    assert not any("`Full`" in m for m in msgs)
+
+
 # ---------------------------------------------------------------------------
 # suppression + baseline round-trip
 # ---------------------------------------------------------------------------

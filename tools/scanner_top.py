@@ -64,6 +64,21 @@ NODE_COUNTERS = {
 }
 
 
+def _per_device(snap: dict, name: str, node: str) -> dict:
+    """{device: value} for one node's samples of a device-labeled
+    series (multi-chip evaluator affinity)."""
+    entry = snap.get(name)
+    if not entry:
+        return {}
+    out = {}
+    for s in entry["samples"]:
+        sl = s["labels"]
+        if sl.get("node") == node and "device" in sl:
+            out[sl["device"]] = out.get(sl["device"], 0.0) \
+                + s.get("value", 0.0)
+    return out
+
+
 def digest(snap: dict) -> dict:
     """Per-node counter totals + gauges + a timestamp, ready for rate
     computation between two polls."""
@@ -77,6 +92,13 @@ def digest(snap: dict) -> dict:
                             stage="evaluate")
         d["saveq"] = _gauge(snap, "scanner_tpu_stage_queue_depth", node,
                             stage="save")
+        # per-chip utilization (evaluator affinity): tasks + busy
+        # seconds per assigned device — the series the tool predated;
+        # without them a wedged chip hides inside the node totals
+        d["dev_tasks"] = _per_device(
+            snap, "scanner_tpu_device_tasks_total", node)
+        d["dev_busy"] = _per_device(
+            snap, "scanner_tpu_device_busy_seconds_total", node)
         out["nodes"][node] = d
     return out
 
@@ -139,6 +161,31 @@ def render(status: dict, cur: dict, prev: dict, master: str) -> str:
             f"{_rate(d, p, 'd2h_b', now) / 1e6:>9.2f} "
             f"{d['evalq']:>6.0f} {d['saveq']:>6.0f} "
             f"{d['retries']:>6.0f}")
+    # per-chip breakdown (multi-chip evaluator affinity): one row per
+    # (node, device) that has taken tasks — chip imbalance (a device
+    # stuck while siblings climb) is invisible in the node totals above
+    dev_rows = []
+    for node, d in sorted(cur["nodes"].items()):
+        devs = d.get("dev_tasks") or {}
+        if not devs or set(devs) == {"default"}:
+            continue
+        p = prev_nodes.get(node) or {}
+        for dev in sorted(devs):
+            tasks = devs[dev]
+            busy = (d.get("dev_busy") or {}).get(dev, 0.0)
+            p_busy = (p.get("dev_busy") or {}).get(dev, 0.0)
+            if "_dt" in d:
+                util = max(busy - p_busy, 0.0) / max(d["_dt"], 1e-6)
+            else:
+                up = max(now - d["start"], 1e-6) if d.get("start") else None
+                util = busy / up if up else 0.0
+            dev_rows.append(f"{node:10} {dev:>10} {tasks:>7.0f} "
+                            f"{busy:>8.1f} {min(util, 1.0) * 100:>6.1f}%")
+    if dev_rows:
+        lines.append("")
+        lines.append(f"{'NODE':10} {'DEVICE':>10} {'TASKS':>7} "
+                     f"{'BUSY s':>8} {'UTIL':>7}")
+        lines.extend(dev_rows)
     return "\n".join(lines)
 
 
