@@ -345,6 +345,36 @@ def main():
             "recompiles_by_device":
                 per_labels("scanner_tpu_op_recompiles_total"),
         })
+        # memory digest (util/memstats.py): peak HBM per device (backend
+        # view), the allocation ledger's peaks per (device, kind) —
+        # staged columns vs warm-up args vs sink batches — and the
+        # padding waste bucketed dispatch paid, in approximate bytes
+        # (pad rows x decoded-frame bytes; exact per-op row widths are
+        # not knowable from counters alone)
+        pad_rows_total = sum(
+            s["value"] for s in snap.get(
+                "scanner_tpu_op_pad_rows_total", {}).get("samples", []))
+        from scanner_tpu.util import memstats as _memstats
+        detail.append({
+            "config": "memory",
+            "device_hbm": _memstats.device_memory_stats(),
+            "device_hbm_peak_bytes":
+                per_labels("scanner_tpu_device_hbm_peak_bytes"),
+            "ledger_peak_bytes":
+                per_labels("scanner_tpu_ledger_peak_bytes"),
+            "ledger_live_bytes":
+                per_labels("scanner_tpu_ledger_live_bytes"),
+            "staged_bytes_total": sum(
+                s["value"] for s in snap.get(
+                    "scanner_tpu_h2d_bytes_total", {}).get("samples", [])),
+            "pad_rows_total": pad_rows_total,
+            "pad_waste_bytes_approx": int(pad_rows_total * W * H * 3),
+            "oom_events": sum(
+                s["value"] for s in snap.get(
+                    "scanner_tpu_device_oom_events_total",
+                    {}).get("samples", [])),
+        })
+
         def hist_quantiles(series: str, qs=(0.5, 0.9, 0.99)) -> dict:
             """Estimate quantiles from a snapshot histogram by linear
             interpolation within its buckets (the same estimate

@@ -217,6 +217,20 @@ def _module_tuple(mod: ModuleInfo, name: str) -> Optional[List[str]]:
     return None
 
 
+def _module_dict_keys(mod: ModuleInfo, name: str) -> Optional[Set[str]]:
+    """String keys of a module-level dict assignment whose VALUES may be
+    arbitrary expressions (faults._EXC maps exc names to constructors —
+    _module_str_dict cannot read it)."""
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and stmt.targets[0].id == name \
+                and isinstance(stmt.value, ast.Dict):
+            keys = {_const_str(k) for k in stmt.value.keys}
+            return {k for k in keys if k is not None}
+    return None
+
+
 def _module_str_dict(mod: ModuleInfo, name: str
                      ) -> Optional[Dict[str, str]]:
     for stmt in mod.tree.body:
@@ -465,14 +479,35 @@ class ContractPass(AnalysisPass):
                     "and chaos tests pass vacuously", fmod.tree))
         plans = _module_str_dict(fmod, "NAMED_PLANS") or {}
         data_sites = _module_tuple(fmod, "DATA_SITES") or []
+        # clause-level validation beyond the site name: a canned plan
+        # with a typo'd mode or an exc= key the _EXC table doesn't
+        # construct would only fail when someone finally runs it
+        modes = set(_module_tuple(fmod, "MODES") or ())
+        exc_keys = _module_dict_keys(fmod, "_EXC")
         for name, plan in plans.items():
             for clause in plan.split(";"):
-                site = clause.strip().split(":", 1)[0]
+                fields = clause.strip().split(":")
+                site = fields[0]
                 if site and site not in site_set:
                     out.append(fmod.finding(
                         "SC305",
                         f"NAMED_PLANS[{name!r}] targets unknown site "
                         f"`{site}`", fmod.tree))
+                if len(fields) > 1 and modes and fields[1] not in modes:
+                    out.append(fmod.finding(
+                        "SC305",
+                        f"NAMED_PLANS[{name!r}] uses unknown mode "
+                        f"`{fields[1]}` (known: "
+                        f"{', '.join(sorted(modes))})", fmod.tree))
+                for f in fields[2:]:
+                    k, sep, v = f.partition("=")
+                    if sep and k == "exc" and exc_keys is not None \
+                            and v not in exc_keys:
+                        out.append(fmod.finding(
+                            "SC305",
+                            f"NAMED_PLANS[{name!r}] names unknown "
+                            f"exc `{v}` — parse_plan will reject the "
+                            "plan at arm time", fmod.tree))
         for site in data_sites:
             if site not in site_set:
                 out.append(fmod.finding(

@@ -28,6 +28,14 @@ class StorageException(ScannerException):
     """Raised on storage backend errors."""
 
 
+class DeviceOutOfMemory(ScannerException):
+    """Device memory exhaustion (RESOURCE_EXHAUSTED) observed at an
+    engine staging/dispatch site — classified transient so the master
+    requeues the task strike-free after its staged buffers are freed
+    (util/memstats.py OOM forensics; the `memory.pressure` fault site
+    raises this to force the path deterministically on CPU)."""
+
+
 class DeviceType(enum.Enum):
     """Where a kernel runs.
 

@@ -45,6 +45,16 @@ def default_config() -> Dict[str, Any]:
             # per process.
             "compilation_cache_dir": "",
         },
+        "memory": {
+            # memory observability (util/memstats.py): per-device HBM
+            # gauges + the allocation ledger every engine-owned device
+            # buffer registers in.  On by default (nanoseconds per
+            # buffer); SCANNER_TPU_MEMSTATS=0 overrides per process.
+            "enabled": True,
+            # ledger entries named in an OOM/status memory report
+            # (largest first); SCANNER_TPU_MEMSTATS_TOPN overrides.
+            "report_top_n": 10,
+        },
         "trace": {
             # distributed-tracing span recording (util/tracing.py):
             # task/stage/op spans, flight recorder, cross-host trace
@@ -123,6 +133,17 @@ class Config:
         disabled (the default)."""
         d = self.config.get("perf", {}).get("compilation_cache_dir", "")
         return d or None
+
+    @property
+    def memstats_enabled(self) -> bool:
+        """Memory accounting (HBM gauges + allocation ledger; the
+        deployment default — SCANNER_TPU_MEMSTATS overrides)."""
+        return bool(self.config.get("memory", {}).get("enabled", True))
+
+    @property
+    def memstats_report_top_n(self) -> int:
+        """Ledger entries named in a memory report, largest first."""
+        return int(self.config.get("memory", {}).get("report_top_n", 10))
 
     @property
     def tracing_enabled(self) -> bool:

@@ -27,6 +27,8 @@ from typing import Any, List, Optional, Sequence, Union
 import numpy as np
 
 from ..common import NullElement
+from ..util import faults as _faults
+from ..util import memstats as _ms
 from ..util import metrics as _mx
 
 Elem = Any
@@ -214,9 +216,28 @@ class ColumnBatch:
         if isinstance(self.data, np.ndarray):
             import jax
             t0 = time.time()
-            data = jax.device_put(self.data, device)
+            lbl = _ms.device_label(device)
+            try:
+                # the memory.pressure fault site lives INSIDE the guard:
+                # an injected DeviceOutOfMemory takes the same forensics
+                # path a real RESOURCE_EXHAUSTED from device_put would
+                if _faults.ACTIVE:
+                    _faults.inject(
+                        "memory.pressure",
+                        detail=f"h2d:{lbl}:{self.data.nbytes}")
+                data = jax.device_put(self.data, device)
+            except Exception as e:
+                if _ms.is_oom(e):
+                    _ms.note_oom(e, site="staging",
+                                 detail=f"h2d {self.data.nbytes} bytes "
+                                        f"-> {lbl}")
+                raise
             _M_H2D_SECONDS.inc(time.time() - t0)
             _M_H2D_BYTES.inc(self.data.nbytes)
+            # allocation ledger: this staged batch is an engine-owned
+            # device buffer; released when the device array is collected
+            _ms.track_array(data, "staging",
+                            device=lbl if device is not None else None)
             return ColumnBatch(self.rows, data,
                                self.nulls, convert=self.convert)
         if device is not None and _is_jax(self.data):
@@ -229,8 +250,18 @@ class ColumnBatch:
                     cur = None
             if cur is not None and cur != {device}:
                 import jax
-                return ColumnBatch(self.rows,
-                                   jax.device_put(self.data, device),
+                try:
+                    data = jax.device_put(self.data, device)
+                except Exception as e:
+                    if _ms.is_oom(e):
+                        _ms.note_oom(
+                            e, site="staging",
+                            detail=f"cross-chip re-stage -> "
+                                   f"{_ms.device_label(device)}")
+                    raise
+                _ms.track_array(data, "staging",
+                                device=_ms.device_label(device))
+                return ColumnBatch(self.rows, data,
                                    self.nulls, convert=self.convert)
         return self
 
@@ -243,6 +274,9 @@ class ColumnBatch:
         returns quickly.  No-op for host data; best-effort on jax
         versions without copy_to_host_async."""
         if _is_jax(self.data):
+            # the sink batch sits in device memory until the saver's
+            # fetch: account it so pre-fetch HBM pressure has an owner
+            _ms.track_array(self.data, "sink")
             fn = getattr(self.data, "copy_to_host_async", None)
             if fn is not None:
                 try:

@@ -103,6 +103,12 @@ class Client:
             if not os.environ.get("SCANNER_TPU_TRACING"):
                 from ..util import tracing
                 tracing.set_enabled(cfg.tracing_enabled)
+            # [memory] section: accounting default + report size; the
+            # SCANNER_TPU_MEMSTATS* env vars (read at import) win
+            from ..util import memstats
+            if not os.environ.get("SCANNER_TPU_MEMSTATS"):
+                memstats.set_enabled(cfg.memstats_enabled)
+            memstats.set_report_top_n(cfg.memstats_report_top_n)
             # explicit argument beats config beats default
             storage_type = storage_type or cfg.storage_type
             if master is None:
@@ -153,12 +159,14 @@ class Client:
         self._metrics_server = None
         if metrics_port is not None:
             from ..util.metrics import MetricsServer
+            from ..util import memstats as _memstats
             self._metrics_server = MetricsServer(
                 port=metrics_port,
                 statusz=lambda: {"role": "client",
                                  "master": self._master_address,
                                  "db": getattr(self._db.backend, "root",
-                                               None)},
+                                               None),
+                                 "memory": _memstats.status_dict()},
                 healthz=lambda: {"role": "client"})
 
         self.ops = O.OpGenerator()
@@ -213,6 +221,20 @@ class Client:
             return self._cluster.metrics()
         from ..util.metrics import merge_snapshots, registry
         return merge_snapshots({"client": registry().snapshot()})
+
+    def memory_report(self) -> Dict[str, Any]:
+        """Memory forensics (docs/observability.md §Memory).  Cluster
+        mode: the master's GetMemoryReport view — its live HBM/
+        allocation-ledger snapshot plus every one-shot OOM report
+        workers shipped (each naming the top ledger entries by bytes
+        with their owning task and trace id).  Local mode: this
+        process's memstats view and last OOM report, if any."""
+        if self._cluster is not None:
+            return self._cluster.memory_report()
+        from ..util import memstats
+        last = memstats.last_report()
+        return {"memory": memstats.status_dict(),
+                "reports": [last] if last else []}
 
     def shutdown_cluster(self, workers: bool = True) -> int:
         """Remotely stop the cluster this client is attached to: the

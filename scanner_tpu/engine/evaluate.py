@@ -40,6 +40,7 @@ from ..common import (DeviceType, GraphException, JobException, NullElement,
                       ScannerException, SliceList)
 from ..graph import analysis as A
 from ..graph import ops as O
+from ..util import memstats as _ms
 from ..util import metrics as _mx
 from ..util import tracing as _tracing
 from ..util.log import get_logger
@@ -194,13 +195,10 @@ def default_pipeline_instances(configured: Optional[int] = None) -> int:
     return 1
 
 
-def device_label(device: Optional[Any]) -> str:
-    """Stable metrics label for a jax device ("tpu:3"); "default" when
-    placement is jax's choice (affinity off / single chip)."""
-    if device is None:
-        return "default"
-    return f"{getattr(device, 'platform', 'dev')}:" \
-           f"{getattr(device, 'id', 0)}"
+# canonical implementation lives with the memory accountant so metrics,
+# ledger entries and trace attrs key devices identically; re-exported
+# here because the evaluator/executor are its historical home
+device_label = _ms.device_label
 
 
 # ---------------------------------------------------------------------------
@@ -413,9 +411,17 @@ class KernelInstance:
                     # compilation cache dedups the XLA work across
                     # same-kind chips)
                     import jax
-                    args = [jax.device_put(a, self.device)
-                            if isinstance(a, np.ndarray) else a
-                            for a in args]
+                    staged = []
+                    for a in args:
+                        if isinstance(a, np.ndarray):
+                            a = jax.device_put(a, self.device)
+                            # ledger: warm-up args hold HBM until this
+                            # bucket's compile finishes (released when
+                            # the arrays are collected at loop exit)
+                            _ms.track_array(a, "warmup",
+                                            device=self.dev_label)
+                        staged.append(a)
+                    args = staged
                 try:
                     self.kernel.execute(*args)
                 except Exception:  # noqa: BLE001 — warm-up is best-effort
@@ -889,7 +895,7 @@ class TaskEvaluator:
                             res = ki.kernel.execute(*row_args)
                             emit_result(compute[live], _single(res, n, out_cols))
                         i = j
-        except BaseException:
+        except BaseException as e:
             # the kernel died mid-run: its internal state is partial and
             # _last_row may already claim the run's end.  Reset both so a
             # subsequent carry plan MISSES (fallback) instead of silently
@@ -900,6 +906,12 @@ class TaskEvaluator:
                     ki.kernel.reset()
                 finally:
                     ki._last_row = None
+            if _ms.is_oom(e):
+                # dispatch-site OOM forensics: the report names the
+                # ledger entries (and their tasks) that held HBM when
+                # this op's allocation failed
+                _ms.note_oom(e, site="dispatch",
+                             detail=f"op {n.name} on {ki.dev_label}")
             raise
         _M_OP_ROWS.labels(op=n.name).inc(len(compute))
         _M_OP_SECONDS.labels(op=n.name).inc(time.time() - t0)

@@ -717,6 +717,12 @@ class LocalExecutor:
                 w.trace_span.add_event("error", type=type(e).__name__,
                                        message=str(e)[:200])
             self._task_trace_end(w, status="error")
+            # drop the failed attempt's staged columns/results NOW: a
+            # task requeued after memory pressure must not keep holding
+            # the very device buffers that caused it (the ledger
+            # releases as the arrays are collected)
+            w.elements = None
+            w.results = None
             if on_task_error is not None and on_task_error(w, e):
                 return
             _log.exception("task (%d,%d) failed; aborting pipeline",
@@ -1014,6 +1020,8 @@ class LocalExecutor:
                             "error", type=type(e).__name__,
                             message=str(e)[:200])
                     self._task_trace_end(w, status="error")
+                    w.elements = None
+                    w.results = None
                     if on_task_error is not None and on_task_error(w, e):
                         continue
                     raise
@@ -1038,6 +1046,8 @@ class LocalExecutor:
                             "error", type=type(e).__name__,
                             message=str(e)[:200])
                     self._task_trace_end(w, status="error")
+                    w.elements = None
+                    w.results = None
                     if on_task_error is not None and on_task_error(w, e):
                         continue
                     raise

@@ -33,6 +33,7 @@ from ..common import CacheMode, JobException, PerfParams, ScannerException
 from ..storage import Database, make_storage
 from ..storage import metadata as md
 from ..util import faults as _faults
+from ..util import memstats as _memstats
 from ..util import metrics as _mx
 from ..util import tracing as _tracing
 from ..util.log import get_logger
@@ -88,8 +89,15 @@ RPC_CONTRACTS = {
     "GetProfiles":      {"timeout_s": 30.0, "idempotent": True},
     "ShipSpans":        {"timeout_s": 30.0, "idempotent": False},
     "GetTrace":         {"timeout_s": 30.0, "idempotent": True},
+    "ShipMemoryReport": {"timeout_s": 30.0, "idempotent": False},
+    "GetMemoryReport":  {"timeout_s": 30.0, "idempotent": True},
     "Shutdown":         {"timeout_s": PING_TIMEOUT, "idempotent": True},
 }
+
+# OOM forensic reports retained on the master (newest win): enough for
+# a post-mortem across a worker fleet's pressure event, bounded so a
+# flapping job cannot grow master memory
+MAX_MEMORY_REPORTS = 16
 
 # cross-host trace assembly bounds: spans kept per bulk on the master
 # (overflow counts into the GetTrace/status `spans_dropped` field), the
@@ -155,6 +163,11 @@ def _is_transient_failure(exc: BaseException) -> bool:
     import grpc
 
     from ..common import StorageException
+    if _memstats.is_oom(exc):
+        # device memory exhaustion: the pressure came from co-scheduled
+        # work, not this task — requeue strike-free (the failed attempt
+        # freed its staged buffers on the way out)
+        return True
     return isinstance(exc, (StorageException, rpc.RpcError, grpc.RpcError,
                             ConnectionError, TimeoutError))
 
@@ -290,6 +303,13 @@ class _BulkJob:
     # checkpoint-restored completions by seconds-since-recovery would
     # report a completion rate off by orders of magnitude.
     done_at_start: int = 0
+    # retention: when this bulk ages out of the last-N history ring its
+    # heavy scheduling state (done set, task_rows, per-task maps, the
+    # span store) is dropped and status queries serve from this frozen
+    # snapshot — Client.stragglers/GetTrace keep working post-completion
+    # (aggregates survive compaction; raw spans do not)
+    compacted: bool = False
+    status_frozen: Optional[dict] = None
 
     def count_stage(self, stage: str, key: Tuple[int, int]) -> None:
         if key not in self.stage_seen[stage]:
@@ -300,6 +320,32 @@ class _BulkJob:
         self.finished = True
         if not self.finished_at:
             self.finished_at = time.time()
+
+    def compact(self, frozen_status: dict) -> None:
+        """Drop the heavy per-task state of a finished bulk that aged
+        out of the history ring; a long-lived master serving thousands
+        of bulks keeps only the tiny straggler aggregates + a frozen
+        status per historical bulk instead of 10^5-task done-sets and
+        span stores."""
+        self.compacted = True
+        self.status_frozen = frozen_status
+        self.spans = []
+        self.done = set()
+        self.task_rows = {}
+        self.job_tasks = {}
+        self.queue = {}
+        self.job_rr = deque()
+        self.outstanding = {}
+        self.held = {}
+        self.failures = {}
+        self.transient_failures = {}
+        self.stage_seen = {"load": set(), "evaluate": set()}
+        self.sticky_worker = {}
+        self.sticky_cur = {}
+        # profiles are deliberately KEPT: GetProfiles / Client.trace
+        # device lanes retained them for all history before compaction
+        # existed, and they are per-worker (bounded per bulk), not
+        # per-task
 
     def q_push(self, key: Tuple[int, int], front: bool = False) -> None:
         j, t = key
@@ -352,6 +398,10 @@ class Master:
         self._next_bulk_id = 0
         self._bulk: Optional[_BulkJob] = None
         self._history: Dict[int, _BulkJob] = {}
+        # OOM forensic reports shipped by workers (ShipMemoryReport),
+        # newest-last, bounded — served back by GetMemoryReport next to
+        # this process's own memstats view
+        self._mem_reports: Deque[dict] = deque(maxlen=MAX_MEMORY_REPORTS)
         self._last_poke = time.time()
         self._no_worker_since = time.time()
         self._cleared_bulk_id: Optional[int] = None
@@ -380,6 +430,8 @@ class Master:
             "GetProfiles": self._rpc_get_profiles,
             "ShipSpans": self._rpc_ship_spans,
             "GetTrace": self._rpc_get_trace,
+            "ShipMemoryReport": self._rpc_ship_memory_report,
+            "GetMemoryReport": self._rpc_get_memory_report,
             "Shutdown": self._rpc_shutdown,
         }, port=port, tracer=self.tracer)
         self.port = self._server.port
@@ -500,11 +552,7 @@ class Master:
                 if bulk.total_tasks == 0:
                     bulk.mark_finished()
                 self._history[bulk.bulk_id] = bulk
-                # bound trace retention: only the newest
-                # SPAN_HISTORY_BULKS bulks keep full span stores; older
-                # ones keep just their (small) straggler aggregates
-                for bid in sorted(self._history)[:-SPAN_HISTORY_BULKS]:
-                    self._history[bid].spans = []
+                self._trim_history_locked()
                 _mlog.info(
                     "bulk %d admitted: %d jobs, %d tasks",
                     bulk.bulk_id, len(bulk.job_tasks), bulk.total_tasks)
@@ -777,6 +825,14 @@ class Master:
         """One source of truth for job progress: the GetJobStatus reply,
         the client progress bar, and /statusz all read this.  Caller
         holds self._lock."""
+        if bulk.compacted and bulk.status_frozen is not None:
+            # compacted historical bulk: the heavy per-task state is
+            # gone; serve the snapshot frozen at compaction (worker
+            # liveness stays live — it is a cluster fact, not a bulk one)
+            st = dict(bulk.status_frozen)
+            st["num_workers"] = sum(1 for w in self._workers.values()
+                                    if w.active)
+            return st
         # freeze the clock at bulk completion: a historical bulk queried
         # later must report its real throughput, not a decayed one
         end = bulk.finished_at or time.time()
@@ -843,8 +899,14 @@ class Master:
             status = self._job_status_locked(bulk) \
                 if bulk is not None else None
             bulk_id = bulk.bulk_id if bulk is not None else None
+            mem_reports = len(self._mem_reports)
         return {"role": "master", "workers": workers,
-                "bulk_id": bulk_id, "bulk": status}
+                "bulk_id": bulk_id, "bulk": status,
+                # the Memory panel: this process's HBM/ledger view plus
+                # how many worker OOM reports are held for
+                # GetMemoryReport
+                "memory": dict(_memstats.status_dict(),
+                               worker_reports=mem_reports)}
 
     def _rpc_get_metrics(self, req: dict) -> dict:
         """Cluster-wide metrics: this process's snapshot plus every live
@@ -895,13 +957,32 @@ class Master:
             bulk = self._history.get(req["bulk_id"])
             return {"profiles": list(bulk.profiles) if bulk else []}
 
+    def _trim_history_locked(self) -> None:
+        """Bound historical-bulk retention: only the newest
+        SPAN_HISTORY_BULKS bulks keep full span stores and per-task
+        scheduling state; older finished ones compact to straggler
+        aggregates + a frozen status snapshot, which GetJobStatus /
+        GetTrace / Client.stragglers keep serving — post-completion
+        queries work for the whole ring and degrade (spans only) past
+        it, instead of a long-lived master holding every bulk's
+        10^5-task done-sets forever.  Caller holds self._lock."""
+        for bid in sorted(self._history)[:-SPAN_HISTORY_BULKS]:
+            old = self._history[bid]
+            if old.finished and not old.compacted:
+                old.compact(self._job_status_locked(old))
+            else:
+                old.spans = []
+
     # -- trace assembly (util/tracing.py) -----------------------------------
 
     def _absorb_span_locked(self, bulk: _BulkJob, d: dict) -> None:
         """One shipped span into the bulk's store + the incremental
         straggler aggregates (per-stage stats, slowest-task heap).
         Caller holds self._lock."""
-        if len(bulk.spans) < MAX_BULK_SPANS:
+        if bulk.compacted:
+            bulk.span_drops += 1  # store dropped at compaction; count,
+            # but keep feeding the (retained) aggregates below
+        elif len(bulk.spans) < MAX_BULK_SPANS:
             bulk.spans.append(d)
         else:
             bulk.span_drops += 1
@@ -1002,6 +1083,46 @@ class Master:
                     "spans": list(bulk.spans),
                     "spans_dropped": bulk.span_drops,
                     "stragglers": self._stragglers_locked(bulk)}
+
+    # -- memory observability (util/memstats.py) -----------------------------
+
+    def _rpc_ship_memory_report(self, req: dict) -> dict:
+        """Workers push their one-shot OOM memory reports here (the
+        ShipSpans-style out-of-band path): a worker that OOMs — or
+        dies shortly after — leaves its forensics on the master."""
+        report = req.get("report")
+        if isinstance(report, dict):
+            report = dict(report)
+            # the report stamps its own origin node; the shipper's id
+            # is only the fallback (any sibling worker may ship it)
+            if not report.get("node"):
+                report["node"] = f"worker{req.get('worker_id', '?')}"
+            with self._lock:
+                self._mem_reports.append(report)
+            _mlog.warning(
+                "memory report from worker %s: %s",
+                req.get("worker_id"), report.get("reason", ""))
+        return {"ok": True}
+
+    def _rpc_get_memory_report(self, req: dict) -> dict:
+        """The cluster memory view (Client.memory_report()): this
+        process's live memstats snapshot plus every OOM report workers
+        shipped, newest last."""
+        with self._lock:
+            reports = list(self._mem_reports)
+        own = _memstats.last_report()
+        if own is not None:
+            own = dict(own)
+            if not own.get("node"):
+                own["node"] = "master"
+            # in-process clusters share the memstats module: "our own"
+            # report may be the very one a worker already shipped —
+            # don't serve it twice
+            if not any(r.get("seq") == own.get("seq")
+                       and r.get("node") == own.get("node")
+                       for r in reports):
+                reports.append(own)
+        return {"memory": _memstats.status_dict(), "reports": reports}
 
     def _rpc_shutdown(self, req: dict) -> dict:
         """Remote cluster stop (Client.shutdown_cluster / blocking
@@ -1430,6 +1551,10 @@ class Worker:
         # the master in batches (ShipSpans); the node label is refined
         # to worker<id> once registration hands out the id
         self.tracer = _tracing.Tracer(node="worker", export=True)
+        # an OOM report from this process should snapshot THIS worker's
+        # flight recorder, not the default client tracer (last Worker
+        # constructed wins when several share a test process)
+        _memstats.set_tracer(self.tracer)
         self._shutdown = threading.Event()
         # SIGTERM drain mode (start_worker wires the signal): stop
         # pulling, finish in-flight tasks, deregister, then shut down
@@ -1567,6 +1692,8 @@ class Worker:
             "pipeline_instances": ex.pipeline_instances if ex else None,
             "num_load_workers": ex.num_load_workers if ex else None,
             "num_save_workers": ex.num_save_workers if ex else None,
+            # the Memory panel: per-device HBM + allocation-ledger view
+            "memory": _memstats.status_dict(),
         }
 
     # ------------------------------------------------------------------
@@ -1611,6 +1738,16 @@ class Worker:
             self.master.try_call("ShipSpans", bulk_id=bulk_id,
                                  worker_id=self.worker_id, spans=spans)
 
+    def _ship_memory_report(self) -> None:
+        """Push the newest unshipped OOM memory report (if any) to the
+        master — best-effort, like span shipping: the local log and
+        flight recorder still hold the forensics if the RPC fails."""
+        report = _memstats.take_unshipped_report()
+        if report is None:
+            return
+        self.master.try_call("ShipMemoryReport",
+                             worker_id=self.worker_id, report=report)
+
     def _post_profile(self, bulk_id: int) -> None:
         """Ship this worker's profile to the master once per bulk job
         (reference: worker profile files, worker.cpp:2067-2138)."""
@@ -1620,6 +1757,7 @@ class Worker:
         # final span flush: whatever the per-task ships didn't cover
         # (e.g. spans of tasks that failed mid-pipeline)
         self._ship_spans(bulk_id)
+        self._ship_memory_report()
         # serialize the XLA device timeline INTO the profile before it
         # crosses hosts: the trace *directory* path is meaningless on
         # the master's filesystem (util/jaxprof.py)
@@ -1770,6 +1908,9 @@ class Worker:
                             self.worker_id, w.job.job_idx, w.task_idx,
                             exc_info=exc)
             self._ship_spans(bulk_id)  # the error span chain ships too
+            # an OOM-failed task generated a memory report: ship it now
+            # so the master holds the forensics before the requeue
+            self._ship_memory_report()
             self.master.try_call(
                 "FailedWork", bulk_id=bulk_id, worker_id=self.worker_id,
                 job_idx=w.job.job_idx, task_idx=w.task_idx,
@@ -1930,6 +2071,11 @@ class ClusterClient:
         """The master-assembled cross-host trace of a bulk: span dicts
         from every node plus the straggler summary (GetTrace RPC)."""
         return self.master.call("GetTrace", bulk_id=bulk_id)
+
+    def memory_report(self) -> dict:
+        """Cluster memory forensics (GetMemoryReport RPC): the master's
+        live HBM/ledger view plus every OOM report workers shipped."""
+        return self.master.call("GetMemoryReport")
 
     def ship_spans(self, bulk_id: int, spans: List[dict]) -> None:
         """Contribute client-side spans (the job's root) to the

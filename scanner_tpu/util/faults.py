@@ -70,7 +70,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Union
 
-from ..common import ScannerException, StorageException
+from ..common import (DeviceOutOfMemory, ScannerException,
+                      StorageException)
 from . import metrics as _mx
 from .log import get_logger
 
@@ -88,6 +89,7 @@ SITES = (
     "pipeline.eval",      # engine/executor.py evaluate stage, per task
     "pipeline.save",      # engine/executor.py save stage, per task
     "worker.heartbeat",   # engine/service.py heartbeat loop, per beat
+    "memory.pressure",    # engine/batch.py to_device staging, per h2d
 )
 
 MODES = ("raise", "delay", "corrupt", "crash")
@@ -146,6 +148,10 @@ _EXC = {
     "timeout": lambda m: TimeoutError(m),
     "oserror": lambda m: OSError(m),
     "unavailable": _unavailable_exc,
+    # device memory exhaustion: what util/memstats.is_oom recognizes —
+    # a memory.pressure:raise:exc=oom plan forces the OOM-forensics +
+    # transient-requeue path deterministically on CPU
+    "oom": lambda m: DeviceOutOfMemory(m),
 }
 
 _M_FAULTS = _mx.registry().counter(
@@ -401,6 +407,10 @@ NAMED_PLANS = {
     "master-crash": "rpc.server.handle:crash:match=FinishedWork:n=4",
     # every heartbeat after the first is dropped -> stale-worker removal
     "heartbeat-drop": "worker.heartbeat:raise:after=1",
+    # device HBM exhausted during h2d staging -> one-shot memory report
+    # (top ledger entries with owning task/trace), staged buffers freed,
+    # strike-free transient requeue, bit-exact completion
+    "memory-pressure": "memory.pressure:raise:exc=oom:n=1:times=1",
 }
 
 

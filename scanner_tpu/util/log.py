@@ -15,16 +15,49 @@ SCANNER_TPU_LOG (debug|info|warning|error) changes the level — the
 operator-facing switch for debugging a wedged 16-host job.  Records also
 propagate normally, so applications can route them through their own
 logging configuration.
+
+SCANNER_TPU_LOG_FORMAT=json switches the default handler to structured
+output: one JSON object per line carrying ts/level/logger/msg plus the
+active tracing context's trace_id/span_id (util/tracing.py), so logs
+join traces in post-mortems — grep a task's trace_id from the straggler
+summary and every log line that task's code path emitted lines up.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import sys
 
 _ROOT = "scanner_tpu"
 _configured = False
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per record; trace_id/span_id pulled from the
+    active tracing context so log lines join the assembled traces."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(record.created, 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        try:
+            # lazy: the formatter must not force tracing (and its
+            # metrics registry) into processes that never trace
+            from . import tracing
+            ctx = tracing.current_context()
+            if ctx is not None:
+                out["trace_id"] = ctx.trace_id
+                out["span_id"] = ctx.span_id
+        except Exception:  # noqa: BLE001 — logging must never raise
+            pass
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)
 
 
 def _configure_once() -> None:
@@ -48,9 +81,13 @@ def _configure_once() -> None:
             print(f"scanner_tpu: SCANNER_TPU_LOG={level_name!r} is not a "
                   f"valid level", file=sys.stderr)
     handler = logging.StreamHandler(sys.stderr)
-    handler.setFormatter(logging.Formatter(
-        "%(asctime)s %(levelname).1s %(name)s %(message)s",
-        datefmt="%H:%M:%S"))
+    if os.environ.get("SCANNER_TPU_LOG_FORMAT", "").strip().lower() \
+            == "json":
+        handler.setFormatter(JsonFormatter())
+    else:
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname).1s %(name)s %(message)s",
+            datefmt="%H:%M:%S"))
     root.addHandler(handler)
     root.setLevel(level)
 

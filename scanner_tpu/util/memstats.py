@@ -1,0 +1,649 @@
+"""Memory observability: per-device HBM accounting + allocation ledger.
+
+The time side of the observability stack (util/metrics.py live series,
+util/tracing.py causal spans, util/profiler.py post-mortem intervals)
+answers *where time went*; nothing answered *where bytes live*.  Staged
+source columns (`ColumnBatch.to_device`), bucket-ladder warm-up args
+(engine/evaluate.py precompile) and async sink prefetch batches all
+allocate HBM invisibly, and an OOM surfaced as an opaque
+`RESOURCE_EXHAUSTED` with no owner.  This module is the missing
+accountant, with two sources of truth that cross-check each other:
+
+  * **Backend-reported device stats** — `device.memory_stats()` sampled
+    per local jax device at scrape time (`bytes_in_use`, peak, limit),
+    surfaced as the ``scanner_tpu_device_hbm_*`` gauges.  Gracefully
+    absent on backends that report nothing (the CPU backend returns
+    None) — the gauges then simply have no samples.
+  * **The allocation ledger** — every engine-owned device buffer
+    registers ``(bytes, device, kind, task, trace_id)`` on create and
+    releases when the buffer object is collected (``track_array`` hangs
+    a ``weakref.finalize`` off the array, so a leaked staging batch is
+    a *visible* live ledger entry, not a mystery).  Live bytes and a
+    high watermark are kept per (device, kind) and mirrored into the
+    ``scanner_tpu_ledger_*`` series.
+
+On a RESOURCE_EXHAUSTED (real, or injected through the
+``memory.pressure`` fault site on CPU) the staging/dispatch sites call
+:func:`note_oom`, which emits a one-shot **memory report** — device
+stats, the top-N ledger entries by bytes with their owning task and
+trace id, and the tail of the tracing flight recorder — to the log and
+stores it for the ``GetMemoryReport`` RPC path
+(``Client.memory_report()``).  The failure itself is classified
+transient (engine/service.py ``_is_transient_failure``) so the task
+requeues strike-free after its staged buffers are freed.
+
+Knobs: ``SCANNER_TPU_MEMSTATS=0`` disables ledger tracking (device
+gauges stay — they cost only a scrape-time sample);
+``SCANNER_TPU_MEMSTATS_TOPN`` sizes the report's top-entry list
+(default 10).  The ``[memory]`` config section carries the deployment
+defaults the env vars override (docs/observability.md §Memory).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..common import DeviceOutOfMemory
+from . import metrics as _mx
+from . import tracing as _tracing
+from .log import get_logger
+
+_log = get_logger("memstats")
+
+# -- live series (docs/observability.md §Memory) ----------------------------
+
+# backend-reported HBM occupancy, sampled from device.memory_stats() at
+# scrape time (set_function children installed per device that reports)
+_M_HBM_USE = _mx.registry().gauge(
+    "scanner_tpu_device_hbm_bytes_in_use",
+    "Backend-reported device memory in use (device.memory_stats "
+    "bytes_in_use), sampled at scrape time.  Absent on backends that "
+    "report no memory stats (CPU).",
+    labels=["device"])
+_M_HBM_PEAK = _mx.registry().gauge(
+    "scanner_tpu_device_hbm_peak_bytes",
+    "Backend-reported peak device memory in use since process start "
+    "(device.memory_stats peak_bytes_in_use).",
+    labels=["device"])
+_M_HBM_LIMIT = _mx.registry().gauge(
+    "scanner_tpu_device_hbm_limit_bytes",
+    "Backend-reported device memory capacity available to this process "
+    "(device.memory_stats bytes_limit).",
+    labels=["device"])
+
+# the allocation ledger's own view — engine-owned buffers only, so
+# (hbm_bytes_in_use - ledger_live_bytes) is the non-engine remainder
+# (XLA executables, scratch, framework overhead)
+_M_LEDGER_LIVE = _mx.registry().gauge(
+    "scanner_tpu_ledger_live_bytes",
+    "Bytes of engine-owned device buffers currently registered in the "
+    "allocation ledger, per device and buffer kind (staging / warmup / "
+    "sink).",
+    labels=["device", "kind"])
+_M_LEDGER_PEAK = _mx.registry().gauge(
+    "scanner_tpu_ledger_peak_bytes",
+    "High watermark of ledger live bytes per (device, kind) since "
+    "process start.",
+    labels=["device", "kind"])
+_M_LEDGER_ALLOCS = _mx.registry().counter(
+    "scanner_tpu_ledger_allocs_total",
+    "Device buffers registered in the allocation ledger, per device "
+    "and kind.",
+    labels=["device", "kind"])
+_M_LEDGER_RELEASES = _mx.registry().counter(
+    "scanner_tpu_ledger_releases_total",
+    "Ledger entries released (buffer collected or explicitly freed), "
+    "per device and kind.  allocs - releases = live entry count.",
+    labels=["device", "kind"])
+_M_OOM = _mx.registry().counter(
+    "scanner_tpu_device_oom_events_total",
+    "RESOURCE_EXHAUSTED events observed at engine staging/dispatch "
+    "sites (real device OOMs, or memory.pressure fault injections), "
+    "by site.",
+    labels=["site"])
+
+
+# same knob semantics as SCANNER_TPU_TRACING (one parser, no drift)
+_ENABLED = _tracing._env_on("SCANNER_TPU_MEMSTATS")
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def set_enabled(on: bool) -> None:
+    """Programmatic override ([memory] enabled config key, tests); the
+    SCANNER_TPU_MEMSTATS env var is read at import and wins when set."""
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+def _env_top_n() -> Optional[int]:
+    v = os.environ.get("SCANNER_TPU_MEMSTATS_TOPN", "")
+    try:
+        n = int(v) if v else None
+    except ValueError:
+        return None
+    # clamp like the config path: a report must stay bounded (negative
+    # values would flip the top-entries slice into "all but N")
+    return max(1, n) if n is not None else None
+
+
+_REPORT_TOP_N = _env_top_n() or 10
+
+
+def report_top_n() -> int:
+    return _REPORT_TOP_N
+
+
+def set_report_top_n(n: int) -> None:
+    """[memory] report_top_n config wiring; the SCANNER_TPU_MEMSTATS_TOPN
+    env var (read at import) wins when set."""
+    global _REPORT_TOP_N
+    if _env_top_n() is None:
+        _REPORT_TOP_N = max(1, int(n))
+
+
+def device_label(device: Optional[Any]) -> str:
+    """Stable label for a jax device ("tpu:3"); "default" when placement
+    is jax's choice (affinity off / single chip).  The canonical
+    implementation — engine/evaluate.py re-exports it, so metrics,
+    ledger entries and trace attrs all key devices identically."""
+    if device is None:
+        return "default"
+    return f"{getattr(device, 'platform', 'dev')}:" \
+           f"{getattr(device, 'id', 0)}"
+
+
+def array_device_label(arr: Any) -> str:
+    """Label for the device a jax array actually lives on; "default"
+    when it is not determinable (host arrays, sharded arrays, version
+    drift)."""
+    devs = getattr(arr, "devices", None)
+    if callable(devs):
+        try:
+            ds = list(devs())
+            if len(ds) == 1:
+                return device_label(ds[0])
+        except Exception:  # noqa: BLE001 — accounting must never raise
+            pass
+    return "default"
+
+
+# ---------------------------------------------------------------------------
+# The allocation ledger
+# ---------------------------------------------------------------------------
+
+class _Entry:
+    __slots__ = ("eid", "nbytes", "device", "kind", "task", "trace_id",
+                 "created")
+
+    def __init__(self, eid: int, nbytes: int, device: str, kind: str,
+                 task: Optional[str], trace_id: Optional[str]):
+        self.eid = eid
+        self.nbytes = int(nbytes)
+        self.device = device
+        self.kind = kind
+        self.task = task
+        self.trace_id = trace_id
+        self.created = time.time()
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"id": self.eid, "bytes": self.nbytes,
+                "device": self.device, "kind": self.kind,
+                "task": self.task, "trace_id": self.trace_id,
+                "age_s": round(time.time() - self.created, 3)}
+
+
+# RLock, not Lock: release() runs from weakref finalizers, which the
+# cyclic GC may fire at any allocation point — including one inside a
+# locked register() on the same thread.  Lock-order rule: NOTHING
+# acquires a metrics family/child lock while holding this one (and the
+# finalizer path touches no metric locks at all) — a finalizer firing
+# inside a metric's own locked allocating region must never wait on a
+# thread that holds _lock and wants that same metric lock.
+_lock = threading.RLock()
+_entries: Dict[int, _Entry] = {}
+_next_id = 0
+_live: Dict[Tuple[str, str], int] = {}
+_peak: Dict[Tuple[str, str], int] = {}
+# (device, kind) keys whose ledger gauges already have scrape-time
+# samplers installed, and release counts awaiting a counter flush from
+# a normal (non-finalizer) thread
+_gauged_keys: set = set()
+_pending_releases: Dict[Tuple[str, str], int] = {}
+
+
+def _install_ledger_gauges(key: Tuple[str, str]) -> None:
+    """Scrape-time samplers for one (device, kind)'s live/peak gauges —
+    plain GIL-atomic dict reads, so scraping never holds the ledger
+    lock while a gauge lock is held.  The live sampler also flushes the
+    deferred release counts: a raw /metrics scrape of an otherwise-idle
+    process must show allocs − releases = live entries (the documented
+    leak diagnostic), not counts stranded by the finalizer deferral."""
+    d, k = key
+
+    def live_sample(key=key):
+        _flush_release_counts()
+        return float(_live.get(key, 0))
+
+    _M_LEDGER_LIVE.labels(device=d, kind=k).set_function(live_sample)
+    _M_LEDGER_PEAK.labels(device=d, kind=k).set_function(
+        lambda key=key: float(_peak.get(key, 0)))
+
+
+def _flush_release_counts() -> None:
+    """Mirror deferred release counts into the releases counter.  The
+    finalizer-driven release() path defers this (metric locks are
+    unsafe there); any normal-thread entry point flushes."""
+    with _lock:
+        if not _pending_releases:
+            return
+        pending = dict(_pending_releases)
+        _pending_releases.clear()
+    for (d, k), n in pending.items():
+        _M_LEDGER_RELEASES.labels(device=d, kind=k).inc(n)
+
+
+def _current_owner() -> Tuple[Optional[str], Optional[str]]:
+    """(task, trace_id) attribution from the active tracing context:
+    the stage/task spans on the hot paths carry job/task attrs, so a
+    buffer registered under one inherits its owner for free."""
+    ctx = _tracing.current_context()
+    trace_id = ctx.trace_id if ctx is not None else None
+    attrs = _tracing.current_span_attrs()
+    task = None
+    if "task" in attrs:
+        task = f"{attrs.get('job')},{attrs.get('task')}"
+    return task, trace_id
+
+
+def register(nbytes: int, device: str, kind: str,
+             task: Optional[str] = None,
+             trace_id: Optional[str] = None) -> Optional[int]:
+    """Record an engine-owned device buffer; returns the entry id (None
+    when memstats is disabled).  Callers that cannot tie release to an
+    object's lifetime pair this with :func:`release` explicitly;
+    :func:`track_array` is the finalizer-based flavor."""
+    if not _ENABLED:
+        return None
+    if task is None and trace_id is None:
+        task, trace_id = _current_owner()
+    global _next_id
+    key = (device, kind)
+    with _lock:
+        eid = _next_id
+        _next_id += 1
+        e = _Entry(eid, nbytes, device, kind, task, trace_id)
+        _entries[eid] = e
+        live = _live.get(key, 0) + e.nbytes
+        _live[key] = live
+        if live > _peak.get(key, 0):
+            _peak[key] = live
+        new_key = key not in _gauged_keys
+        if new_key:
+            _gauged_keys.add(key)
+    # metric work strictly OUTSIDE the ledger lock (see the lock-order
+    # rule at _lock); gauges sample the dicts at scrape time instead of
+    # being pushed, so release() needs no metric calls at all
+    if new_key:
+        _install_ledger_gauges(key)
+    _M_LEDGER_ALLOCS.labels(device=device, kind=kind).inc()
+    _flush_release_counts()
+    # the allocation lands on the owning task's trace timeline, so a
+    # merged trace shows where this task's bytes came from
+    _tracing.add_event("mem.register", kind=kind, bytes=int(nbytes),
+                       device=device)
+    return eid
+
+
+def release(eid: Optional[int]) -> None:
+    """Drop a ledger entry.  Runs from weakref finalizers: only the
+    (reentrant) ledger lock and plain dict/int work in here — metric
+    locks are deferred to _flush_release_counts on a normal thread."""
+    if eid is None:
+        return
+    with _lock:
+        e = _entries.pop(eid, None)
+        if e is None:
+            return  # double release (finalizer + explicit): idempotent
+        key = (e.device, e.kind)
+        _live[key] = max(_live.get(key, 0) - e.nbytes, 0)
+        _pending_releases[key] = _pending_releases.get(key, 0) + 1
+
+
+def track_array(arr: Any, kind: str,
+                device: Optional[str] = None) -> Optional[int]:
+    """Register `arr`'s bytes and release automatically when the array
+    object is collected (weakref.finalize), so the ledger stays
+    byte-accurate without manual pairing on the engine hot paths.
+    Returns the entry id, or None (disabled / un-weakref-able)."""
+    # the HBM gauges are independent of the ledger flag (the docs
+    # promise they survive SCANNER_TPU_MEMSTATS=0): this call site has
+    # jax demonstrably in use — latch that for _jax_ready and install
+    global _jax_in_use
+    _jax_in_use = True
+    _maybe_install_device_gauges()
+    if not _ENABLED:
+        return None
+    nbytes = getattr(arr, "nbytes", None)
+    if not nbytes:
+        return None
+    try:
+        # probe BEFORE registering: an un-weakref-able array would
+        # leave a ledger entry nothing can ever release (call sites
+        # discard the eid by design — release is the finalizer's job)
+        weakref.ref(arr)
+    except TypeError:
+        return None
+    eid = register(int(nbytes), device or array_device_label(arr), kind)
+    if eid is not None:
+        weakref.finalize(arr, release, eid)
+    return eid
+
+
+def live_bytes(device: Optional[str] = None,
+               kind: Optional[str] = None) -> int:
+    with _lock:
+        return sum(v for (d, k), v in _live.items()
+                   if (device is None or d == device)
+                   and (kind is None or k == kind))
+
+
+def watermark_bytes(device: Optional[str] = None,
+                    kind: Optional[str] = None) -> int:
+    with _lock:
+        return sum(v for (d, k), v in _peak.items()
+                   if (device is None or d == device)
+                   and (kind is None or k == kind))
+
+
+def entries() -> List[Dict[str, Any]]:
+    """Live ledger entries as plain dicts (leak-guard fixture, tests)."""
+    _flush_release_counts()
+    with _lock:
+        return [e.to_dict() for e in _entries.values()]
+
+
+def top_entries(n: Optional[int] = None) -> List[Dict[str, Any]]:
+    """The N largest live entries by bytes — the "who owns the HBM"
+    answer an OOM report leads with."""
+    with _lock:
+        es = sorted(_entries.values(), key=lambda e: -e.nbytes)
+        return [e.to_dict() for e in es[:n or _REPORT_TOP_N]]
+
+
+def ledger_summary() -> List[Dict[str, Any]]:
+    _flush_release_counts()
+    with _lock:
+        keys = sorted(set(_live) | set(_peak))
+        counts: Dict[Tuple[str, str], int] = {}
+        for e in _entries.values():
+            k = (e.device, e.kind)
+            counts[k] = counts.get(k, 0) + 1
+        return [{"device": d, "kind": k,
+                 "live_bytes": _live.get((d, k), 0),
+                 "peak_bytes": _peak.get((d, k), 0),
+                 "entries": counts.get((d, k), 0)}
+                for d, k in keys]
+
+
+# ---------------------------------------------------------------------------
+# Backend-reported device stats
+# ---------------------------------------------------------------------------
+
+# memory_stats key aliases across jax backends/versions
+_STAT_KEYS = (("bytes_in_use", ("bytes_in_use",)),
+              ("peak_bytes", ("peak_bytes_in_use", "peak_bytes")),
+              ("limit_bytes", ("bytes_limit", "bytes_reservable_limit")))
+
+
+def _read_stats(dev: Any) -> Optional[Dict[str, int]]:
+    try:
+        st = dev.memory_stats()
+    except Exception:  # noqa: BLE001 — version drift / unsupported
+        return None
+    if not st:
+        return None
+    out = {}
+    for name, aliases in _STAT_KEYS:
+        for a in aliases:
+            if a in st:
+                out[name] = int(st[a])
+                break
+        else:
+            out[name] = 0
+    return out
+
+
+# latched the first time the engine hands us a real jax array
+# (track_array): from then on the backend is provably up, independent
+# of any private-API probe
+_jax_in_use = False
+
+
+def _jax_ready() -> bool:
+    """True only when this process has provably brought a jax backend
+    up.  Sampling device stats must never be the thing that INITIALIZES
+    a backend: a master co-located with worker processes would grab the
+    exclusive TPU runtime (or stall its status handler behind a
+    multi-second init) just to answer /statusz.  Evidence, in order:
+    the engine already handed us a device array (_jax_in_use), or the
+    backend registry is non-empty.  FAIL CLOSED when the (private)
+    registry cannot be read — missing gauges on a drifted jax beat a
+    master seizing the TPU runtime."""
+    if _jax_in_use:
+        return True
+    if sys.modules.get("jax") is None:
+        return False
+    try:
+        from jax._src import xla_bridge as xb
+        backs = getattr(xb, "_backends", None)
+        if isinstance(backs, dict):
+            return bool(backs)
+    except Exception:  # noqa: BLE001 — private-API drift
+        pass
+    return False
+
+
+def device_memory_stats() -> Dict[str, Dict[str, int]]:
+    """{device_label: {bytes_in_use, peak_bytes, limit_bytes}} from the
+    backend, for every local device that reports stats.  {} on
+    backends that report none (CPU) — gracefully absent by design —
+    and in processes that never initialized jax (see _jax_ready)."""
+    if not _jax_ready():
+        return {}
+    try:
+        import jax
+        devs = list(jax.local_devices())
+    except Exception:  # noqa: BLE001 — no jax, no stats
+        return {}
+    out = {}
+    for d in devs:
+        st = _read_stats(d)
+        if st is not None:
+            out[device_label(d)] = st
+    if out:
+        _maybe_install_device_gauges()
+    return out
+
+
+_gauges_installed = False
+
+
+def _maybe_install_device_gauges() -> None:
+    """Install scrape-time samplers for the HBM gauges, once, for every
+    local device that reports memory stats.  Lazy (first ledger-path
+    array or stats read) so importing this module never touches jax,
+    and guarded by _jax_ready so it never initializes a backend.  No
+    ledger lock held here (lock-order rule); a racing double install
+    re-binds identical samplers, which is idempotent."""
+    global _gauges_installed
+    if _gauges_installed or not _jax_ready():
+        return
+    try:
+        import jax
+        devs = list(jax.local_devices())
+    except Exception:  # noqa: BLE001
+        return
+    _gauges_installed = True
+    for d in devs:
+        if _read_stats(d) is None:
+            continue
+        lbl = device_label(d)
+        for gauge, stat in ((_M_HBM_USE, "bytes_in_use"),
+                            (_M_HBM_PEAK, "peak_bytes"),
+                            (_M_HBM_LIMIT, "limit_bytes")):
+            gauge.labels(device=lbl).set_function(
+                lambda dev=d, s=stat:
+                float((_read_stats(dev) or {}).get(s, 0)))
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics
+# ---------------------------------------------------------------------------
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                "OOM")
+
+
+def is_oom(exc: BaseException) -> bool:
+    """True for device memory exhaustion: the engine's own
+    DeviceOutOfMemory (also what the memory.pressure fault site
+    raises), or an XLA RESOURCE_EXHAUSTED runtime error."""
+    if isinstance(exc, DeviceOutOfMemory):
+        return True
+    if type(exc).__name__ == "XlaRuntimeError":
+        msg = str(exc)
+        return any(m in msg for m in _OOM_MARKERS)
+    return False
+
+
+# the flight recorder an OOM report snapshots; components with their own
+# tracer (the cluster Worker) install it so the report shows what THAT
+# process was doing, not the default client tracer
+_tracer: Optional[Any] = None
+
+
+def set_tracer(tracer: Any) -> None:
+    global _tracer
+    _tracer = tracer
+
+
+_report_lock = threading.Lock()
+_last_report: Optional[Dict[str, Any]] = None
+_report_seq = 0
+_last_log_time = 0.0
+LOG_INTERVAL = 60.0  # full-report log lines at most this often
+
+
+def memory_report(reason: str = "",
+                  site: str = "") -> Dict[str, Any]:
+    """One forensic snapshot: backend device stats, the ledger summary,
+    the top-N live entries by bytes (with owning task + trace id), and
+    the tail of the flight recorder.  Plain msgpack-able dict — it
+    crosses the ShipMemoryReport / GetMemoryReport RPC path."""
+    # prefer the tracer owning the CALLING thread's trace context (an
+    # OOM on worker 1's executor thread reports as worker 1 even when a
+    # later-constructed sibling re-bound the module default)
+    tracer = _tracing.current_tracer() or _tracer \
+        or _tracing.default_tracer()
+    recent = [{"name": d.get("name"), "trace_id": d.get("trace_id"),
+               "span_id": d.get("span_id"), "node": d.get("node"),
+               "start": d.get("start"), "end": d.get("end"),
+               "status": d.get("status")}
+              for d in tracer.recent(20)]
+    return {
+        "time": time.time(),
+        "reason": reason,
+        "site": site,
+        # stamped at the source: the shipper's worker_id is not a
+        # reliable origin when several in-process Workers share this
+        # module (whoever polls first ships)
+        "node": getattr(tracer, "node", None),
+        "devices": device_memory_stats(),
+        "ledger": ledger_summary(),
+        "top_entries": top_entries(),
+        "recent_spans": recent,
+    }
+
+
+def note_oom(exc: BaseException, site: str,
+             detail: str = "") -> Dict[str, Any]:
+    """Record one RESOURCE_EXHAUSTED observation: count it, attach it to
+    the current task's trace span, build the memory report, store it
+    for the RPC pull/ship path, and log it — the full report at most
+    once per LOG_INTERVAL (an OOM storm across pipeline instances must
+    not drown the log), a one-liner always."""
+    global _last_report, _report_seq, _last_log_time
+    _M_OOM.labels(site=site).inc()
+    _tracing.add_event("mem.oom", site=site,
+                       error=f"{type(exc).__name__}: {str(exc)[:200]}")
+    report = memory_report(
+        reason=f"{type(exc).__name__}: {str(exc)[:300]}", site=site)
+    if detail:
+        report["detail"] = detail
+    with _report_lock:
+        _report_seq += 1
+        report["seq"] = _report_seq
+        _last_report = report
+        now = time.time()
+        log_full = now - _last_log_time >= LOG_INTERVAL
+        if log_full:
+            _last_log_time = now
+    top = report["top_entries"][:3]
+    _log.error(
+        "device memory exhausted at %s (%s); ledger live=%d bytes, "
+        "top entries: %s",
+        site, report["reason"], live_bytes(),
+        ", ".join(f"{e['bytes']}B {e['kind']}@{e['device']} "
+                  f"task={e['task']}" for e in top) or "none")
+    if log_full:
+        _log.error("memory report: %s", json.dumps(report, default=str))
+    return report
+
+
+def last_report() -> Optional[Dict[str, Any]]:
+    with _report_lock:
+        return dict(_last_report) if _last_report else None
+
+
+_shipped_seq = 0
+
+
+def take_unshipped_report() -> Optional[Dict[str, Any]]:
+    """The newest report, handed out at most once (a GLOBAL claim-once
+    cursor: report state is process-wide, so when several in-process
+    Workers poll, exactly one ships each report instead of each
+    duplicating it)."""
+    global _shipped_seq
+    with _report_lock:
+        if _last_report is not None and _report_seq > _shipped_seq:
+            _shipped_seq = _report_seq
+            return dict(_last_report)
+        return None
+
+
+def status_dict() -> Dict[str, Any]:
+    """The /statusz Memory panel: compact live view (full top-entries
+    detail stays on the report path)."""
+    with _report_lock:
+        last = ({"time": _last_report["time"],
+                 "site": _last_report.get("site"),
+                 "reason": _last_report.get("reason")}
+                if _last_report else None)
+        oom_events = _report_seq
+    return {
+        "enabled": _ENABLED,
+        "devices": device_memory_stats(),
+        "ledger": ledger_summary(),
+        "ledger_live_bytes": live_bytes(),
+        "oom_events": oom_events,
+        "last_oom": last,
+    }

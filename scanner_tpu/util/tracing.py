@@ -254,6 +254,27 @@ def current_context() -> Optional[SpanContext]:
     return SpanContext(t, s)
 
 
+def current_tracer() -> Optional[Tracer]:
+    """The tracer owning the current context, or None.  The calling
+    thread's component identity: a span opened by a Worker's executor
+    runs under THAT worker's tracer, so ambient consumers (memstats OOM
+    reports) can attribute work to the right node even when several
+    components share a process."""
+    cur = _CURRENT.get()
+    return cur[0] if cur is not None else None
+
+
+def current_span_attrs() -> Dict[str, Any]:
+    """Attrs of the current LIVE span, or {} (no span / remote context).
+    The stage/task spans on the engine hot paths carry job/task attrs,
+    so ambient consumers (the memstats allocation ledger) can attribute
+    work to its owning task without new plumbing."""
+    cur = _CURRENT.get()
+    if cur is None or not isinstance(cur[1], Span):
+        return {}
+    return dict(cur[1].attrs or {})
+
+
 def current_traceparent() -> Optional[str]:
     """The header to inject, or None (disabled / outside any trace)."""
     if not _ENABLED:

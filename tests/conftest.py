@@ -13,3 +13,30 @@ import pytest  # noqa: E402
 def tmp_db(tmp_path):
     from scanner_tpu.storage import Database, PosixStorage
     return Database(PosixStorage(str(tmp_path / "db")))
+
+
+@pytest.fixture()
+def ledger_leak_guard():
+    """Opt-in leak guard (util/memstats.py allocation ledger): snapshot
+    the live device-buffer ledger entries before the test and FAIL if
+    entries registered during the test are still live afterwards — a
+    staging leak the chaos suite could only crash on becomes a direct
+    assertion.  Release is finalizer-driven, so collect a few times
+    before judging (cycles + jax's deferred drops)."""
+    import gc
+
+    from scanner_tpu.util import memstats
+
+    gc.collect()
+    before = {e["id"] for e in memstats.entries()}
+    yield memstats
+    leaked = []
+    for _ in range(4):
+        gc.collect()
+        leaked = [e for e in memstats.entries()
+                  if e["id"] not in before]
+        if not leaked:
+            break
+    assert not leaked, (
+        f"engine left {len(leaked)} registered device buffer(s) in the "
+        f"allocation ledger: {leaked[:5]}")

@@ -313,7 +313,11 @@ def _contract_repo(tmp_path):
     """)
     _write(tmp_path, "pkg/util/faults.py", """
         SITES = ("rpc.call", "storage.write")
-        NAMED_PLANS = {"p1": "rpc.call:raise", "p2": "nosuch.site:crash"}
+        MODES = ("raise", "delay")
+        _EXC = {"fault": lambda m: Exception(m)}
+        NAMED_PLANS = {"p1": "rpc.call:raise", "p2": "nosuch.site:crash",
+                       "p3": "rpc.call:explode:n=1",
+                       "p4": "rpc.call:raise:exc=nosuchexc"}
         ACTIVE = False
 
         def inject(site, data=None, detail=""):
@@ -376,6 +380,10 @@ def test_contract_fixture_codes(tmp_path):
     assert any("typo.site" in m for m in msgs)          # unknown inject
     assert any("storage.write" in m for m in msgs)      # unwired site
     assert any("nosuch.site" in m for m in msgs)        # bad named plan
+    assert any("unknown mode `explode`" in m for m in msgs)
+    assert any("unknown exc `nosuchexc`" in m for m in msgs)
+    # the valid clause shapes raise nothing extra
+    assert not any("`raise`" in m for m in msgs)
     msgs = [f.message for f in by_code.get("SC306", [])]
     assert any("NotRegistered" in m for m in msgs)      # called, no server
     assert any("`Reg`" in m for m in msgs)              # registered, dead

@@ -71,8 +71,15 @@ def main() -> int:
     spec = faults.NAMED_PLANS.get(args.plan, args.plan)
     rules = faults.parse_plan(spec)  # validate before spinning anything
     sites = {r.site for r in rules}
+    # memory.* sites hook device staging (engine/batch.py to_device):
+    # they need a frame pipeline with a device kernel to have anything
+    # to fire on, and the workers need device staging forced on the
+    # CPU backend (SCANNER_TPU_KERNEL_DEVICES=all) — same lever the
+    # multichip tests use
+    mem_plan = any(s.split(".")[0] == "memory" for s in sites)
     worker_side = any(s.split(".")[0] in ("pipeline", "storage", "gcs",
-                                          "worker") for s in sites)
+                                          "worker", "memory")
+                      for s in sites)
     master_side = "rpc.server.handle" in sites
     client_side = "rpc.client.call" in sites
     print(f"plan: {spec}\nsites: {sorted(sites)} "
@@ -99,9 +106,18 @@ def main() -> int:
     db_path = args.db or tempfile.mkdtemp(prefix="chaos_run_")
     print(f"db: {db_path}")
     seed = Client(db_path=db_path)
-    seed.new_table("chaos_src", ["output"],
-                   [[_pk(100 + i)] for i in range(args.rows)],
-                   overwrite=True)
+    if mem_plan:
+        import scanner_tpu.kernels  # noqa: F401 — registers Histogram
+        from scanner_tpu import video as scv
+        vid = os.path.join(tempfile.mkdtemp(prefix="chaos_vid_"),
+                           "src.mp4")
+        scv.synthesize_video(vid, num_frames=args.rows, width=64,
+                             height=48, fps=24, keyint=8)
+        seed.ingest_videos([("chaos_vid", vid)])
+    else:
+        seed.new_table("chaos_src", ["output"],
+                       [[_pk(100 + i)] for i in range(args.rows)],
+                       overwrite=True)
 
     # children run on the CPU backend with ambient accelerator-plugin
     # triggers stripped (util/jaxenv.py: a wedged tunnel would hang the
@@ -110,6 +126,8 @@ def main() -> int:
     env = cpu_only_env()
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env.pop("SCANNER_TPU_FAULTS", None)
+    if mem_plan:
+        env["SCANNER_TPU_KERNEL_DEVICES"] = "all"
 
     def spawn(script, argv, plan=None):
         e = dict(env)
@@ -161,8 +179,13 @@ def main() -> int:
     print(f"workers registered: {sc.job_status().get('num_workers', 0)}")
 
     def run(out_name, **kw):
-        col = sc.io.Input([NamedStream(sc, "chaos_src")])
-        col = sc.ops.ChaosRunDouble(x=col)
+        if mem_plan:
+            from scanner_tpu import NamedVideoStream
+            col = sc.io.Input([NamedVideoStream(sc, "chaos_vid")])
+            col = sc.ops.Histogram(frame=col)
+        else:
+            col = sc.io.Input([NamedStream(sc, "chaos_src")])
+            col = sc.ops.ChaosRunDouble(x=col)
         out = NamedStream(sc, out_name)
         sc.run(sc.io.Output(col, [out]), PerfParams.manual(2, 2, **kw),
                cache_mode=CacheMode.Overwrite, show_progress=True)

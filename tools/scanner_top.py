@@ -6,6 +6,7 @@ subsystem (docs/observability.md).  `top` for a scanner cluster:
 
     python tools/scanner_top.py --master localhost:5000
     python tools/scanner_top.py --master localhost:5000 --once   # scripts
+    python tools/scanner_top.py --master localhost:5000 --json   # machines
 
 Rates (decode fps, eval rows/s, h2d MB/s) come from counter deltas
 between polls; the first poll (and --once) uses since-process-start
@@ -99,6 +100,15 @@ def digest(snap: dict) -> dict:
             snap, "scanner_tpu_device_tasks_total", node)
         d["dev_busy"] = _per_device(
             snap, "scanner_tpu_device_busy_seconds_total", node)
+        # per-chip memory (util/memstats.py): backend-reported HBM
+        # occupancy/limit plus the allocation ledger's engine-owned
+        # live bytes (summed across buffer kinds)
+        d["dev_hbm"] = _per_device(
+            snap, "scanner_tpu_device_hbm_bytes_in_use", node)
+        d["dev_hbm_limit"] = _per_device(
+            snap, "scanner_tpu_device_hbm_limit_bytes", node)
+        d["dev_ledger"] = _per_device(
+            snap, "scanner_tpu_ledger_live_bytes", node)
         out["nodes"][node] = d
     return out
 
@@ -161,17 +171,20 @@ def render(status: dict, cur: dict, prev: dict, master: str) -> str:
             f"{_rate(d, p, 'd2h_b', now) / 1e6:>9.2f} "
             f"{d['evalq']:>6.0f} {d['saveq']:>6.0f} "
             f"{d['retries']:>6.0f}")
-    # per-chip breakdown (multi-chip evaluator affinity): one row per
-    # (node, device) that has taken tasks — chip imbalance (a device
-    # stuck while siblings climb) is invisible in the node totals above
+    # per-chip breakdown (evaluator affinity + memstats): one row per
+    # (node, device) that has taken tasks or holds memory — chip
+    # imbalance (a device stuck while siblings climb) and HBM skew are
+    # invisible in the node totals above
     dev_rows = []
     for node, d in sorted(cur["nodes"].items()):
-        devs = d.get("dev_tasks") or {}
-        if not devs or set(devs) == {"default"}:
+        tasks_by = d.get("dev_tasks") or {}
+        devs = set(tasks_by) | set(d.get("dev_hbm") or {}) \
+            | set(d.get("dev_ledger") or {})
+        if not devs or (devs == {"default"} and not d.get("dev_hbm")):
             continue
         p = prev_nodes.get(node) or {}
         for dev in sorted(devs):
-            tasks = devs[dev]
+            tasks = tasks_by.get(dev, 0.0)
             busy = (d.get("dev_busy") or {}).get(dev, 0.0)
             p_busy = (p.get("dev_busy") or {}).get(dev, 0.0)
             if "_dt" in d:
@@ -179,14 +192,57 @@ def render(status: dict, cur: dict, prev: dict, master: str) -> str:
             else:
                 up = max(now - d["start"], 1e-6) if d.get("start") else None
                 util = busy / up if up else 0.0
-            dev_rows.append(f"{node:10} {dev:>10} {tasks:>7.0f} "
-                            f"{busy:>8.1f} {min(util, 1.0) * 100:>6.1f}%")
+            hbm = (d.get("dev_hbm") or {}).get(dev, 0.0)
+            limit = (d.get("dev_hbm_limit") or {}).get(dev, 0.0)
+            ledger = (d.get("dev_ledger") or {}).get(dev, 0.0)
+            pct = f"{hbm / limit * 100:>5.1f}%" if limit else "    -"
+            dev_rows.append(
+                f"{node:10} {dev:>10} {tasks:>7.0f} {busy:>8.1f} "
+                f"{min(util, 1.0) * 100:>6.1f}% {hbm / 1e6:>9.1f} "
+                f"{pct:>6} {ledger / 1e6:>9.1f}")
     if dev_rows:
         lines.append("")
         lines.append(f"{'NODE':10} {'DEVICE':>10} {'TASKS':>7} "
-                     f"{'BUSY s':>8} {'UTIL':>7}")
+                     f"{'BUSY s':>8} {'UTIL':>7} {'HBM MB':>9} "
+                     f"{'HBM%':>6} {'LEDG MB':>9}")
         lines.extend(dev_rows)
     return "\n".join(lines)
+
+
+def json_doc(status: dict, cur: dict, master: str) -> dict:
+    """The --json document: everything --once renders, machine-readable
+    (scripts used to scrape the human table).  Per-node counter totals
+    since process start plus the per-device utilization/memory maps."""
+    nodes = {}
+    for node, d in sorted(cur["nodes"].items()):
+        nodes[node] = {
+            "decoded_frames": d["decode_f"],
+            "eval_rows": d["eval_r"],
+            "h2d_bytes": d["h2d_b"],
+            "d2h_bytes": d["d2h_b"],
+            "retries": d["retries"],
+            "eval_queue": d["evalq"],
+            "save_queue": d["saveq"],
+            "process_start_time": d.get("start"),
+            "devices": {
+                dev: {
+                    "tasks": (d.get("dev_tasks") or {}).get(dev, 0.0),
+                    "busy_seconds":
+                        (d.get("dev_busy") or {}).get(dev, 0.0),
+                    "hbm_bytes_in_use":
+                        (d.get("dev_hbm") or {}).get(dev, 0.0),
+                    "hbm_limit_bytes":
+                        (d.get("dev_hbm_limit") or {}).get(dev, 0.0),
+                    "ledger_live_bytes":
+                        (d.get("dev_ledger") or {}).get(dev, 0.0),
+                }
+                for dev in sorted(set(d.get("dev_tasks") or {})
+                                  | set(d.get("dev_hbm") or {})
+                                  | set(d.get("dev_ledger") or {}))
+            },
+        }
+    return {"time": cur["t"], "master": master, "status": status,
+            "nodes": nodes}
 
 
 # -- main -------------------------------------------------------------------
@@ -201,6 +257,9 @@ def main(argv=None) -> int:
                     help="poll period seconds (default %(default)s)")
     ap.add_argument("--once", action="store_true",
                     help="print one snapshot and exit (for scripts)")
+    ap.add_argument("--json", action="store_true",
+                    help="print one machine-readable JSON snapshot and "
+                         "exit (mirrors --once; no table scraping)")
     args = ap.parse_args(argv)
 
     from scanner_tpu.engine.rpc import RpcClient
@@ -221,6 +280,10 @@ def main(argv=None) -> int:
                     and "tasks_done" not in status:
                 status = None
             cur = digest(reply["snapshot"])
+            if args.json:
+                import json as _json
+                print(_json.dumps(json_doc(status, cur, args.master)))
+                return 0
             frame = render(status, cur, prev, args.master)
             if args.once:
                 print(frame)
