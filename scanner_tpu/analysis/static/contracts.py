@@ -22,6 +22,12 @@ passes make each one checkable:
   SC307  RPC classification: every registered handler needs an
          `RPC_CONTRACTS` entry (timeout class + idempotency — what the
          retry/backoff layer is allowed to do with it)
+  SC308  alert-rule contract drift: the health engine's DEFAULT_RULES
+         names and the docs/observability.md default-ruleset table may
+         not drift (both directions; the table is delimited by
+         `default-alert-rules:begin/end` markers), and the `[alerts]`
+         config section must declare exactly the keys
+         health.CONFIG_KEYS accepts
 """
 
 from __future__ import annotations
@@ -295,6 +301,7 @@ class ContractPass(AnalysisPass):
         "SC305": "fault-injection site drift (SITES vs wired hooks)",
         "SC306": "RPC method drift (called vs registered)",
         "SC307": "RPC handler missing RPC_CONTRACTS classification",
+        "SC308": "alert-rule drift (DEFAULT_RULES vs docs vs [alerts])",
     }
 
     def run(self, project: Project) -> List[Finding]:
@@ -304,6 +311,7 @@ class ContractPass(AnalysisPass):
         out.extend(self._config_keys(project))
         out.extend(self._fault_sites(project))
         out.extend(self._rpc_surface(project))
+        out.extend(self._alert_rules(project))
         return out
 
     # -- SC301 / SC302 ---------------------------------------------------
@@ -514,6 +522,103 @@ class ContractPass(AnalysisPass):
                     "SC305",
                     f"DATA_SITES entry `{site}` is not in SITES",
                     fmod.tree))
+        return out
+
+    # -- SC308 -----------------------------------------------------------
+
+    _ALERT_DOC_BLOCK_RE = re.compile(
+        r"<!--\s*default-alert-rules:begin\s*-->(.*?)"
+        r"<!--\s*default-alert-rules:end\s*-->", re.S)
+    _ALERT_DOC_NAME_RE = re.compile(r"^\|\s*`([a-z0-9_]+)`", re.M)
+
+    @staticmethod
+    def _default_rule_names(mod: ModuleInfo
+                            ) -> Optional[List[Tuple[str, ast.AST]]]:
+        """(name, node) per element of the module-level DEFAULT_RULES
+        tuple — the literal `name=` kwarg (or first positional string)
+        of each rule constructor call."""
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and stmt.targets[0].id == "DEFAULT_RULES" \
+                    and isinstance(stmt.value, (ast.Tuple, ast.List)):
+                out: List[Tuple[str, ast.AST]] = []
+                for el in stmt.value.elts:
+                    if not isinstance(el, ast.Call):
+                        continue
+                    name = None
+                    for kw in el.keywords:
+                        if kw.arg == "name":
+                            name = _const_str(kw.value)
+                    if name is None and el.args:
+                        name = _const_str(el.args[0])
+                    if name is not None:
+                        out.append((name, el))
+                return out
+        return None
+
+    def _alert_rules(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        hmod = project.module("util/health.py")
+        if hmod is None:
+            return out
+        rules = self._default_rule_names(hmod)
+        doc = _read_doc(project, "observability.md")
+        if rules:
+            block = self._ALERT_DOC_BLOCK_RE.search(doc) if doc else None
+            if doc and block is None:
+                out.append(hmod.finding(
+                    "SC308",
+                    "health.DEFAULT_RULES exists but docs/"
+                    "observability.md has no default-alert-rules "
+                    "marker table (<!-- default-alert-rules:begin/end "
+                    "-->) — operators cannot see what alerts by "
+                    "default", hmod.tree))
+            elif block is not None:
+                doc_names = set(
+                    self._ALERT_DOC_NAME_RE.findall(block.group(1)))
+                for name, node in rules:
+                    if name not in doc_names:
+                        out.append(hmod.finding(
+                            "SC308",
+                            f"default alert rule `{name}` is missing "
+                            "from the docs/observability.md "
+                            "default-ruleset table", node))
+                for name in sorted(doc_names
+                                   - {n for n, _ in rules}):
+                    out.append(Finding(
+                        code="SC308",
+                        message=f"docs/observability.md default-ruleset "
+                                f"table lists `{name}` but "
+                                "health.DEFAULT_RULES has no such rule",
+                        path="docs/observability.md", line=1, scope="",
+                        snippet=name))
+        # [alerts] config keys <-> health.CONFIG_KEYS, both directions:
+        # a declared key the engine never reads is dead config; an
+        # accepted key config doesn't declare is unreachable
+        schema = _module_tuple(hmod, "CONFIG_KEYS")
+        cfg_mod = None
+        for m in project.modules:
+            if m.relpath.endswith("config.py") \
+                    and _default_config_keys(m):
+                cfg_mod = m
+                break
+        if schema is not None and cfg_mod is not None:
+            declared = {k for sec, k in _default_config_keys(cfg_mod)
+                        if sec == "alerts"}
+            if declared:
+                for k in sorted(declared - set(schema)):
+                    out.append(cfg_mod.finding(
+                        "SC308",
+                        f"config key `[alerts] {k}` is declared but "
+                        "health.CONFIG_KEYS does not accept it",
+                        cfg_mod.tree))
+                for k in sorted(set(schema) - declared):
+                    out.append(hmod.finding(
+                        "SC308",
+                        f"health.CONFIG_KEYS accepts `{k}` but "
+                        "config.default_config() declares no "
+                        f"`[alerts] {k}`", hmod.tree))
         return out
 
     # -- SC306 / SC307 ---------------------------------------------------

@@ -63,6 +63,19 @@ def default_config() -> Dict[str, Any]:
             # overrides per process.
             "enabled": True,
         },
+        "alerts": {
+            # the health/SLO engine (util/health.py): declarative alert
+            # rules evaluated in-process over the metrics registry,
+            # rolled up into /healthz | /readyz | /alertz and
+            # Client.health().  On by default (a ~1 Hz sample of the
+            # rule-referenced series); SCANNER_TPU_HEALTH=0 overrides
+            # per process.
+            "enabled": True,
+            # user alert rules appended to the built-in default
+            # ruleset; ";"-separated clauses, grammar in
+            # docs/observability.md §Health & SLOs.  "" = defaults only.
+            "rules": "",
+        },
         "faults": {
             # deterministic fault-injection plan (docs/robustness.md for
             # the clause syntax; util/faults.py implements it).  "" (the
@@ -150,6 +163,18 @@ class Config:
         """Distributed-tracing span recording (the deployment default;
         SCANNER_TPU_TRACING overrides per process)."""
         return bool(self.config.get("trace", {}).get("enabled", True))
+
+    @property
+    def alerts_enabled(self) -> bool:
+        """Health/SLO alert engine (the deployment default;
+        SCANNER_TPU_HEALTH overrides per process)."""
+        return bool(self.config.get("alerts", {}).get("enabled", True))
+
+    @property
+    def alert_rules(self) -> str:
+        """User alert rules ([alerts] rules clause spec), "" = only the
+        built-in default ruleset."""
+        return str(self.config.get("alerts", {}).get("rules", "") or "")
 
     @property
     def faults_plan(self) -> Optional[str]:

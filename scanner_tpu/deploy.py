@@ -164,6 +164,11 @@ class ClusterConfig:
     # save; a too-small value turns every rolling update into a crash
     # the stale scan must clean up.
     termination_grace_period: int = 120
+    # user alert rules appended to the built-in health/SLO ruleset
+    # (docs/observability.md §Health & SLOs clause grammar); wired into
+    # the ConfigMap's [alerts] section so every pod's engine evaluates
+    # them.  "" = defaults only.
+    alert_rules: str = ""
 
     def price_per_hour(self) -> float:
         return (self.master_cpus * CPU_PRICE_PER_CORE
@@ -286,6 +291,8 @@ def config_manifest(cfg: ClusterConfig) -> Dict:
     if cfg.compilation_cache_dir:
         sections["perf"] = {
             "compilation_cache_dir": cfg.compilation_cache_dir}
+    if cfg.alert_rules:
+        sections["alerts"] = {"rules": cfg.alert_rules}
     toml = dump_toml(sections)
     return {
         "apiVersion": "v1", "kind": "ConfigMap",
@@ -296,6 +303,29 @@ def config_manifest(cfg: ClusterConfig) -> Dict:
 
 def _metrics_arg(cfg: ClusterConfig) -> str:
     return f", metrics_port={cfg.metrics_port}" if cfg.metrics_port else ""
+
+
+def _probes(cfg: ClusterConfig) -> Dict:
+    """Container liveness/readiness probes against the metrics
+    endpoint's health routes (util/metrics.py MetricsServer).
+    Liveness -> /healthz, which answers 200 whenever the process can
+    answer at all: alert states (HBM pressure, latency burn) are
+    workload facts a restart cannot fix, so the probe only fails when
+    the process is dead or wedged.  Readiness -> /readyz, which goes
+    503 while the health roll-up is `unhealthy` OR a SIGTERM drain is
+    in progress — k8s stops routing to the pod while its in-flight
+    tasks finish instead of killing it.  Only emitted when the
+    endpoint exists (metrics_port set)."""
+    if not cfg.metrics_port:
+        return {}
+    return {
+        "livenessProbe": {
+            "httpGet": {"path": "/healthz", "port": cfg.metrics_port},
+            "periodSeconds": 10, "failureThreshold": 6},
+        "readinessProbe": {
+            "httpGet": {"path": "/readyz", "port": cfg.metrics_port},
+            "periodSeconds": 5, "failureThreshold": 2},
+    }
 
 
 def master_manifest(cfg: ClusterConfig) -> Dict:
@@ -322,6 +352,7 @@ def master_manifest(cfg: ClusterConfig) -> Dict:
                     "env": [{"name": "SCANNER_TPU_LOG",
                              "value": cfg.log_level}],
                     "ports": ports,
+                    **_probes(cfg),
                     "resources": {"requests": {"cpu": str(cfg.master_cpus)}},
                 }]},
             },
@@ -402,6 +433,7 @@ def _worker_statefulset(cfg: ClusterConfig, name: str, replicas: int,
                         **({"ports": [{"containerPort": cfg.metrics_port,
                                        "name": "metrics"}]}
                            if cfg.metrics_port else {}),
+                        **_probes(cfg),
                         "env": [
                             {"name": "SCANNER_TPU_LOG",
                              "value": cfg.log_level},

@@ -109,6 +109,15 @@ class Client:
             if not os.environ.get("SCANNER_TPU_MEMSTATS"):
                 memstats.set_enabled(cfg.memstats_enabled)
             memstats.set_report_top_n(cfg.memstats_report_top_n)
+            # [alerts] section: health/SLO engine default + user rules;
+            # the SCANNER_TPU_HEALTH env var (read at import) wins
+            from ..util import health as _health_cfg
+            if not os.environ.get("SCANNER_TPU_HEALTH"):
+                _health_cfg.set_enabled(cfg.alerts_enabled)
+            # applied in both directions (like [trace]): a config with
+            # rules="" CLEARS user rules an earlier config installed —
+            # removed rules' states resolve instead of firing forever
+            _health_cfg.configure(cfg.alert_rules)
             # explicit argument beats config beats default
             storage_type = storage_type or cfg.storage_type
             if master is None:
@@ -159,6 +168,7 @@ class Client:
         self._metrics_server = None
         if metrics_port is not None:
             from ..util.metrics import MetricsServer
+            from ..util import health as _health_st
             from ..util import memstats as _memstats
             self._metrics_server = MetricsServer(
                 port=metrics_port,
@@ -166,6 +176,7 @@ class Client:
                                  "master": self._master_address,
                                  "db": getattr(self._db.backend, "root",
                                                None),
+                                 "health": _health_st.status_dict(),
                                  "memory": _memstats.status_dict()},
                 healthz=lambda: {"role": "client"})
 
@@ -182,6 +193,11 @@ class Client:
             num_save_workers=num_save_workers,
             pipeline_instances=pipeline_instances or 1,
             decoder_threads=decoder_threads)
+        # health/SLO engine (util/health.py): local-mode runs get the
+        # same backpressure/latency judgment cluster nodes do; no-op
+        # when SCANNER_TPU_HEALTH=0 / [alerts] enabled=false
+        from ..util import health as _health
+        _health.ensure_started()
 
     # -- context manager ----------------------------------------------------
 
@@ -221,6 +237,19 @@ class Client:
             return self._cluster.metrics()
         from ..util.metrics import merge_snapshots, registry
         return merge_snapshots({"client": registry().snapshot()})
+
+    def health(self) -> Dict[str, Any]:
+        """Cluster health roll-up (docs/observability.md §Health &
+        SLOs).  Cluster mode: the master's GetHealth view — worst-of
+        `ok|degraded|unhealthy` across master + every live worker,
+        node-prefixed reason codes, and each node's firing alerts
+        (`{"status", "reasons", "firing", "nodes"}`).  Local mode: this
+        process's health engine in the same shape under
+        nodes["client"]."""
+        if self._cluster is not None:
+            return self._cluster.health()
+        from ..util import health as _health
+        return _health.merge_status({"client": _health.status_dict()})
 
     def memory_report(self) -> Dict[str, Any]:
         """Memory forensics (docs/observability.md §Memory).  Cluster

@@ -659,6 +659,12 @@ class LocalExecutor:
         degrades to a single-threaded sequential loop — same semantics,
         clean stack traces for debugging."""
         import os
+        # the health/SLO engine watches this pipeline's queue-depth and
+        # stage-rate series: make sure it samples while stages run,
+        # even when no Client/Worker constructor started it (direct
+        # LocalExecutor embedding, spawned test workers)
+        from ..util import health as _health
+        _health.ensure_started()
         if os.environ.get("SCANNER_TPU_NO_PIPELINING", "0") not in \
                 ("0", "", "false"):
             return self._run_serial(info, source, on_start, on_done,

@@ -33,6 +33,7 @@ from ..common import CacheMode, JobException, PerfParams, ScannerException
 from ..storage import Database, make_storage
 from ..storage import metadata as md
 from ..util import faults as _faults
+from ..util import health as _health
 from ..util import memstats as _memstats
 from ..util import metrics as _mx
 from ..util import tracing as _tracing
@@ -84,6 +85,7 @@ RPC_CONTRACTS = {
     "FailedWork":       {"timeout_s": 30.0, "idempotent": False},
     "GetJobStatus":     {"timeout_s": 30.0, "idempotent": True},
     "GetMetrics":       {"timeout_s": 30.0, "idempotent": True},
+    "GetHealth":        {"timeout_s": 30.0, "idempotent": True},
     "PokeWatchdog":     {"timeout_s": 30.0, "idempotent": True},
     "PostProfile":      {"timeout_s": 30.0, "idempotent": False},
     "GetProfiles":      {"timeout_s": 30.0, "idempotent": True},
@@ -425,6 +427,7 @@ class Master:
             "FailedWork": self._rpc_failed_work,
             "GetJobStatus": self._rpc_job_status,
             "GetMetrics": self._rpc_get_metrics,
+            "GetHealth": self._rpc_get_health,
             "PokeWatchdog": self._rpc_poke,
             "PostProfile": self._rpc_post_profile,
             "GetProfiles": self._rpc_get_profiles,
@@ -444,6 +447,11 @@ class Master:
             self.metrics_server = MetricsServer(
                 port=metrics_port, statusz=self._statusz,
                 healthz=lambda: {"role": "master"}, host=metrics_host)
+        # the health/SLO engine (util/health.py): worker-liveness and
+        # latency-burn rules read series this process maintains, so the
+        # master always evaluates them — /healthz, GetJobStatus and
+        # GetHealth report the roll-up
+        _health.ensure_started()
         self._scan_thread = threading.Thread(
             target=self._scan_loop, name="master-scan", daemon=True)
         self._scan_thread.start()
@@ -880,11 +888,18 @@ class Master:
                 # still report cluster liveness: lets tooling (e.g.
                 # tools/chaos_run.py) wait for workers to register
                 # before submitting anything
-                return {"error": "no such bulk job",
-                        "num_workers": sum(
-                            1 for w in self._workers.values()
-                            if w.active)}
-            return self._job_status_locked(bulk)
+                st = {"error": "no such bulk job",
+                      "num_workers": sum(
+                          1 for w in self._workers.values()
+                          if w.active)}
+            else:
+                st = self._job_status_locked(bulk)
+        # the master-local health roll-up rides on every status poll
+        # (added OUTSIDE the control-plane lock: the engine has a lock
+        # of its own) — the 4 Hz client poll and scanner_top see
+        # degradation without a second RPC
+        st["health"] = _health.rollup()
+        return st
 
     def _statusz(self) -> dict:
         """JSON body of /statusz: live job progress + worker liveness."""
@@ -902,6 +917,9 @@ class Master:
             mem_reports = len(self._mem_reports)
         return {"role": "master", "workers": workers,
                 "bulk_id": bulk_id, "bulk": status,
+                # the Health panel: this process's roll-up + firing
+                # alerts (util/health.py; outside the control lock)
+                "health": _health.status_dict(),
                 # the Memory panel: this process's HBM/ledger view plus
                 # how many worker OOM reports are held for
                 # GetMemoryReport
@@ -940,6 +958,35 @@ class Master:
                         by_node[f"worker{wid}"] = reply["snapshot"]
         return {"snapshot": merge_snapshots(by_node),
                 "nodes": sorted(by_node)}
+
+    def _rpc_get_health(self, req: dict) -> dict:
+        """Cluster-wide health: this process's roll-up plus every live
+        worker's (GetHealth dialed at each worker's advertised address,
+        the same diagnostic pull plane as GetMetrics), combined into
+        one worst-of status with node-prefixed reason codes —
+        Client.health() and the scanner_top ALERTS section read this."""
+        from concurrent import futures as _fut
+
+        with self._lock:
+            targets = [(w.worker_id, w.address)
+                       for w in self._workers.values()
+                       if w.active and w.address]
+        nodes: Dict[str, dict] = {"master": _health.status_dict()}
+
+        def pull(wid: int, addr: str):
+            c = rpc.RpcClient(addr, WORKER_SERVICE, timeout=2.0)
+            try:
+                return wid, c.try_call("GetHealth", retries=0)
+            finally:
+                c.close()
+
+        if targets and req.get("workers", True):
+            with _fut.ThreadPoolExecutor(
+                    max_workers=min(16, len(targets))) as pool:
+                for wid, reply in pool.map(lambda t: pull(*t), targets):
+                    if reply and "health" in reply:
+                        nodes[f"worker{wid}"] = reply["health"]
+        return _health.merge_status(nodes)
 
     def _rpc_poke(self, req: dict) -> dict:
         self._last_poke = time.time()
@@ -1508,6 +1555,14 @@ class Master:
         if self.metrics_server is not None:
             self.metrics_server.stop()
             self.metrics_server = None
+        # drop this master's heartbeat-age gauge children: with the
+        # scan loop gone nothing would ever update or remove them, and
+        # a stale high-age sample would keep the health engine's
+        # worker_heartbeat_stale alert firing forever in a process that
+        # outlives the master (embedders, test suites)
+        with self._lock:
+            for w in self._workers.values():
+                _M_HB_AGE.remove_labels(worker=str(w.worker_id))
 
 
 # ---------------------------------------------------------------------------
@@ -1564,6 +1619,9 @@ class Worker:
             # serves the master's cluster-wide metrics aggregation
             "GetMetrics": lambda req: {
                 "snapshot": _mx.registry().snapshot()},
+            # serves the master's cluster-wide health aggregation
+            # (GetHealth fan-in -> Client.health())
+            "GetHealth": lambda req: {"health": _health.status_dict()},
             "Shutdown": self._rpc_shutdown,
         }, port=port, tracer=self.tracer)
         self.port = self._server.port
@@ -1572,7 +1630,17 @@ class Worker:
         if metrics_port is not None:
             self.metrics_server = MetricsServer(
                 port=metrics_port, statusz=self._statusz,
-                healthz=lambda: {"role": "worker"}, host=metrics_host)
+                healthz=lambda: {"role": "worker",
+                                 "draining": self._draining.is_set()},
+                # SIGTERM drain: not-ready (k8s stops routing) while
+                # /healthz stays 200 (still alive, finishing in-flight)
+                ready=lambda: not self._draining.is_set(),
+                host=metrics_host)
+        # health/SLO engine: backpressure/saturation rules read series
+        # this worker's pipeline maintains; alert transition instants
+        # land on THIS worker's flight recorder (node-labeled)
+        _health.set_tracer(self.tracer)
+        _health.ensure_started()
         self.executor = LocalExecutor(
             self.db, self.profiler,
             num_load_workers=num_load_workers,
@@ -1692,6 +1760,8 @@ class Worker:
             "pipeline_instances": ex.pipeline_instances if ex else None,
             "num_load_workers": ex.num_load_workers if ex else None,
             "num_save_workers": ex.num_save_workers if ex else None,
+            # the Health panel: roll-up + firing alerts (util/health.py)
+            "health": _health.status_dict(),
             # the Memory panel: per-device HBM + allocation-ledger view
             "memory": _memstats.status_dict(),
         }
@@ -2066,6 +2136,12 @@ class ClusterClient:
 
     def job_status(self, bulk_id: Optional[int] = None) -> dict:
         return self.master.call("GetJobStatus", bulk_id=bulk_id)
+
+    def health(self) -> dict:
+        """Cluster-wide health roll-up (GetHealth RPC): worst-of status
+        across master + every live worker, node-prefixed reason codes,
+        and each node's firing alerts."""
+        return self.master.call("GetHealth", timeout=30.0)
 
     def get_trace(self, bulk_id: Optional[int] = None) -> dict:
         """The master-assembled cross-host trace of a bulk: span dicts

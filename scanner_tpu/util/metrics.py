@@ -415,6 +415,70 @@ _REGISTRY.gauge(
 
 
 # ---------------------------------------------------------------------------
+# Histogram quantile estimation (shared: SLO engine, bench, tools)
+# ---------------------------------------------------------------------------
+
+def histogram_quantile(uppers: Sequence[float],
+                       buckets: Sequence[float],
+                       q: float) -> Optional[float]:
+    """Estimate the q-quantile from per-bucket counts (len(buckets) ==
+    len(uppers) + 1; the extra final bucket is +Inf).  Linear
+    interpolation inside the bucket containing the target rank — the
+    same estimate PromQL's histogram_quantile makes.  Returns None for
+    an empty histogram; observations landing in the +Inf bucket clamp
+    to the highest finite upper bound (there is nothing to interpolate
+    toward)."""
+    total = float(sum(buckets))
+    if total <= 0:
+        return None
+    target = q * total
+    edges = [0.0] + list(uppers)
+    acc = 0.0
+    for i, c in enumerate(buckets):
+        if acc + c >= target and c > 0:
+            if i >= len(uppers):
+                # +Inf bucket: clamp to the last finite bound
+                return float(uppers[-1]) if uppers else None
+            lo, hi = edges[i], uppers[i]
+            return lo + (hi - lo) * (target - acc) / c
+        acc += c
+    return float(uppers[-1]) if uppers else None
+
+
+def snapshot_histogram_quantiles(snapshot: Dict[str, dict], series: str,
+                                 qs: Sequence[float] = (0.5, 0.9, 0.99)
+                                 ) -> Dict[str, Any]:
+    """Aggregate every sample of a histogram series in a (plain or
+    merged) snapshot and estimate quantiles: {"count", "mean_s",
+    "p50_s", ...}, or {} when the series is absent or empty.  The
+    digest shape bench.py banks and tools consume."""
+    e = snapshot.get(series)
+    if not e or not e.get("samples"):
+        return {}
+    uppers = list(e.get("uppers") or [])
+    buckets: Optional[List[float]] = None
+    total, ssum = 0, 0.0
+    for smp in e["samples"]:
+        b = smp.get("buckets")
+        if not b:
+            continue
+        if buckets is None:
+            buckets = [0.0] * len(b)
+        for i, v in enumerate(b):
+            buckets[i] += v
+        total += smp.get("count", 0)
+        ssum += smp.get("sum", 0.0)
+    if not buckets or not total:
+        return {}
+    out: Dict[str, Any] = {"count": int(total),
+                           "mean_s": round(ssum / total, 4)}
+    for q in qs:
+        v = histogram_quantile(uppers, buckets, q)
+        out[f"p{int(q * 100)}_s"] = round(v, 4) if v is not None else None
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Snapshot merging (master aggregates workers)
 # ---------------------------------------------------------------------------
 
@@ -497,24 +561,40 @@ def render_prometheus(snapshot: Dict[str, dict]) -> str:
 # ---------------------------------------------------------------------------
 
 class MetricsServer:
-    """Serves /metrics (Prometheus text), /healthz and /statusz (JSON)
-    on a daemon thread.  Off unless a process explicitly constructs one
-    (Client/Master/Worker `metrics_port=`); port=0 binds an ephemeral
-    port (see `.port`).  Binds loopback by default — the endpoint is
-    unauthenticated and /statusz names db paths and cluster topology;
-    Master/Worker pass host="0.0.0.0" (overridable via `metrics_host=`)
-    because cross-host Prometheus scraping is their point."""
+    """Serves /metrics (Prometheus text), /healthz, /readyz, /alertz
+    and /statusz (JSON) on a daemon thread.  Off unless a process
+    explicitly constructs one (Client/Master/Worker `metrics_port=`);
+    port=0 binds an ephemeral port (see `.port`).  Binds loopback by
+    default — the endpoint is unauthenticated and /statusz names db
+    paths and cluster topology; Master/Worker pass host="0.0.0.0"
+    (overridable via `metrics_host=`) because cross-host Prometheus
+    scraping is their point.
+
+    /healthz reflects the health engine's roll-up (util/health.py) in
+    its BODY (`status`, reason codes; `ok` flips false on `unhealthy`)
+    but always answers 200 while the process is alive — it is the
+    liveness surface, and alert states are workload facts a restart
+    cannot fix.  /readyz is the gate that goes 503 while the roll-up
+    is `unhealthy` or `ready()` is false (a SIGTERM drain: not-ready,
+    still-alive), so k8s stops routing instead of restarting.
+    /alertz serves the firing alerts plus the full rule table."""
 
     def __init__(self, port: int = 0,
                  reg: Optional[MetricsRegistry] = None,
                  statusz: Optional[Callable[[], dict]] = None,
                  healthz: Optional[Callable[[], dict]] = None,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 health: Optional[Callable[[], dict]] = None,
+                 ready: Optional[Callable[[], bool]] = None,
+                 alertz: Optional[Callable[[], dict]] = None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
         reg = reg or registry()
         outer = self
         self._statusz = statusz
         self._healthz = healthz
+        self._health = health
+        self._ready = ready
+        self._alertz = alertz
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # silence per-request stderr spam
@@ -536,8 +616,33 @@ class MetricsServer:
                                         "charset=utf-8", body)
                     elif path == "/healthz":
                         extra = outer._healthz() if outer._healthz else {}
+                        roll = outer._health_rollup()
+                        # ALWAYS 200 while the process can answer:
+                        # /healthz is the LIVENESS surface, and alert
+                        # states (HBM pressure, latency burn) are
+                        # workload facts a restart cannot fix — a 503
+                        # here would restart-loop pods under legitimate
+                        # sustained load.  The body still carries the
+                        # roll-up (ok=false on `unhealthy`) for humans
+                        # and scripts; /readyz is the surface that
+                        # goes 503 so k8s stops ROUTING instead.
+                        ok = roll.get("status", "ok") != "unhealthy"
                         self._send(200, "application/json",
-                                   json.dumps({"ok": True, **extra})
+                                   json.dumps({"ok": ok, **roll,
+                                               **extra}).encode())
+                    elif path == "/readyz":
+                        roll = outer._health_rollup()
+                        rdy = roll.get("status", "ok") != "unhealthy"
+                        if rdy and outer._ready is not None:
+                            rdy = bool(outer._ready())
+                        self._send(200 if rdy else 503,
+                                   "application/json",
+                                   json.dumps({"ready": rdy, **roll})
+                                   .encode())
+                    elif path == "/alertz":
+                        body = outer._alertz_body()
+                        self._send(200, "application/json",
+                                   json.dumps(body, default=str)
                                    .encode())
                     elif path == "/statusz":
                         st = outer._statusz() if outer._statusz else {}
@@ -560,6 +665,28 @@ class MetricsServer:
             target=self._httpd.serve_forever, name="metrics-http",
             daemon=True)
         self._thread.start()
+
+    def _health_rollup(self) -> dict:
+        """status + reason codes for /healthz and /readyz: the injected
+        callback, or the process-wide health engine's roll-up (lazy
+        import — health builds on this module)."""
+        try:
+            if self._health is not None:
+                return self._health()
+            from . import health as _health
+            return _health.rollup()
+        except Exception:  # noqa: BLE001 — a health bug must not make
+            # the liveness probe lie about the process being alive
+            return {"status": "ok", "reasons": []}
+
+    def _alertz_body(self) -> dict:
+        try:
+            if self._alertz is not None:
+                return self._alertz()
+            from . import health as _health
+            return _health.alertz_dict()
+        except Exception as e:  # noqa: BLE001
+            return {"status": "ok", "error": f"{type(e).__name__}: {e}"}
 
     def stop(self) -> None:
         self._httpd.shutdown()

@@ -347,6 +347,21 @@ def open_span(tracer: Tracer, name: str,
                 attrs=attrs or None)
 
 
+def record_instant(tracer: Tracer, name: str, **attrs: Any) -> None:
+    """Record a zero-duration span directly into a tracer's flight
+    recorder — point-in-time facts with no span of their own (alert
+    firing/resolving transitions from util/health.py land here, so a
+    post-incident `tracer.recent()` shows the judgment next to the
+    work).  Attaches under the current trace context when one is
+    active; otherwise records as a standalone root."""
+    if not _ENABLED:
+        return
+    cur = _CURRENT.get()
+    span = open_span(tracer, name, parent=cur[1] if cur else None,
+                     **attrs)
+    close_span(tracer, span)
+
+
 def close_span(tracer: Tracer, span: Optional[Span],
                status: Optional[str] = None) -> None:
     if span is None:

@@ -390,6 +390,82 @@ def test_contract_fixture_codes(tmp_path):
     assert by_code.get("SC307"), "missing RPC_CONTRACTS must be flagged"
 
 
+def _alert_repo(tmp_path, doc_rules=("rule_a", "rule_b"),
+                code_rules=("rule_a", "rule_b"),
+                cfg_keys=("enabled", "rules"),
+                schema_keys=("enabled", "rules"),
+                with_markers=True):
+    """Synthetic mini-repo for the SC308 alert-rule contract lints."""
+    _write(tmp_path, "setup.py", "# root marker\n")
+    rows = "\n".join(f"| `{n}` | warning | something |"
+                     for n in doc_rules)
+    table = (f"<!-- default-alert-rules:begin -->\n"
+             f"| Rule | Severity | Fires when |\n|---|---|---|\n"
+             f"{rows}\n<!-- default-alert-rules:end -->\n"
+             if with_markers else rows)
+    _write(tmp_path, "docs/observability.md", f"""
+        Default ruleset table:
+
+        {table}
+
+        The keys `enabled`, `rules` and `bogus` are documented so the
+        SC304 lint stays quiet in this fixture.
+    """)
+    rules = ",\n            ".join(
+        f'Rule(name="{n}", series="scanner_tpu_x")' for n in code_rules)
+    schema = ", ".join(f'"{k}"' for k in schema_keys)
+    _write(tmp_path, "pkg/util/health.py", f"""
+        def Rule(**kw):
+            return kw
+
+        CONFIG_KEYS = ({schema},)
+
+        DEFAULT_RULES = (
+            {rules},
+        )
+    """)
+    cfg = ", ".join(f'"{k}": 1' for k in cfg_keys)
+    _write(tmp_path, "pkg/config.py", f"""
+        def default_config():
+            return {{"alerts": {{{cfg}}}}}
+    """)
+    return tmp_path
+
+
+def test_alert_contract_clean_fixture_is_quiet(tmp_path):
+    _alert_repo(tmp_path)
+    _, findings = _analyze(tmp_path, "pkg")
+    assert [f for f in findings if f.code == "SC308"] == []
+
+
+def test_alert_contract_rule_names_both_directions(tmp_path):
+    _alert_repo(tmp_path, doc_rules=("rule_a", "rule_ghost"),
+                code_rules=("rule_a", "rule_undoc"))
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC308"]
+    assert any("rule_undoc" in m and "missing from" in m for m in msgs)
+    assert any("rule_ghost" in m and "no such rule" in m for m in msgs)
+    assert not any("`rule_a`" in m for m in msgs)
+
+
+def test_alert_contract_missing_marker_table(tmp_path):
+    _alert_repo(tmp_path, with_markers=False)
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC308"]
+    assert any("marker table" in m for m in msgs)
+
+
+def test_alert_contract_config_schema_both_directions(tmp_path):
+    _alert_repo(tmp_path, cfg_keys=("enabled", "rules", "bogus"),
+                schema_keys=("enabled", "rules", "interval"))
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC308"]
+    assert any("[alerts] bogus" in m and "does not accept" in m
+               for m in msgs)
+    assert any("`interval`" in m and "declares no" in m for m in msgs)
+    assert not any("enabled" in m for m in msgs)
+
+
 def test_contract_rpc_contracts_table_both_directions(tmp_path):
     _write(tmp_path, "setup.py", "# root\n")
     _write(tmp_path, "pkg/rpcmod.py", """

@@ -124,7 +124,8 @@ def _rate(cur: dict, prev: dict, key: str, now: float) -> float:
 
 # -- rendering --------------------------------------------------------------
 
-def render(status: dict, cur: dict, prev: dict, master: str) -> str:
+def render(status: dict, cur: dict, prev: dict, master: str,
+           health: dict = None) -> str:
     now = cur["t"]
     lines = [f"scanner-top  master={master}  "
              f"{time.strftime('%H:%M:%S', time.localtime(now))}"]
@@ -206,10 +207,33 @@ def render(status: dict, cur: dict, prev: dict, master: str) -> str:
                      f"{'BUSY s':>8} {'UTIL':>7} {'HBM MB':>9} "
                      f"{'HBM%':>6} {'LEDG MB':>9}")
         lines.extend(dev_rows)
+    # cluster health (GetHealth): the judgment layer — which rules fire
+    # where, so "is it healthy" doesn't require reading the counters
+    if health:
+        firing = health.get("firing") or []
+        if firing:
+            lines.append("")
+            lines.append(f"ALERTS ({health.get('status', '?')})")
+            for f in firing[:10]:
+                lbl = ",".join(
+                    f"{k}={v}" for k, v in
+                    sorted((f.get("labels") or {}).items()))
+                since = f.get("since")
+                age = f"{max(now - since, 0):.0f}s" if since else "-"
+                lines.append(
+                    f"  {str(f.get('node', '-')):10} "
+                    f"{f.get('rule', '?'):24} "
+                    f"{f.get('severity', '?'):8} {lbl:24} {age:>6}")
+            if len(firing) > 10:
+                lines.append(f"  ... and {len(firing) - 10} more")
+        elif health.get("status") == "ok":
+            lines.append("")
+            lines.append("health: ok (0 alerts firing)")
     return "\n".join(lines)
 
 
-def json_doc(status: dict, cur: dict, master: str) -> dict:
+def json_doc(status: dict, cur: dict, master: str,
+             health: dict = None) -> dict:
     """The --json document: everything --once renders, machine-readable
     (scripts used to scrape the human table).  Per-node counter totals
     since process start plus the per-device utilization/memory maps."""
@@ -242,7 +266,7 @@ def json_doc(status: dict, cur: dict, master: str) -> dict:
             },
         }
     return {"time": cur["t"], "master": master, "status": status,
-            "nodes": nodes}
+            "health": health, "nodes": nodes}
 
 
 # -- main -------------------------------------------------------------------
@@ -279,12 +303,16 @@ def main(argv=None) -> int:
             if status is not None and "error" in status \
                     and "tasks_done" not in status:
                 status = None
+            # cluster-wide health roll-up + firing alerts (GetHealth);
+            # best-effort like the status poll
+            health = client.try_call("GetHealth", retries=0)
             cur = digest(reply["snapshot"])
             if args.json:
                 import json as _json
-                print(_json.dumps(json_doc(status, cur, args.master)))
+                print(_json.dumps(json_doc(status, cur, args.master,
+                                           health)))
                 return 0
-            frame = render(status, cur, prev, args.master)
+            frame = render(status, cur, prev, args.master, health)
             if args.once:
                 print(frame)
                 return 0
