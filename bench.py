@@ -384,10 +384,53 @@ def main():
 
         # end-to-end per-task latency digest (enqueue -> sink-committed):
         # the serving-mode p50/p99 seed (ROADMAP item 2) banked per
-        # round so the latency trajectory ships with the fps one
+        # round so the latency trajectory ships with the fps one.
+        # Computed once; the baseline_metrics entry below reuses it so
+        # the two banked views can never disagree.
+        _tlq = hist_quantiles("scanner_tpu_task_latency_seconds")
+        detail.append({"config": "task_latency", **_tlq})
+        # compute-efficiency digest (util/coststats.py): the roofline
+        # table per (op, device, bucket) — achieved FLOP/s / bytes/s
+        # and the compute-vs-memory-bound verdict — plus the compile
+        # ledger summary with the persistent-cache hit rate.  The
+        # baseline instrument the ROADMAP perf items (pjit mesh, Pallas
+        # scan kernels, frame cache) are judged against.
+        from scanner_tpu.util import coststats as _coststats
+        _eff_ops = _coststats.op_efficiency()
+        _csum = _coststats.ledger_summary()
         detail.append({
-            "config": "task_latency",
-            **hist_quantiles("scanner_tpu_task_latency_seconds"),
+            "config": "op_efficiency",
+            "ops": _eff_ops,
+            "compile": _csum,
+        })
+        # stable per-direction baseline keys (ROADMAP "bank per-item
+        # baselines for the new directions"): one flat entry with a
+        # declared better= direction per metric, so
+        # tools/bench_history.py can gate the serving (task-latency
+        # p99), cache (compile-cache hit rate) and scan/kernel (per-op
+        # efficiency) directions from the first round that banks a
+        # baseline (bench_history.py --write-baselines).  The mean is
+        # WEIGHTED by measured seconds: an unweighted mean over
+        # whichever (op, device, bucket) rows a round happened to hit
+        # would swing on a rarely-run tail bucket's noisy sample and
+        # trip the gate with no real change.
+        _eff_w = sum(o["seconds"] for o in _eff_ops
+                     if o.get("efficiency") is not None)
+        _eff_mean = (round(sum(o["efficiency"] * o["seconds"]
+                               for o in _eff_ops
+                               if o.get("efficiency") is not None)
+                           / _eff_w, 6) if _eff_w else None)
+        detail.append({
+            "config": "baseline_metrics",
+            "metrics": {
+                "task_latency_p99_s": {
+                    "value": _tlq.get("p99_s"), "better": "lower"},
+                "op_efficiency_mean": {
+                    "value": _eff_mean, "better": "higher"},
+                "compile_cache_hit_rate": {
+                    "value": _csum.get("cache_hit_rate"),
+                    "better": "higher"},
+            },
         })
         # health digest (util/health.py): alert transitions fired during
         # this bench run plus the latency-quantile snapshot the SLO

@@ -28,6 +28,13 @@ passes make each one checkable:
          `default-alert-rules:begin/end` markers), and the `[alerts]`
          config section must declare exactly the keys
          health.CONFIG_KEYS accepts
+  SC309  cost-model / efficiency-series drift: every device (TPU)
+         kernel registered under `kernels/` must declare a `cost()`
+         descriptor hook (roofline attribution, util/coststats.py),
+         and coststats' EFFICIENCY_SERIES tuple, the series it
+         actually registers, and the marker-delimited efficiency table
+         in docs/observability.md (`efficiency-series:begin/end`) may
+         not drift — all three pairings, both directions
 """
 
 from __future__ import annotations
@@ -302,6 +309,8 @@ class ContractPass(AnalysisPass):
         "SC306": "RPC method drift (called vs registered)",
         "SC307": "RPC handler missing RPC_CONTRACTS classification",
         "SC308": "alert-rule drift (DEFAULT_RULES vs docs vs [alerts])",
+        "SC309": "cost-model / efficiency-series drift (kernel cost "
+                 "hooks, EFFICIENCY_SERIES, docs efficiency table)",
     }
 
     def run(self, project: Project) -> List[Finding]:
@@ -312,6 +321,7 @@ class ContractPass(AnalysisPass):
         out.extend(self._fault_sites(project))
         out.extend(self._rpc_surface(project))
         out.extend(self._alert_rules(project))
+        out.extend(self._cost_model(project))
         return out
 
     # -- SC301 / SC302 ---------------------------------------------------
@@ -619,6 +629,117 @@ class ContractPass(AnalysisPass):
                         f"health.CONFIG_KEYS accepts `{k}` but "
                         "config.default_config() declares no "
                         f"`[alerts] {k}`", hmod.tree))
+        return out
+
+    # -- SC309 -----------------------------------------------------------
+
+    _EFF_DOC_BLOCK_RE = re.compile(
+        r"<!--\s*efficiency-series:begin\s*-->(.*?)"
+        r"<!--\s*efficiency-series:end\s*-->", re.S)
+
+    @staticmethod
+    def _tpu_kernel_classes(mod: ModuleInfo
+                            ) -> List[Tuple[str, bool, ast.AST]]:
+        """(class name, has_cost, node) for every class registered as a
+        TPU device op via a @register_op(device=DeviceType.TPU, ...)
+        decorator."""
+        out: List[Tuple[str, bool, ast.AST]] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            is_tpu = False
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                if (dotted_name(dec.func) or "").split(".")[-1] \
+                        != "register_op":
+                    continue
+                for kw in dec.keywords:
+                    if kw.arg == "device" and (
+                            dotted_name(kw.value) or "").endswith("TPU"):
+                        is_tpu = True
+            if is_tpu:
+                has_cost = any(
+                    isinstance(b, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and b.name == "cost" for b in node.body)
+                out.append((node.name, has_cost, node))
+        return out
+
+    def _cost_model(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        # direction 1: every TPU device kernel in kernels/ declares its
+        # analytical cost() hook — the roofline join otherwise degrades
+        # to derived defaults silently, and a new stdlib op would ship
+        # without an efficiency story
+        for mod in project.modules:
+            if "kernels/" not in mod.relpath:
+                continue
+            for name, has_cost, node in self._tpu_kernel_classes(mod):
+                if not has_cost:
+                    out.append(mod.finding(
+                        "SC309",
+                        f"TPU device kernel `{name}` declares no "
+                        "cost() descriptor hook — roofline attribution "
+                        "(util/coststats.py) falls back to derived "
+                        "defaults; declare FLOPs/bytes as f(shape) or "
+                        "justify the fallback", node))
+        # directions 2+3: EFFICIENCY_SERIES <-> the series coststats
+        # actually registers <-> the marker-delimited efficiency table
+        # in docs/observability.md, both ways each
+        cmod = project.module("util/coststats.py")
+        if cmod is None:
+            return out
+        declared = _module_tuple(cmod, "EFFICIENCY_SERIES")
+        if declared is None:
+            return out
+        declared_set = set(declared)
+        registered = {r.name for r in _metric_registrations(cmod)
+                      if r.name}
+        for name in sorted(registered - declared_set):
+            out.append(cmod.finding(
+                "SC309",
+                f"series `{name}` is registered in coststats but "
+                "missing from EFFICIENCY_SERIES — the SC309 catalog "
+                "contract cannot see it", cmod.tree))
+        for name in sorted(declared_set - registered):
+            out.append(cmod.finding(
+                "SC309",
+                f"EFFICIENCY_SERIES names `{name}` but coststats "
+                "registers no such series", cmod.tree))
+        doc = _read_doc(project, "observability.md")
+        if not doc:
+            return out
+        block = self._EFF_DOC_BLOCK_RE.search(doc)
+        if block is None:
+            out.append(cmod.finding(
+                "SC309",
+                "coststats declares EFFICIENCY_SERIES but docs/"
+                "observability.md has no efficiency-series marker "
+                "table (<!-- efficiency-series:begin/end -->)",
+                cmod.tree))
+            return out
+        doc_names = {n for n in _SERIES_RE.findall(block.group(1))}
+        base_doc = set()
+        for n in doc_names:
+            for suf in _EXPOSITION_SUFFIXES:
+                if n.endswith(suf) and n[:-len(suf)] in doc_names:
+                    break
+            else:
+                base_doc.add(n)
+        for name in sorted(declared_set - base_doc):
+            out.append(cmod.finding(
+                "SC309",
+                f"efficiency series `{name}` is missing from the "
+                "docs/observability.md efficiency-series table",
+                cmod.tree))
+        for name in sorted(base_doc - declared_set):
+            out.append(Finding(
+                code="SC309",
+                message=f"docs/observability.md efficiency-series "
+                        f"table lists `{name}` but coststats' "
+                        "EFFICIENCY_SERIES has no such series",
+                path="docs/observability.md", line=1, scope="",
+                snippet=name))
         return out
 
     # -- SC306 / SC307 ---------------------------------------------------

@@ -168,6 +168,7 @@ class Client:
         self._metrics_server = None
         if metrics_port is not None:
             from ..util.metrics import MetricsServer
+            from ..util import coststats as _coststats
             from ..util import health as _health_st
             from ..util import memstats as _memstats
             self._metrics_server = MetricsServer(
@@ -177,7 +178,9 @@ class Client:
                                  "db": getattr(self._db.backend, "root",
                                                None),
                                  "health": _health_st.status_dict(),
-                                 "memory": _memstats.status_dict()},
+                                 "memory": _memstats.status_dict(),
+                                 "efficiency":
+                                     _coststats.status_dict()},
                 healthz=lambda: {"role": "client"})
 
         self.ops = O.OpGenerator()
@@ -264,6 +267,21 @@ class Client:
         last = memstats.last_report()
         return {"memory": memstats.status_dict(),
                 "reports": [last] if last else []}
+
+    def compile_report(self) -> Dict[str, Any]:
+        """Compute-efficiency report (docs/observability.md
+        §Efficiency & Compilation).  Cluster mode: the master's
+        GetCompileLedger view — per node, the bounded XLA compile
+        ledger (op, device, bucket, compile seconds, persistent-cache
+        hit|miss|uncached, executable size, analytical cost), its
+        summary with the cache hit rate, and the per-(op, device,
+        bucket) roofline table (achieved FLOP/s, achieved bytes/s,
+        compute-vs-memory bound, EFF%).  Local mode: this process's
+        view in the same shape under nodes["client"]."""
+        if self._cluster is not None:
+            return self._cluster.compile_report()
+        from ..util import coststats
+        return {"nodes": {"client": coststats.compile_report()}}
 
     def shutdown_cluster(self, workers: bool = True) -> int:
         """Remotely stop the cluster this client is attached to: the

@@ -40,6 +40,7 @@ from ..common import (DeviceType, GraphException, JobException, NullElement,
                       ScannerException, SliceList)
 from ..graph import analysis as A
 from ..graph import ops as O
+from ..util import coststats as _cs
 from ..util import memstats as _ms
 from ..util import metrics as _mx
 from ..util import tracing as _tracing
@@ -423,7 +424,14 @@ class KernelInstance:
                         staged.append(a)
                     args = staged
                 try:
-                    self.kernel.execute(*args)
+                    # compile ledger: the warm-up compile of this
+                    # ladder rung is attributed to (op, device, bucket)
+                    # — with the persistent cache configured, a warmed
+                    # restart records it as a `hit`
+                    with _cs.observe_compiles(self.node.name,
+                                              self.dev_label, b,
+                                              f"warmup:b{b}"):
+                        self.kernel.execute(*args)
                 except Exception:  # noqa: BLE001 — warm-up is best-effort
                     _log.debug("precompile of %s at batch %d failed",
                                self.node.name, b, exc_info=True)
@@ -822,6 +830,13 @@ class TaskEvaluator:
             return args
 
         ki.ensure_warm()
+        # roofline attribution (util/coststats.py): device-kernel calls
+        # join their analytical cost descriptor with measured seconds;
+        # accumulated per op run so ONE op.efficiency event lands on the
+        # op's trace span (per-chunk detail goes to the gauges)
+        track_cost = _cs.enabled() and batched_call \
+            and n.effective_device() == DeviceType.TPU
+        run_secs = run_flops = run_bytes = 0.0
         t0 = time.time()
         try:
             with self.profiler.span("evaluate:" + n.name,
@@ -868,7 +883,8 @@ class TaskEvaluator:
                                 (tuple(a.shape), str(a.dtype))
                                 if is_array_data(a) else len(a)
                                 for a in args)
-                            if sig not in ki._shape_sigs:
+                            new_sig = sig not in ki._shape_sigs
+                            if new_sig:
                                 ki._shape_sigs.add(sig)
                                 _M_OP_RECOMPILES.labels(
                                     op=n.name,
@@ -879,7 +895,43 @@ class TaskEvaluator:
                                 _tracing.add_event(
                                     "xla.recompile", op=n.name,
                                     device=ki.dev_label)
-                            res = ki.kernel.execute(*args)
+                            t_call = time.time()
+                            if new_sig and track_cost:
+                                # first call of a fresh signature: any
+                                # XLA compile inside lands in the
+                                # compile ledger under this (op,
+                                # device, bucket)
+                                with _cs.observe_compiles(
+                                        n.name, ki.dev_label,
+                                        len(exec_sel), repr(sig[1:])):
+                                    res = ki.kernel.execute(*args)
+                                # drain this unmeasured call's queued
+                                # device work so the NEXT (measured)
+                                # call times only itself
+                                res = _cs.block_until_ready(res)
+                            else:
+                                res = ki.kernel.execute(*args)
+                            if track_cost and not new_sig:
+                                # measured call seconds joined with the
+                                # analytical descriptor; first calls of
+                                # a signature are excluded so compile
+                                # time never reads as inefficiency.
+                                # Block on the result first: async
+                                # dispatch would otherwise time the
+                                # enqueue, not the op
+                                res = _cs.block_until_ready(res)
+                                call_s = time.time() - t_call
+                                desc = _cs.descriptor_for(
+                                    ki.kernel, n.name, ki.dev_label,
+                                    len(exec_sel), args)
+                                _cs.record_op_call(
+                                    n.name, ki.dev_label,
+                                    len(exec_sel), len(live), call_s,
+                                    desc)
+                                if desc is not None:
+                                    run_secs += call_s
+                                    run_flops += desc.flops or 0.0
+                                    run_bytes += desc.bytes_total
                             if pad:
                                 res = _strip_pad(res, len(live),
                                                  len(out_cols))
@@ -895,6 +947,20 @@ class TaskEvaluator:
                             res = ki.kernel.execute(*row_args)
                             emit_result(compute[live], _single(res, n, out_cols))
                         i = j
+                if run_secs > 0:
+                    cls = _cs.classify(ki.dev_label, run_flops or None,
+                                       run_bytes, run_secs)
+                    if cls is not None:
+                        # straggler attribution: the op span carries
+                        # its own roofline verdict, so a slow
+                        # evaluate:<op> stage reads as INEFFICIENT
+                        # (low eff) vs OVERLOADED (high eff, deep
+                        # queues) in the master's analytics
+                        _tracing.add_event(
+                            "op.efficiency", op=n.name,
+                            device=ki.dev_label,
+                            eff=round(cls["eff"], 6),
+                            bound=cls["bound"])
         except BaseException as e:
             # the kernel died mid-run: its internal state is partial and
             # _last_row may already claim the run's end.  Reset both so a

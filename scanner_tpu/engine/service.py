@@ -32,6 +32,7 @@ import cloudpickle
 from ..common import CacheMode, JobException, PerfParams, ScannerException
 from ..storage import Database, make_storage
 from ..storage import metadata as md
+from ..util import coststats as _coststats
 from ..util import faults as _faults
 from ..util import health as _health
 from ..util import memstats as _memstats
@@ -93,6 +94,7 @@ RPC_CONTRACTS = {
     "GetTrace":         {"timeout_s": 30.0, "idempotent": True},
     "ShipMemoryReport": {"timeout_s": 30.0, "idempotent": False},
     "GetMemoryReport":  {"timeout_s": 30.0, "idempotent": True},
+    "GetCompileLedger": {"timeout_s": 30.0, "idempotent": True},
     "Shutdown":         {"timeout_s": PING_TIMEOUT, "idempotent": True},
 }
 
@@ -276,6 +278,11 @@ class _BulkJob:
     spans: List[dict] = field(default_factory=list)
     span_drops: int = 0
     span_stats: Dict[str, List[float]] = field(default_factory=dict)
+    # per-op roofline aggregates from op.efficiency span events
+    # ([eff_sum, n, memory_bound_n] per evaluate:<op> span name) — the
+    # straggler summary joins them so a slow stage is attributable to
+    # *inefficient* (low eff) vs *overloaded* (high eff, long queue)
+    eff_stats: Dict[str, List[float]] = field(default_factory=dict)
     slowest: List[Tuple] = field(default_factory=list)
     slow_seq: int = 0
     # live-status bookkeeping: output rows per task (from the admission
@@ -435,6 +442,7 @@ class Master:
             "GetTrace": self._rpc_get_trace,
             "ShipMemoryReport": self._rpc_ship_memory_report,
             "GetMemoryReport": self._rpc_get_memory_report,
+            "GetCompileLedger": self._rpc_get_compile_ledger,
             "Shutdown": self._rpc_shutdown,
         }, port=port, tracer=self.tracer)
         self.port = self._server.port
@@ -924,7 +932,11 @@ class Master:
                 # how many worker OOM reports are held for
                 # GetMemoryReport
                 "memory": dict(_memstats.status_dict(),
-                               worker_reports=mem_reports)}
+                               worker_reports=mem_reports),
+                # the Efficiency panel: roofline table + compile-ledger
+                # summary (util/coststats.py; a bare master usually has
+                # none — workers carry the kernel calls)
+                "efficiency": _coststats.status_dict()}
 
     def _rpc_get_metrics(self, req: dict) -> dict:
         """Cluster-wide metrics: this process's snapshot plus every live
@@ -988,6 +1000,35 @@ class Master:
                         nodes[f"worker{wid}"] = reply["health"]
         return _health.merge_status(nodes)
 
+    def _rpc_get_compile_ledger(self, req: dict) -> dict:
+        """Cluster-wide compile ledger + roofline table: this process's
+        compile report plus every live worker's (GetCompileLedger
+        dialed at each worker's advertised address — the same
+        diagnostic pull plane as GetMetrics/GetHealth).
+        Client.compile_report() and tools/scanner_cost.py read this."""
+        from concurrent import futures as _fut
+
+        with self._lock:
+            targets = [(w.worker_id, w.address)
+                       for w in self._workers.values()
+                       if w.active and w.address]
+        nodes: Dict[str, dict] = {"master": _coststats.compile_report()}
+
+        def pull(wid: int, addr: str):
+            c = rpc.RpcClient(addr, WORKER_SERVICE, timeout=2.0)
+            try:
+                return wid, c.try_call("GetCompileLedger", retries=0)
+            finally:
+                c.close()
+
+        if targets and req.get("workers", True):
+            with _fut.ThreadPoolExecutor(
+                    max_workers=min(16, len(targets))) as pool:
+                for wid, reply in pool.map(lambda t: pull(*t), targets):
+                    if reply and "report" in reply:
+                        nodes[f"worker{wid}"] = reply["report"]
+        return {"nodes": nodes}
+
     def _rpc_poke(self, req: dict) -> dict:
         self._last_poke = time.time()
         return {"ok": True}
@@ -1044,6 +1085,11 @@ class Master:
             st[0] += 1
             st[1] += dur
             st[2] = max(st[2], dur)
+        # roofline verdicts ride on the op spans (engine/evaluate.py
+        # op.efficiency events); fold them into tiny aggregates so
+        # stragglers answer "inefficient or overloaded" per op (the
+        # shared fold — tracing.straggler_summary uses the same one)
+        _tracing.fold_op_efficiency(d, bulk.eff_stats)
         if name == "task":
             a = d.get("attrs") or {}
             bulk.slow_seq += 1
@@ -1080,6 +1126,10 @@ class Master:
             per[name] = {"count": int(c), "total_s": round(tot, 4),
                          "max_s": round(mx, 4),
                          "mean_s": round(tot / c, 4) if c else 0.0}
+            # the efficiency join: a slow op at high eff is overloaded
+            # (scale it), at low eff inefficient (fix it)
+            per[name].update(_tracing.op_efficiency_summary(
+                bulk.eff_stats.get(name)))
         slow = [{"job": j, "task": t, "seconds": round(dur, 4),
                  "node": node, "trace_id": bulk.trace_id,
                  "span_id": sid}
@@ -1622,6 +1672,10 @@ class Worker:
             # serves the master's cluster-wide health aggregation
             # (GetHealth fan-in -> Client.health())
             "GetHealth": lambda req: {"health": _health.status_dict()},
+            # serves the master's compile-ledger/roofline aggregation
+            # (GetCompileLedger fan-in -> Client.compile_report())
+            "GetCompileLedger": lambda req: {
+                "report": _coststats.compile_report()},
             "Shutdown": self._rpc_shutdown,
         }, port=port, tracer=self.tracer)
         self.port = self._server.port
@@ -1764,6 +1818,8 @@ class Worker:
             "health": _health.status_dict(),
             # the Memory panel: per-device HBM + allocation-ledger view
             "memory": _memstats.status_dict(),
+            # the Efficiency panel: per-op roofline + compile ledger
+            "efficiency": _coststats.status_dict(),
         }
 
     # ------------------------------------------------------------------
@@ -2152,6 +2208,12 @@ class ClusterClient:
         """Cluster memory forensics (GetMemoryReport RPC): the master's
         live HBM/ledger view plus every OOM report workers shipped."""
         return self.master.call("GetMemoryReport")
+
+    def compile_report(self) -> dict:
+        """Cluster compile ledger + roofline table (GetCompileLedger
+        RPC): per-node XLA compile entries with cache hit/miss labels
+        and the per-(op, device, bucket) efficiency table."""
+        return self.master.call("GetCompileLedger", timeout=30.0)
 
     def ship_spans(self, bulk_id: int, spans: List[dict]) -> None:
         """Contribute client-side spans (the job's root) to the

@@ -65,6 +65,19 @@ class Kernel:
         are discarded."""
         return None
 
+    def cost(self, shapes):
+        """Optional analytical cost descriptor for ONE execute() call
+        (the roofline-attribution hook, util/coststats.py): `shapes`
+        holds one entry per positional input — the array shape tuple
+        for array inputs, the element count for per-row lists.  Return
+        a `coststats.CostDescriptor` (or a dict with `flops` /
+        `bytes_in` / `bytes_out` keys), or None to fall back to the
+        derived default (XLA's cost analysis of the compiled
+        executable, else observed argument bytes).  Device kernels in
+        the stdlib implement this; scanner-check SC309 enforces it for
+        `kernels/` TPU ops."""
+        return None
+
     def close(self) -> None:
         pass
 

@@ -24,8 +24,18 @@ import numpy as np
 
 from ..common import DeviceType, FrameType
 from ..graph.ops import Kernel, register_op
+from ..util.coststats import CostDescriptor
 
 HISTOGRAM_BINS = 16
+
+
+def _frame_shape(shapes, idx: int = 0):
+    """The idx-th input's array shape, or None when the engine handed a
+    per-row list (host path) — cost hooks then fall back to the derived
+    default rather than guess."""
+    if idx < len(shapes) and isinstance(shapes[idx], tuple):
+        return shapes[idx]
+    return None
 
 
 @functools.partial(jax.jit, static_argnames=("bins",))
@@ -97,6 +107,20 @@ class Histogram(Kernel):
                                                                       bins)
         return out
 
+    def cost(self, shapes):
+        """Compare+reduce histogram: per pixel-channel, one fixed-point
+        binning (2 ops) plus `bins` compares and `bins` accumulates.
+        Reads the uint8 frames once, writes (b, C, bins) int32."""
+        s = _frame_shape(shapes)
+        if s is None or len(s) != 4:
+            return None
+        b, h, w, c = s
+        px = b * h * w * c
+        return CostDescriptor(
+            flops=float(px * (HISTOGRAM_BINS + 2)),
+            bytes_in=float(px),
+            bytes_out=float(b * c * HISTOGRAM_BINS * 4))
+
     def execute(self, frame: Sequence[FrameType]) -> Sequence[Any]:
         """Returns the (batch, C, bins) int32 counts as ONE batch array.
 
@@ -142,6 +166,19 @@ class Resize(Kernel):
             self.width = int(width)
         if height is not None:
             self.height = int(height)
+
+    def cost(self, shapes):
+        """Separable bilinear resample: 4 taps (mul+add) per output
+        pixel-channel = 8 flops.  Reads the source frames, writes the
+        (b, H, W, c) uint8 result."""
+        s = _frame_shape(shapes)
+        if s is None or len(s) != 4 or not (self.height and self.width):
+            return None
+        b, h, w, c = s
+        out_px = b * self.height * self.width * c
+        return CostDescriptor(flops=float(out_px * 8),
+                              bytes_in=float(b * h * w * c),
+                              bytes_out=float(out_px))
 
     def execute(self, frame: Sequence[FrameType]) -> Sequence[FrameType]:
         # device in -> device out: chained TPU ops never bounce to host
@@ -194,6 +231,20 @@ class CropResize(Kernel):
             return np.asarray([0.0, 0.0, 1.0, 1.0], np.float32)
         return None
 
+    def cost(self, shapes):
+        """Crop + bilinear resample to (height, width): like Resize, 4
+        taps (mul+add) per output pixel-channel = 8 flops; the per-box
+        scale/translate arithmetic is O(b) and ignored.  Reads the
+        frames and the (b, 4) float32 boxes, writes the crops."""
+        s = _frame_shape(shapes)
+        if s is None or len(s) != 4:
+            return None
+        b, h, w, c = s
+        out_px = b * self.height * self.width * c
+        return CostDescriptor(flops=float(out_px * 8),
+                              bytes_in=float(b * h * w * c + b * 4 * 4),
+                              bytes_out=float(out_px))
+
     def execute(self, frame: Sequence[FrameType],
                 box: Sequence[Any]) -> Sequence[FrameType]:
         boxes = jnp.asarray(np.stack([np.asarray(b, np.float32)
@@ -233,6 +284,18 @@ class Blur(Kernel):
         self.ksize = int(kernel_size) | 1  # odd
         self.kern = jnp.asarray(_gaussian_kernel1d(self.ksize, float(sigma)))
 
+    def cost(self, shapes):
+        """Separable gaussian: two 1-D passes of `ksize` taps each —
+        2 * ksize * 2 flops per pixel-channel.  uint8 in, uint8 out,
+        same geometry."""
+        s = _frame_shape(shapes)
+        if s is None or len(s) != 4:
+            return None
+        b, h, w, c = s
+        px = b * h * w * c
+        return CostDescriptor(flops=float(px * 4 * self.ksize),
+                              bytes_in=float(px), bytes_out=float(px))
+
     def execute(self, frame: Sequence[FrameType]) -> Sequence[FrameType]:
         # device in -> device out: chained TPU ops never bounce to host
         return _blur_impl(jnp.asarray(frame), self.kern, self.ksize)
@@ -244,8 +307,11 @@ def _grayscale(frames: jnp.ndarray) -> jnp.ndarray:
     return (frames.astype(jnp.float32) * w).sum(-1)
 
 
+HS_ITERS = 16  # fixed Horn-Schunck iteration count (cost model reads it)
+
+
 @functools.partial(jax.jit, static_argnames=("iters",))
-def _horn_schunck(prev: jnp.ndarray, nxt: jnp.ndarray, iters: int = 16,
+def _horn_schunck(prev: jnp.ndarray, nxt: jnp.ndarray, iters: int = HS_ITERS,
                   alpha: float = 15.0):
     """Classic Horn-Schunck optical flow, batched; (b,h,w) grayscale in,
     (b,h,w,2) float32 flow out.  Fixed-iteration lax.scan keeps the whole
@@ -283,6 +349,22 @@ class OpticalFlow(Kernel):
     """Dense optical flow between consecutive frames (reference scannertools
     OpticalFlow / test_ops.cpp:63, StenciledKernel).  Output per row:
     float32 (H, W, 2) flow from the previous frame to the current."""
+
+    def cost(self, shapes):
+        """Horn-Schunck: grayscale both frames (~5 flops/px each),
+        gradients (~6/px), then HS_ITERS solver iterations of two 3x3
+        averaging convs (36 flops/px) plus ~12 arithmetic ops/px.
+        Reads the (b, 2, H, W, C) uint8 stencil window, writes
+        (b, H, W, 2) float32 flow."""
+        s = _frame_shape(shapes)
+        if s is None or len(s) != 5:
+            return None
+        b, win, h, w, c = s
+        px = b * h * w
+        flops = px * (win * 5 + 6 + HS_ITERS * (36 + 12))
+        return CostDescriptor(flops=float(flops),
+                              bytes_in=float(b * win * h * w * c),
+                              bytes_out=float(px * 2 * 4))
 
     def execute(self, frame: Sequence[Sequence[FrameType]]
                 ) -> Sequence[FrameType]:

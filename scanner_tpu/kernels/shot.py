@@ -17,7 +17,8 @@ import numpy as np
 
 from ..common import DeviceType, FrameType
 from ..graph.ops import Kernel, register_op
-from .imgproc import _histogram_impl
+from ..util.coststats import CostDescriptor
+from .imgproc import HISTOGRAM_BINS, _frame_shape, _histogram_impl
 
 
 @register_op(device=DeviceType.TPU, stencil=[-1, 0], batch=16)
@@ -29,6 +30,20 @@ class HistDiff(Kernel):
     is Histogram -> HistogramDelta: the engine's stencil element cache
     reuses each histogram, and the stencil data shrinks from full frames to
     3x16 ints."""
+
+    def cost(self, shapes):
+        """Two histograms over the (b, 2, H, W, C) stencil window
+        (bins+2 flops per pixel-channel each, the Histogram model) plus
+        the per-row L1 over 2 * C * bins histogram cells.  Reads the
+        uint8 window, emits one float per row."""
+        s = _frame_shape(shapes)
+        if s is None or len(s) != 5:
+            return None
+        b, win, h, w, c = s
+        px = b * win * h * w * c
+        flops = px * (HISTOGRAM_BINS + 2) + b * 2 * c * HISTOGRAM_BINS
+        return CostDescriptor(flops=float(flops), bytes_in=float(px),
+                              bytes_out=float(b * 8))
 
     def execute(self, frame: Sequence[Sequence[FrameType]]
                 ) -> Sequence[Any]:

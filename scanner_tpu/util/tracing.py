@@ -490,6 +490,39 @@ def write_chrome_trace(span_dicts: Iterable[dict], path: str,
     return path
 
 
+def fold_op_efficiency(span_dict: dict,
+                       acc: Dict[str, List[float]]) -> None:
+    """Fold one span's `op.efficiency` events (the roofline verdicts
+    engine/evaluate.py stamps on evaluate:<op> spans) into the shared
+    [eff_sum, n, memory_bound_n] aggregate — used both by the master's
+    incremental per-bulk folding (engine/service.py) and the full-dump
+    path below, so the two consumers cannot drift."""
+    name = span_dict.get("name", "")
+    if not isinstance(name, str) or not name.startswith("evaluate:"):
+        return
+    for ev in span_dict.get("events", ()):
+        if ev.get("name") != "op.efficiency":
+            continue
+        a = ev.get("attrs") or {}
+        try:
+            eff = float(a.get("eff") or 0.0)
+        except (TypeError, ValueError):
+            continue
+        es = acc.setdefault(name, [0.0, 0, 0])
+        es[0] += eff
+        es[1] += 1
+        if a.get("bound") == "memory":
+            es[2] += 1
+
+
+def op_efficiency_summary(es: Optional[List[float]]) -> Dict[str, float]:
+    """One aggregate's reporting shape ({} when nothing was folded)."""
+    if not es or not es[1]:
+        return {}
+    return {"eff_mean": round(es[0] / es[1], 4),
+            "memory_bound_frac": round(es[2] / es[1], 4)}
+
+
 def straggler_summary(span_dicts: Iterable[dict],
                       top_n: int = 10) -> Dict[str, Any]:
     """Per-span-name duration stats + the top-N slowest task spans (with
@@ -498,11 +531,16 @@ def straggler_summary(span_dicts: Iterable[dict],
     shape incrementally (engine/service.py) for GetJobStatus//statusz."""
     per: Dict[str, List[float]] = {}
     tasks: List[Tuple[float, dict]] = []
+    # roofline verdicts from op.efficiency events on evaluate:<op>
+    # spans — the same fold the master maintains incrementally
+    # (engine/service.py uses these exact helpers)
+    eff: Dict[str, List[float]] = {}
     for d in span_dicts:
         dur = max(d.get("end", 0.0) - d.get("start", 0.0), 0.0)
         per.setdefault(d["name"], []).append(dur)
         if d["name"] == "task":
             tasks.append((dur, d))
+        fold_op_efficiency(d, eff)
     tasks.sort(key=lambda x: -x[0])
     out_stages = {}
     for name, durs in sorted(per.items()):
@@ -510,6 +548,7 @@ def straggler_summary(span_dicts: Iterable[dict],
             "count": len(durs), "total_s": round(sum(durs), 4),
             "max_s": round(max(durs), 4),
             "mean_s": round(sum(durs) / len(durs), 4)}
+        out_stages[name].update(op_efficiency_summary(eff.get(name)))
     slowest = []
     for dur, d in tasks[:top_n]:
         a = d.get("attrs") or {}

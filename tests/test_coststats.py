@@ -1,0 +1,506 @@
+"""Compute-efficiency observability (util/coststats.py + wiring).
+
+Covers the analytical cost-model exactness for stock kernels, roofline
+classification math against synthetic device peaks, the XLA compile
+ledger (observation, ring bounds, persistent-cache hit/miss labels),
+the GetCompileLedger RPC round-trip + scanner_top/statusz surfaces,
+and the acceptance e2e: the golden pipeline's ladder warm-up produces
+one ledger entry per (op, device, bucket) with nonzero compile seconds
+on a virtual multi-device host.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+from scanner_tpu import (CacheMode, Client, NamedStream, NamedVideoStream,
+                         PerfParams)
+import scanner_tpu.kernels  # noqa: F401  (registers the stdlib ops)
+from scanner_tpu.common import DeviceType
+from scanner_tpu.engine.evaluate import bucket_ladder
+from scanner_tpu.graph.ops import KernelConfig, registry
+from scanner_tpu.util import coststats as cs
+from scanner_tpu.util import metrics as _mx
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+RUNNER = os.path.join(HERE, "coststats_runner.py")
+
+
+def _kernel(name, **kw):
+    import scanner_tpu.kernels  # noqa: F401
+    cfg = KernelConfig(device=DeviceType.CPU)
+    return registry.get(name).kernel_factory(cfg, **kw)
+
+
+# ---------------------------------------------------------------------------
+# analytical-cost exactness (the cost-model contract)
+# ---------------------------------------------------------------------------
+
+def test_histogram_cost_exact():
+    k = _kernel("Histogram")
+    d = k.cost([(8, 48, 64, 3)])
+    px = 8 * 48 * 64 * 3
+    assert d.bytes_in == px                      # uint8 frames, read once
+    assert d.bytes_out == 8 * 3 * 16 * 4         # (b, C, bins) int32
+    assert d.flops == px * (16 + 2)              # bins compares+adds + bin
+    assert d.source == "hook"
+    # per-row list input (host path): no analytical model, fall back
+    assert k.cost([5]) is None
+
+
+def test_crop_resize_cost_exact():
+    k = _kernel("CropResize", size=32)
+    d = k.cost([(4, 48, 64, 3), 4])
+    out_px = 4 * 32 * 32 * 3
+    assert d.flops == out_px * 8                 # 4 bilinear taps mul+add
+    assert d.bytes_in == 4 * 48 * 64 * 3 + 4 * 16
+    assert d.bytes_out == out_px
+
+
+def test_blur_and_histdiff_cost_exact():
+    k = _kernel("Blur", kernel_size=3)
+    d = k.cost([(2, 16, 16, 3)])
+    px = 2 * 16 * 16 * 3
+    assert d.flops == px * 4 * 3                 # 2 separable passes
+    assert d.bytes_in == px and d.bytes_out == px
+
+    hd = _kernel("HistDiff")
+    d2 = hd.cost([(2, 2, 8, 8, 3)])
+    win_px = 2 * 2 * 8 * 8 * 3
+    assert d2.flops == win_px * (16 + 2) + 2 * 2 * 3 * 16
+    assert d2.bytes_in == win_px
+    assert d2.bytes_out == 2 * 8
+
+
+def test_optical_flow_cost_scales_with_window():
+    k = _kernel("OpticalFlow")
+    d = k.cost([(2, 2, 16, 16, 3)])
+    from scanner_tpu.kernels.imgproc import HS_ITERS
+    px = 2 * 16 * 16
+    assert d.flops == px * (2 * 5 + 6 + HS_ITERS * 48)
+    assert d.bytes_in == 2 * 2 * 16 * 16 * 3
+    assert d.bytes_out == px * 2 * 4
+
+
+# ---------------------------------------------------------------------------
+# roofline classification
+# ---------------------------------------------------------------------------
+
+def test_classify_compute_vs_memory_bound():
+    # synthetic roofline: ridge point at 100 FLOPs/byte
+    cs.set_device_peaks("unit:rx", 1e12, 1e10)
+    hot = cs.classify("unit:rx", flops=1e9, bytes_total=1e6, seconds=0.01)
+    assert hot["bound"] == "compute"
+    assert hot["flops_per_s"] == pytest.approx(1e11)
+    assert hot["eff"] == pytest.approx(0.1)
+    cold = cs.classify("unit:rx", flops=1e6, bytes_total=1e6,
+                       seconds=0.001)
+    assert cold["bound"] == "memory"
+    assert cold["eff"] == pytest.approx(1e9 / 1e10)
+    # FLOPs unknown -> memory-bound by definition (bandwidth roofline)
+    bw = cs.classify("unit:rx", flops=None, bytes_total=1e6, seconds=0.01)
+    assert bw["bound"] == "memory"
+    assert cs.classify("unit:rx", None, 0.0, 0.01) is None
+    assert cs.classify("unit:rx", 1e6, 1e6, 0.0) is None
+
+
+def test_record_op_call_updates_gauges_and_table():
+    cs.set_device_peaks("unit:rg", 1e12, 1e10)
+    desc = cs.CostDescriptor(flops=2e6, bytes_in=1e4, bytes_out=100)
+    r = cs.record_op_call("UnitOp", "unit:rg", 8, 8, 0.001, desc)
+    assert r is not None and r["bound"] == "compute"
+    rows = [o for o in cs.op_efficiency()
+            if o["op"] == "UnitOp" and o["device"] == "unit:rg"]
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["bucket"] == 8 and row["calls"] == 1
+    assert row["bound"] == "compute"
+    assert row["efficiency"] == pytest.approx(2e9 / 1e12)
+    assert row["cost_source"] == "hook"
+    snap = _mx.registry().snapshot()
+    eff = {json.dumps(s["labels"], sort_keys=True): s["value"]
+           for s in snap["scanner_tpu_op_efficiency_ratio"]["samples"]}
+    key = json.dumps({"bucket": "8", "device": "unit:rg",
+                      "op": "UnitOp"}, sort_keys=True)
+    assert eff[key] == pytest.approx(2e9 / 1e12)
+    bound = {json.dumps(s["labels"], sort_keys=True): s["value"]
+             for s in snap["scanner_tpu_op_compute_bound"]["samples"]}
+    assert bound[key] == 1.0
+    # disabled path records nothing
+    cs.set_enabled(False)
+    try:
+        assert cs.record_op_call("UnitOp", "unit:rg", 8, 8, 0.001,
+                                 desc) is None
+    finally:
+        cs.set_enabled(True)
+
+
+# ---------------------------------------------------------------------------
+# the compile ledger
+# ---------------------------------------------------------------------------
+
+def test_observe_compiles_records_ledger_entry():
+    import jax
+    import jax.numpy as jnp
+    seen0 = cs.ledger_summary()["entries_seen"]
+    with cs.observe_compiles("LedgerOp", "unit:lg", 8, "sig-e2e"):
+        f = jax.jit(lambda x: (x * 2.0 + 1.0).sum())
+        f(jnp.ones((8, 23))).block_until_ready()   # unique shape
+    entries = [e for e in cs.compile_ledger() if e["op"] == "LedgerOp"]
+    assert entries, "no compile observed"
+    e = entries[-1]
+    assert e["device"] == "unit:lg" and e["bucket"] == 8
+    assert e["signature"] == "sig-e2e"
+    assert e["compile_s"] > 0
+    assert e["cache"] in ("hit", "miss", "uncached")
+    assert cs.ledger_summary()["entries_seen"] > seen0
+    # metrics counted it
+    snap = _mx.registry().snapshot()
+    total = sum(s["value"]
+                for s in snap["scanner_tpu_compile_total"]["samples"]
+                if s["labels"].get("op") == "LedgerOp")
+    assert total >= 1
+    # the executable's analytical cost fed the derived-default path
+    d = cs.descriptor_for(_kernel("Histogram"), "LedgerOp", "unit:lg",
+                          8, [np.ones((8, 23), np.float32)])
+    # Histogram's hook rejects this shape -> falls to derived/observed
+    assert d is not None and d.source in ("derived", "observed")
+
+
+def test_observed_fallback_descriptor_uses_arg_bytes():
+    class NoHook:
+        def cost(self, shapes):
+            return None
+
+    d = cs.descriptor_for(NoHook(), "NeverCompiled", "unit:nf", 4,
+                          [np.zeros((4, 10), np.float32)])
+    assert d.source == "observed"
+    assert d.bytes_in == 4 * 10 * 4
+    assert d.flops is None
+
+
+def test_ledger_ring_bounds():
+    cs.set_ring_size(4)
+    try:
+        for i in range(7):
+            ctx = cs._CompileCtx("RingOp", "unit:rr", i, f"s{i}")
+            ctx.compiles.append((0.01, "uncached"))
+            cs._record_compiles(ctx)
+        ring = [e for e in cs.compile_ledger() if e["op"] == "RingOp"]
+        assert len(ring) <= 4
+        assert ring[-1]["bucket"] == 6          # newest kept
+        assert cs.ledger_summary()["entries"] <= 4
+    finally:
+        cs.set_ring_size(1024)
+
+
+def test_persistent_cache_hit_miss_labels(tmp_path):
+    """With jax's persistent compilation cache configured, the first
+    compile of a program records `miss` and a structurally identical
+    second compile records `hit` — the classification the acceptance
+    criteria require on ledger entries."""
+    import jax
+    import jax.numpy as jnp
+    try:
+        from jax._src import compilation_cache as _jcc
+        _jcc.reset_cache  # noqa: B018 — probe the API
+    except (ImportError, AttributeError):
+        pytest.skip("jax compilation_cache.reset_cache unavailable")
+
+    old_dir = jax.config.jax_compilation_cache_dir
+    old_t = jax.config.jax_persistent_cache_min_compile_time_secs
+    old_s = jax.config.jax_persistent_cache_min_entry_size_bytes
+    jax.config.update("jax_compilation_cache_dir", str(tmp_path))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    # the cache-used decision latches on the first compile of the
+    # process (earlier suites compiled with no cache dir): re-probe
+    _jcc.reset_cache()
+    try:
+        def make():
+            def cache_probe(x):
+                return (x * 3.5 - 1.25).sum()
+            return jax.jit(cache_probe)
+
+        with cs.observe_compiles("CacheOp", "unit:cc", 1, "first"):
+            make()(jnp.ones((31,))).block_until_ready()
+        with cs.observe_compiles("CacheOp", "unit:cc", 1, "second"):
+            make()(jnp.ones((31,))).block_until_ready()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          old_t)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          old_s)
+        _jcc.reset_cache()  # un-latch for the suites that follow
+    entries = {e["signature"]: e for e in cs.compile_ledger()
+               if e["op"] == "CacheOp"}
+    assert entries["first"]["cache"] == "miss", entries
+    assert entries["second"]["cache"] == "hit", entries
+    rate = cs.ledger_summary()["cache_hit_rate"]
+    assert rate is not None and 0.0 < rate <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# engine wiring: local e2e + cluster RPC round-trip
+# ---------------------------------------------------------------------------
+
+N_FRAMES = 36  # wp=8, io=16: full chunks of 8 plus a 4-row tail task
+
+
+def _synth(tmp_path, name, w=64, h=56):
+    # unique geometry so the jit signatures are cold in this process
+    # however many suites ran Histogram before us.  Widths stay
+    # multiples of 16: the native decoder's tight-packed RGB output
+    # overflows sws_scale's SIMD row writes on unaligned widths (a
+    # pre-existing scvid issue, not an efficiency-plane one)
+    from scanner_tpu import video as scv
+    vid = str(tmp_path / f"{name}.mp4")
+    scv.synthesize_video(vid, num_frames=N_FRAMES, width=w, height=h,
+                         fps=24, keyint=8)
+    return vid
+
+
+def test_local_dispatch_ledger_and_efficiency(tmp_path, monkeypatch):
+    """Local-mode golden pipeline with forced device staging: every
+    dispatch-site compile lands in the ledger with a cache label, the
+    roofline table classifies Histogram, and Client.compile_report()
+    serves both under nodes["client"]."""
+    monkeypatch.setenv("SCANNER_TPU_KERNEL_DEVICES", "all")
+    vid = _synth(tmp_path, "local")
+    sc = Client(db_path=str(tmp_path / "db"))
+    sc.ingest_videos([("csv", vid)])
+    frame = sc.io.Input([NamedVideoStream(sc, "csv")])
+    out = NamedStream(sc, "cs_local")
+    sc.run(sc.io.Output(sc.ops.Histogram(frame=frame), [out]),
+           PerfParams.manual(8, 16), cache_mode=CacheMode.Overwrite,
+           show_progress=False)
+    rows = list(out.load())
+    assert len(rows) == N_FRAMES
+
+    entries = [e for e in cs.compile_ledger()
+               if e["op"] == "Histogram" and "56, 64" in e["signature"]]
+    assert entries, "dispatch-site compiles missing from the ledger"
+    buckets = {e["bucket"] for e in entries}
+    # steady-state chunks run at bucket 8; the 4-row tail at bucket 4
+    assert buckets == {4, 8}, entries
+    for e in entries:
+        assert e["compile_s"] > 0
+        assert e["cache"] in ("hit", "miss", "uncached")
+        assert e["compiles"] >= 1
+
+    eff = [o for o in cs.op_efficiency() if o["op"] == "Histogram"]
+    assert eff, "no roofline rows for Histogram"
+    for o in eff:
+        assert o["bound"] in ("compute", "memory")
+        assert o["efficiency"] > 0
+        assert o["cost_source"] == "hook"
+
+    rep = sc.compile_report()
+    assert "client" in rep["nodes"]
+    crep = rep["nodes"]["client"]
+    assert crep["summary"]["compiles"] >= len(entries)
+    assert any(o["op"] == "Histogram" for o in crep["op_efficiency"])
+    sc.stop()
+
+
+@pytest.fixture
+def eff_cluster(tmp_path, monkeypatch):
+    """Master (with /statusz) + 1 worker + client over an ingested
+    video, device staging forced so the efficiency plane records."""
+    monkeypatch.setenv("SCANNER_TPU_KERNEL_DEVICES", "all")
+    from scanner_tpu.engine.service import Master, Worker
+
+    db_path = str(tmp_path / "db")
+    vid = _synth(tmp_path, "cluster", w=96, h=48)
+    seed = Client(db_path=db_path)
+    seed.ingest_videos([("csc", vid)])
+    master = Master(db_path=db_path, no_workers_timeout=10.0,
+                    metrics_port=0)
+    addr = f"localhost:{master.port}"
+    worker = Worker(addr, db_path=db_path, pipeline_instances=2)
+    sc = Client(db_path=db_path, master=addr)
+    yield sc, master, worker, addr
+    sc.stop()
+    worker.stop()
+    master.stop()
+
+
+def test_cluster_compile_report_rpc_and_surfaces(eff_cluster):
+    """GetCompileLedger RPC round-trip: master + worker nodes in
+    Client.compile_report(), the /statusz Efficiency panel, and
+    scanner_top --json carrying compile + ops keys."""
+    sc, master, _worker, addr = eff_cluster
+    frame = sc.io.Input([NamedVideoStream(sc, "csc")])
+    out = NamedStream(sc, "cs_cluster")
+    sc.run(sc.io.Output(sc.ops.Histogram(frame=frame), [out]),
+           PerfParams.manual(8, 16), cache_mode=CacheMode.Overwrite,
+           show_progress=False)
+    assert len(list(out.load())) == N_FRAMES
+
+    rep = sc.compile_report()
+    nodes = rep["nodes"]
+    assert "master" in nodes
+    workers = [n for n in nodes if n.startswith("worker")]
+    assert workers, nodes
+    wrep = nodes[workers[0]]
+    assert set(wrep) == {"ledger", "summary", "op_efficiency"}
+    # the worker (same process here, as in the memstats cluster) saw
+    # the Histogram compiles; the ledger labels every one
+    assert any(e["op"] == "Histogram" for e in wrep["ledger"])
+    assert all(e["cache"] in ("hit", "miss", "uncached")
+               for e in wrep["ledger"])
+
+    # /statusz Efficiency panel (master role)
+    port = master.metrics_server.port
+    st = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/statusz", timeout=10).read())
+    assert "efficiency" in st
+    assert st["efficiency"]["enabled"] is True
+    assert "compile" in st["efficiency"]
+    assert isinstance(st["efficiency"]["ops"], list)
+
+    # scanner_top --json: compile + ops keys per node
+    from scanner_tpu.util.jaxenv import cpu_only_env
+    env = cpu_only_env()
+    env["PYTHONPATH"] = os.path.dirname(HERE) + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    tool = os.path.join(os.path.dirname(HERE), "tools", "scanner_top.py")
+    r = subprocess.run(
+        [sys.executable, tool, "--master", addr, "--json"],
+        env=env, capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, r.stderr
+    doc = json.loads(r.stdout)
+    wn = doc["nodes"][workers[0]]
+    assert "compile" in wn and "hit_rate" in wn["compile"]
+    assert "ops" in wn
+    if wn["ops"]:
+        o = next(iter(wn["ops"].values()))
+        assert {"bucket", "efficiency", "compute_bound",
+                "flops_per_s", "bytes_per_s"} <= set(o)
+    # the human table grew the efficiency section
+    r2 = subprocess.run(
+        [sys.executable, tool, "--master", addr, "--once"],
+        env=env, capture_output=True, text=True, timeout=180)
+    assert r2.returncode == 0, r2.stderr
+    if wn["ops"]:
+        assert "EFF%" in r2.stdout and "XCACHE" in r2.stdout
+
+    # scanner_cost: the dedicated report CLI against the same master
+    cost_tool = os.path.join(os.path.dirname(HERE), "tools",
+                             "scanner_cost.py")
+    r3 = subprocess.run(
+        [sys.executable, cost_tool, "--master", addr, "--json"],
+        env=env, capture_output=True, text=True, timeout=180)
+    assert r3.returncode == 0, r3.stderr
+    doc3 = json.loads(r3.stdout)
+    assert "master" in doc3["nodes"]
+    r4 = subprocess.run(
+        [sys.executable, cost_tool, "--master", addr],
+        env=env, capture_output=True, text=True, timeout=180)
+    assert r4.returncode == 0, r4.stderr
+    assert "compiles in" in r4.stdout
+
+
+# ---------------------------------------------------------------------------
+# bench_history: the per-direction baseline gate
+# ---------------------------------------------------------------------------
+
+def test_bench_history_baseline_gate(tmp_path):
+    """bench_history --write-baselines banks the stable
+    baseline_metrics keys; a later round that regresses a metric
+    against its declared direction beyond the threshold exits 1."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_history_under_test",
+        os.path.join(os.path.dirname(HERE), "tools", "bench_history.py"))
+    bh = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bh)
+
+    def write_round(p99, eff, hit):
+        with open(tmp_path / "BENCH_r01.json", "w") as f:
+            json.dump({"parsed": {"metric": "m", "value": 10.0}}, f)
+        with open(tmp_path / "BENCH_DETAIL.json", "w") as f:
+            json.dump([{"config": "baseline_metrics", "metrics": {
+                "task_latency_p99_s": {"value": p99, "better": "lower"},
+                "op_efficiency_mean": {"value": eff, "better": "higher"},
+                "compile_cache_hit_rate": {"value": hit,
+                                           "better": "higher"},
+            }}], f)
+
+    write_round(p99=2.0, eff=0.5, hit=0.9)
+    assert bh.main(["--dir", str(tmp_path), "--write-baselines"]) == 0
+    base = bh.load_baselines(str(tmp_path))
+    assert base["task_latency_p99_s"]["value"] == 2.0
+    # same numbers: clean
+    assert bh.main(["--dir", str(tmp_path)]) == 0
+    # latency p99 doubles (lower-is-better): gate trips
+    write_round(p99=4.0, eff=0.5, hit=0.9)
+    assert bh.main(["--dir", str(tmp_path)]) == 1
+    # efficiency halves (higher-is-better): gate trips
+    write_round(p99=2.0, eff=0.2, hit=0.9)
+    assert bh.main(["--dir", str(tmp_path)]) == 1
+    # a metric going unmeasured (None) must NOT page
+    write_round(p99=2.0, eff=None, hit=None)
+    assert bh.main(["--dir", str(tmp_path)]) == 0
+    # improvements never page
+    write_round(p99=1.0, eff=0.9, hit=1.0)
+    assert bh.main(["--dir", str(tmp_path)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# acceptance e2e: warm-up ladder ledger on a virtual multi-device host
+# ---------------------------------------------------------------------------
+
+def test_warmup_ladder_compile_ledger_per_device(tmp_path):
+    """The golden pipeline's bucket-ladder warm-up on a 2-device
+    virtual host produces one compile-ledger entry per (op, device,
+    bucket) with nonzero compile seconds, and every observed compile
+    carries a cache label — the acceptance criterion."""
+    from scanner_tpu import video as scv
+    from scanner_tpu.util.jaxenv import cpu_only_env
+
+    vid = str(tmp_path / "warm.mp4")
+    scv.synthesize_video(vid, num_frames=32, width=64, height=44,
+                         fps=24, keyint=8)
+    out = str(tmp_path / "cs.json")
+    env = cpu_only_env(n_devices=2)
+    env["PYTHONPATH"] = os.path.dirname(HERE) + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    env["SCANNER_TPU_KERNEL_DEVICES"] = "all"
+    env["SCANNER_TPU_PRECOMPILE"] = "1"
+    r = subprocess.run(
+        [sys.executable, RUNNER, vid, out],
+        env=env, cwd=HERE, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0 and "COSTSTATS_OK" in r.stdout, \
+        f"runner failed (rc={r.returncode}):\n{r.stderr[-3000:]}"
+    with open(out) as f:
+        res = json.load(f)
+    assert res["n_devices"] == 2
+    assert res["n_rows"] == 32
+
+    warm = [e for e in res["ledger"]
+            if e["op"] == "Histogram"
+            and str(e["signature"]).startswith("warmup:")]
+    ladder = bucket_ladder(8)  # wp=8 in the runner
+    want = {(f"cpu:{d}", b) for d in (0, 1) for b in ladder}
+    got = {(e["device"], e["bucket"]) for e in warm}
+    assert got == want, (got, want)
+    for e in warm:
+        assert e["compile_s"] > 0, e
+        assert e["cache"] in ("hit", "miss", "uncached")
+    # 100% of observed compiles are accounted: the summary's compile
+    # count equals the per-entry sum, none dropped from the ring
+    total = sum(e["compiles"] for e in res["ledger"])
+    assert res["summary"]["compiles"] == total
+    assert res["summary"]["entries_seen"] == len(res["ledger"])
+    # the roofline table classified the op
+    eff = [o for o in res["op_efficiency"] if o["op"] == "Histogram"]
+    assert eff and all(o["bound"] in ("compute", "memory") for o in eff)
+    # and the local-mode report carries the same plane
+    assert "client" in res["report"]["nodes"]

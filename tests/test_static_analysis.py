@@ -466,6 +466,100 @@ def test_alert_contract_config_schema_both_directions(tmp_path):
     assert not any("enabled" in m for m in msgs)
 
 
+def _cost_repo(tmp_path, kernel_has_cost=True,
+               declared=("scanner_tpu_eff_a", "scanner_tpu_eff_b"),
+               registered=("scanner_tpu_eff_a", "scanner_tpu_eff_b"),
+               doc_series=("scanner_tpu_eff_a", "scanner_tpu_eff_b"),
+               with_markers=True):
+    """Synthetic mini-repo for the SC309 cost-model contract lints."""
+    _write(tmp_path, "setup.py", "# root marker\n")
+    cost = ("\n            def cost(self, shapes):\n"
+            "                return None\n" if kernel_has_cost else "\n")
+    _write(tmp_path, "pkg/kernels/imgk.py", f"""
+        from pkg.common import DeviceType
+        from pkg.graph.ops import Kernel, register_op
+
+        @register_op(device=DeviceType.TPU, batch=4)
+        class DeviceK(Kernel):
+            def execute(self, frame):
+                return frame
+{cost}
+        @register_op()
+        class HostK(Kernel):
+            def execute(self, frame):
+                return frame
+    """)
+    regs = "\n        ".join(
+        f'_G{i} = _mx.registry().gauge("{n}", "help text", '
+        f'labels=["op"])' for i, n in enumerate(registered))
+    decl = ", ".join(f'"{n}"' for n in declared)
+    _write(tmp_path, "pkg/util/coststats.py", f"""
+        from . import metrics as _mx
+
+        {regs}
+
+        EFFICIENCY_SERIES = ({decl},)
+    """)
+    rows = "\n".join(f"| `{n}` | gauge | x |" for n in doc_series)
+    table = (f"<!-- efficiency-series:begin -->\n"
+             f"| Series | Type | Meaning |\n|---|---|---|\n"
+             f"{rows}\n<!-- efficiency-series:end -->\n"
+             if with_markers else rows)
+    all_series = sorted(set(declared) | set(registered) | set(doc_series))
+    _write(tmp_path, "docs/observability.md", f"""
+        Catalog (every fixture series mentioned so SC301 stays quiet):
+        {" ".join(f"`{n}`" for n in all_series)}
+
+        {table}
+    """)
+    return tmp_path
+
+
+def test_cost_model_kernel_hook_fixture(tmp_path):
+    _cost_repo(tmp_path, kernel_has_cost=False)
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC309"]
+    assert any("DeviceK" in m and "cost()" in m for m in msgs)
+    # host kernels (no device=TPU) are exempt
+    assert not any("HostK" in m for m in msgs)
+
+
+def test_cost_model_clean_fixture_is_quiet(tmp_path):
+    _cost_repo(tmp_path)
+    _, findings = _analyze(tmp_path, "pkg")
+    assert [f for f in findings if f.code == "SC309"] == []
+
+
+def test_cost_model_series_all_pairings_both_directions(tmp_path):
+    _cost_repo(
+        tmp_path,
+        declared=("scanner_tpu_eff_a", "scanner_tpu_eff_phantom"),
+        registered=("scanner_tpu_eff_a", "scanner_tpu_eff_unlisted"),
+        doc_series=("scanner_tpu_eff_a", "scanner_tpu_eff_ghost"))
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC309"]
+    # registered but not declared
+    assert any("scanner_tpu_eff_unlisted" in m
+               and "missing from EFFICIENCY_SERIES" in m for m in msgs)
+    # declared but never registered
+    assert any("scanner_tpu_eff_phantom" in m
+               and "registers no such series" in m for m in msgs)
+    # declared but missing from the doc table
+    assert any("scanner_tpu_eff_phantom" in m and "missing from the"
+               in m for m in msgs)
+    # doc table lists an unknown series
+    assert any("scanner_tpu_eff_ghost" in m and "no such series" in m
+               for m in msgs)
+    assert not any("`scanner_tpu_eff_a`" in m for m in msgs)
+
+
+def test_cost_model_missing_marker_table(tmp_path):
+    _cost_repo(tmp_path, with_markers=False)
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC309"]
+    assert any("marker" in m for m in msgs)
+
+
 def test_contract_rpc_contracts_table_both_directions(tmp_path):
     _write(tmp_path, "setup.py", "# root\n")
     _write(tmp_path, "pkg/rpcmod.py", """

@@ -84,7 +84,8 @@ def find_regressions(by_metric, threshold, check_all=False):
 def detail_digest(bench_dir):
     """The latest round's BENCH_DETAIL.json, reduced to the lines a
     trajectory reader wants: per-config fps, task-latency quantiles,
-    and the health/alerts digest.  {} when the file is absent."""
+    the health/alerts digest, the per-op efficiency table and the
+    stable baseline metrics.  {} when the file is absent."""
     path = os.path.join(bench_dir, "BENCH_DETAIL.json")
     if not os.path.exists(path):
         return {}
@@ -93,7 +94,8 @@ def detail_digest(bench_dir):
             detail = json.load(f)
     except (OSError, ValueError):
         return {}
-    out = {"fps_by_config": {}, "task_latency": {}, "health": {}}
+    out = {"fps_by_config": {}, "task_latency": {}, "health": {},
+           "op_efficiency": {}, "baseline_metrics": {}}
     for d in detail:
         if not isinstance(d, dict):
             continue
@@ -105,7 +107,65 @@ def detail_digest(bench_dir):
         elif d.get("config") == "health":
             out["health"] = {k: v for k, v in d.items()
                             if k not in ("config", "rpc_latency")}
+        elif d.get("config") in ("op_efficiency", "op_efficiency_hw"):
+            out["op_efficiency"][d["config"]] = {
+                k: v for k, v in d.items() if k != "config"}
+        elif d.get("config") == "baseline_metrics":
+            out["baseline_metrics"] = d.get("metrics") or {}
     return out
+
+
+# stable per-direction baseline gate: bench.py banks `baseline_metrics`
+# (each with a declared better= direction) into BENCH_DETAIL.json;
+# --write-baselines snapshots them here, and every later run compares
+# against the snapshot so the serving/cache/kernel directions gate the
+# moment their first healthy round banks a baseline.
+BASELINES_FILE = "BENCH_BASELINES.json"
+
+
+def load_baselines(bench_dir):
+    path = os.path.join(bench_dir, BASELINES_FILE)
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        return doc.get("metrics", {}) if isinstance(doc, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def write_baselines(bench_dir, metrics):
+    path = os.path.join(bench_dir, BASELINES_FILE)
+    known = {k: v for k, v in metrics.items()
+             if isinstance(v, dict) and v.get("value") is not None}
+    with open(path, "w") as f:
+        json.dump({"metrics": known}, f, indent=1)
+    return path
+
+
+def find_detail_regressions(baselines, current, threshold):
+    """[(metric, baseline, now, change_frac)] where a baseline-metrics
+    value moved against its declared direction beyond `threshold`.
+    Metrics absent from either side (no baseline banked yet, or not
+    measurable this round) are skipped — a CPU-fallback round must not
+    page on a missing hardware number."""
+    regs = []
+    for name, base in baselines.items():
+        cur = current.get(name)
+        if not isinstance(base, dict) or not isinstance(cur, dict):
+            continue
+        b, c = base.get("value"), cur.get("value")
+        if b is None or c is None or not b:
+            continue
+        better = base.get("better", cur.get("better", "higher"))
+        change = (c - b) / abs(b)
+        if better == "lower":
+            change = -change
+        # change is now "improvement fraction": negative = worse
+        if change < -threshold:
+            regs.append((name, b, c, change))
+    return regs
 
 
 def main(argv=None) -> int:
@@ -123,6 +183,12 @@ def main(argv=None) -> int:
                          "not just the newest")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
+    ap.add_argument("--write-baselines", action="store_true",
+                    help="snapshot the latest BENCH_DETAIL "
+                         "baseline_metrics into BENCH_BASELINES.json — "
+                         "the per-direction gate (task-latency p99, "
+                         "per-op efficiency, compile-cache hit rate) "
+                         "compares every later run against it")
     args = ap.parse_args(argv)
 
     rounds = load_rounds(args.dir)
@@ -133,6 +199,13 @@ def main(argv=None) -> int:
     by_metric = series_by_metric(rounds)
     regs = find_regressions(by_metric, args.threshold, args.all)
     detail = detail_digest(args.dir)
+    base_metrics = detail.get("baseline_metrics") or {}
+    if args.write_baselines and base_metrics:
+        path = write_baselines(args.dir, base_metrics)
+        print(f"bench-history: baselines written to {path}",
+              file=sys.stderr)
+    detail_regs = find_detail_regressions(
+        load_baselines(args.dir), base_metrics, args.threshold)
 
     if args.json:
         print(json.dumps({
@@ -144,10 +217,14 @@ def main(argv=None) -> int:
                 {"metric": m, "from_round": r0, "from": v0,
                  "to_round": r1, "to": v1, "drop": round(drop, 4)}
                 for m, r0, v0, r1, v1, drop in regs],
+            "detail_regressions": [
+                {"metric": m, "baseline": b, "value": c,
+                 "change": round(ch, 4)}
+                for m, b, c, ch in detail_regs],
             "threshold": args.threshold,
             "detail": detail,
         }, indent=1))
-        return 1 if regs else 0
+        return 1 if regs or detail_regs else 0
 
     print(f"bench-history: {len(rounds)} rounds "
           f"(r{rounds[0][0]:02d}..r{rounds[-1][0]:02d}), "
@@ -177,11 +254,29 @@ def main(argv=None) -> int:
                         if k.endswith(":firing"))
             print(f"  health: {h.get('status', '?')} "
                   f"({int(fired)} alert firings during the run)")
-    if regs:
+        eff = (detail.get("op_efficiency") or {}).get("op_efficiency")
+        if eff and eff.get("ops"):
+            for o in eff["ops"][:8]:
+                print(f"  eff {o['op']}@{o['device']} b{o['bucket']}: "
+                      f"{o['efficiency']:.2%} ({o['bound']}-bound)")
+            comp = eff.get("compile") or {}
+            hr = comp.get("cache_hit_rate")
+            print(f"  compile: {comp.get('compiles', 0)} in "
+                  f"{comp.get('compile_seconds', 0)}s, cache hit rate "
+                  + (f"{hr:.0%}" if hr is not None else "n/a"))
+        if base_metrics:
+            print("  baselines: " + "  ".join(
+                f"{k}={v.get('value')}" for k, v in
+                sorted(base_metrics.items())
+                if isinstance(v, dict)))
+    if regs or detail_regs:
         print("\nREGRESSIONS:")
         for m, r0, v0, r1, v1, drop in regs:
             print(f"  {m}: r{r0:02d} {v0:.2f} -> r{r1:02d} {v1:.2f} "
                   f"({drop:.1%} drop > {args.threshold:.0%})")
+        for m, b, c, ch in detail_regs:
+            print(f"  {m}: baseline {b} -> {c} "
+                  f"({-ch:.1%} worse > {args.threshold:.0%})")
         return 1
     print("\nno regressions beyond threshold")
     return 0

@@ -65,6 +65,55 @@ NODE_COUNTERS = {
 }
 
 
+def _sum_by_label(snap: dict, name: str, node: str, label: str) -> dict:
+    """{label_value: summed value} for one node's samples of a series."""
+    entry = snap.get(name)
+    if not entry:
+        return {}
+    out = {}
+    for s in entry["samples"]:
+        sl = s["labels"]
+        if sl.get("node") == node and label in sl:
+            out[sl[label]] = out.get(sl[label], 0.0) + s.get("value", 0.0)
+    return out
+
+
+def _op_efficiency(snap: dict, node: str) -> dict:
+    """{(op, device): row} from the coststats efficiency gauges,
+    keeping the largest bucket per (op, device) — the steady-state
+    rung (tail buckets run rarely and noisy)."""
+    out = {}
+    entry = snap.get("scanner_tpu_op_efficiency_ratio")
+    if not entry:
+        return out
+    for s in entry["samples"]:
+        sl = s["labels"]
+        if sl.get("node") != node:
+            continue
+        key = (sl.get("op", "?"), sl.get("device", "?"))
+        try:
+            bucket = int(sl.get("bucket", 0))
+        except ValueError:
+            bucket = 0
+        if key in out and out[key]["bucket"] >= bucket:
+            continue
+        labels = {"op": key[0], "device": key[1],
+                  "bucket": sl.get("bucket", "0")}
+        out[key] = {
+            "bucket": bucket,
+            "efficiency": s.get("value", 0.0),
+            "compute_bound": _gauge(
+                snap, "scanner_tpu_op_compute_bound", node,
+                **labels) >= 0.5,
+            "flops_per_s": _gauge(
+                snap, "scanner_tpu_op_achieved_flops", node, **labels),
+            "bytes_per_s": _gauge(
+                snap, "scanner_tpu_op_achieved_bandwidth_bytes", node,
+                **labels),
+        }
+    return out
+
+
 def _per_device(snap: dict, name: str, node: str) -> dict:
     """{device: value} for one node's samples of a device-labeled
     series (multi-chip evaluator affinity)."""
@@ -109,8 +158,22 @@ def digest(snap: dict) -> dict:
             snap, "scanner_tpu_device_hbm_limit_bytes", node)
         d["dev_ledger"] = _per_device(
             snap, "scanner_tpu_ledger_live_bytes", node)
+        # compute-efficiency plane (util/coststats.py): XLA compiles by
+        # persistent-cache outcome, and the per-(op, device) roofline
+        # verdict at the steady-state bucket
+        d["compile"] = _sum_by_label(
+            snap, "scanner_tpu_compile_total", node, "cache")
+        d["ops"] = _op_efficiency(snap, node)
         out["nodes"][node] = d
     return out
+
+
+def _hit_rate(compile_by_cache: dict):
+    """Persistent-cache hit rate, or None when no cache is configured
+    (every observed compile was `uncached`)."""
+    hit = compile_by_cache.get("hit", 0.0)
+    miss = compile_by_cache.get("miss", 0.0)
+    return hit / (hit + miss) if (hit + miss) else None
 
 
 def _rate(cur: dict, prev: dict, key: str, now: float) -> float:
@@ -207,6 +270,28 @@ def render(status: dict, cur: dict, prev: dict, master: str,
                      f"{'BUSY s':>8} {'UTIL':>7} {'HBM MB':>9} "
                      f"{'HBM%':>6} {'LEDG MB':>9}")
         lines.extend(dev_rows)
+    # per-op roofline (util/coststats.py): EFF% against the device peak
+    # for the binding resource, at the steady-state bucket — a slow op
+    # at high EFF% needs more chips, at low EFF% a better kernel.  The
+    # XCACHE column is the node's persistent-compile-cache hit rate.
+    eff_rows = []
+    for node, d in sorted(cur["nodes"].items()):
+        ops = d.get("ops") or {}
+        hr = _hit_rate(d.get("compile") or {})
+        hr_s = f"{hr * 100:.0f}%" if hr is not None else "-"
+        for (op, dev), o in sorted(ops.items()):
+            eff_rows.append(
+                f"{node:10} {op[:16]:16} {dev:>9} {o['bucket']:>6} "
+                f"{o['efficiency'] * 100:>6.1f}% "
+                f"{'compute' if o['compute_bound'] else 'memory':>8} "
+                f"{o['flops_per_s'] / 1e9:>9.2f} "
+                f"{o['bytes_per_s'] / 1e9:>8.3f} {hr_s:>6}")
+    if eff_rows:
+        lines.append("")
+        lines.append(f"{'NODE':10} {'OP':16} {'DEVICE':>9} {'BUCKET':>6} "
+                     f"{'EFF%':>7} {'BOUND':>8} {'GFLOP/s':>9} "
+                     f"{'GB/s':>8} {'XCACHE':>6}")
+        lines.extend(eff_rows)
     # cluster health (GetHealth): the judgment layer — which rules fire
     # where, so "is it healthy" doesn't require reading the counters
     if health:
@@ -263,6 +348,15 @@ def json_doc(status: dict, cur: dict, master: str,
                 for dev in sorted(set(d.get("dev_tasks") or {})
                                   | set(d.get("dev_hbm") or {})
                                   | set(d.get("dev_ledger") or {}))
+            },
+            # compute-efficiency plane: compile counts by cache outcome
+            # (+ derived hit rate) and the per-op roofline rows the
+            # human table renders
+            "compile": dict(d.get("compile") or {},
+                            hit_rate=_hit_rate(d.get("compile") or {})),
+            "ops": {
+                f"{op}@{dev}": o
+                for (op, dev), o in sorted((d.get("ops") or {}).items())
             },
         }
     return {"time": cur["t"], "master": master, "status": status,

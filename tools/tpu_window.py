@@ -341,6 +341,63 @@ print("MULTICHIP_UTIL " + json.dumps(out))
 """
 
 
+_EFF_DIGEST = r"""
+import json, os, tempfile, time
+import jax
+from scanner_tpu import (CacheMode, Client, NamedStream, NamedVideoStream,
+                         PerfParams)
+import scanner_tpu.kernels
+from scanner_tpu import video as scv
+from scanner_tpu.util import coststats
+
+root = tempfile.mkdtemp(prefix="eff_hw_")
+vid = os.path.join(root, "v.mp4")
+N = 384
+scv.synthesize_video(vid, num_frames=N, width=640, height=480, fps=24,
+                     keyint=32)
+sc = Client(db_path=os.path.join(root, "db"))
+sc.ingest_videos([("bench", vid)])
+
+def run(name, build):
+    frames = sc.io.Input([NamedVideoStream(sc, "bench")])
+    out = NamedStream(sc, name)
+    t0 = time.time()
+    sc.run(sc.io.Output(build(frames), [out]), PerfParams.manual(32, 96),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    return round(N / (time.time() - t0), 1)
+
+# warm first so the measured runs are compile-free: the roofline join
+# excludes compile-bearing first calls, but the fps numbers should be
+# steady-state too
+run("eff_warm", lambda f: sc.ops.Histogram(frame=f))
+fps_hist = run("eff_hist", lambda f: sc.ops.Histogram(frame=f))
+fps_blur = run("eff_blur", lambda f: sc.ops.Blur(frame=f))
+out = {
+    "device": str(jax.devices()[0]),
+    "fps_histogram": fps_hist,
+    "fps_blur": fps_blur,
+    "ops": coststats.op_efficiency(),
+    "compile": coststats.ledger_summary(),
+}
+sc.stop()
+# bank the hardware roofline digest with the round's bench evidence
+# (same file bench.py writes its digests to) — the ROADMAP asks for a
+# hardware op_efficiency baseline on the next healthy capture window
+path = os.path.join(os.getcwd(), "BENCH_DETAIL.json")
+try:
+    detail = json.load(open(path))
+    if not isinstance(detail, list):
+        detail = [detail]
+except Exception:
+    detail = []
+detail.append({"config": "op_efficiency_hw",
+               "clock": time.strftime("%Y-%m-%dT%H:%M:%S"), **out})
+with open(path, "w") as f:
+    json.dump(detail, f, indent=1)
+print("EFF_DIGEST " + json.dumps(out))
+"""
+
+
 def tunnel_up() -> bool:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from tpu_capture import tunnel_up as probe  # same probe + env override
@@ -392,6 +449,10 @@ def main() -> int:
         "per-device utilization digest + affinity A/B (-> "
         "BENCH_DETAIL.json)", code=_MC_UTIL,
         timeout=1200, marker="MULTICHIP_UTIL ")
+    results["op_efficiency"] = run_step(
+        "hardware roofline digest (util/coststats.py -> "
+        "BENCH_DETAIL.json op_efficiency_hw)", code=_EFF_DIGEST,
+        timeout=1200, marker="EFF_DIGEST ")
     results["op_bench"] = run_step(
         "per-op device/host A/B (tools/op_bench.py -> OP_BENCH.json)",
         argv=[sys.executable, "tools/op_bench.py"], timeout=1200)
