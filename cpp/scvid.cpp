@@ -207,6 +207,9 @@ struct ScvidDecoder {
   // NV12 and converted on-GPU for the same reason, util/image.cu:22)
   int out_fmt = 0;
   int64_t emitted = 0;  // display-order frames emitted since last reset
+  // over-aligned scratch surface for swscale output at widths whose
+  // tight stride is not SIMD-safe (see convert_frame)
+  std::vector<uint8_t> scratch;
 };
 
 SCVID_API ScvidDecoder* scvid_decoder_create(const char* codec_name,
@@ -330,15 +333,52 @@ int convert_frame(ScvidDecoder* d, uint8_t* dst) {
       return 0;
     }
     if (ensure_sws(d, f, AV_PIX_FMT_YUV420P) < 0) return -1;
-    uint8_t* dst_planes[4] = {dst_y, dst_u, dst_v, nullptr};
-    int dst_stride[4] = {(int)w, (int)cw, (int)cw, 0};
+    if ((w % 32) == 0) {
+      uint8_t* dst_planes[4] = {dst_y, dst_u, dst_v, nullptr};
+      int dst_stride[4] = {(int)w, (int)cw, (int)cw, 0};
+      sws_scale(d->sws, f->data, f->linesize, 0, h, dst_planes,
+                dst_stride);
+      return 0;
+    }
+    // Unaligned width: swscale's SIMD row writers store full vector
+    // registers, overrunning a tight-packed destination row by up to
+    // the vector width — at the last row that lands PAST the caller's
+    // buffer (heap corruption for widths not a multiple of 16, found
+    // in PR 9).  Scale into an over-aligned scratch surface and copy
+    // tight rows out.
+    const int ys = FFALIGN((int)w, 64), cs = FFALIGN((int)cw, 64);
+    d->scratch.resize((size_t)ys * h + 2 * (size_t)cs * ch + 64);
+    uint8_t* sy = d->scratch.data();
+    uint8_t* su = sy + (size_t)ys * h;
+    uint8_t* sv = su + (size_t)cs * ch;
+    uint8_t* dst_planes[4] = {sy, su, sv, nullptr};
+    int dst_stride[4] = {ys, cs, cs, 0};
     sws_scale(d->sws, f->data, f->linesize, 0, h, dst_planes, dst_stride);
+    for (int64_t r = 0; r < h; ++r) memcpy(dst_y + r * w, sy + r * ys, w);
+    for (int64_t r = 0; r < ch; ++r) {
+      memcpy(dst_u + r * cw, su + r * cs, cw);
+      memcpy(dst_v + r * cw, sv + r * cs, cw);
+    }
     return 0;
   }
   if (ensure_sws(d, f, AV_PIX_FMT_RGB24) < 0) return -1;
-  uint8_t* dst_planes[4] = {dst, nullptr, nullptr, nullptr};
-  int dst_stride[4] = {3 * (int)w, 0, 0, 0};
+  const int tight = 3 * (int)w;
+  if ((w % 16) == 0) {
+    uint8_t* dst_planes[4] = {dst, nullptr, nullptr, nullptr};
+    int dst_stride[4] = {tight, 0, 0, 0};
+    sws_scale(d->sws, f->data, f->linesize, 0, h, dst_planes,
+              dst_stride);
+    return 0;
+  }
+  // unaligned width: same SIMD-overrun hazard as above — aligned
+  // scratch stride, then tight-row copy-out
+  const int stride = FFALIGN(tight, 64);
+  d->scratch.resize((size_t)stride * h + 64);
+  uint8_t* dst_planes[4] = {d->scratch.data(), nullptr, nullptr, nullptr};
+  int dst_stride[4] = {stride, 0, 0, 0};
   sws_scale(d->sws, f->data, f->linesize, 0, h, dst_planes, dst_stride);
+  for (int64_t r = 0; r < h; ++r)
+    memcpy(dst + r * tight, d->scratch.data() + r * stride, tight);
   return 0;
 }
 

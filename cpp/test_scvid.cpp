@@ -301,6 +301,111 @@ int main() {
     remove(bpkts);
   }
 
+  // --- unaligned width (regression: heap corruption at w % 16 != 0) -----
+  // Tight-packed swscale output overran SIMD row writes for widths not a
+  // multiple of 16; convert_frame now routes those through an aligned
+  // scratch surface.  Decode a 90x70 clip into an EXACTLY-sized buffer
+  // with canary bytes behind it — run under `make asan` for the full
+  // proof; the canary catches gross overruns even without it.
+  {
+    const int UW = 90, UH = 70, UN = 24;
+    const char* ump4 = "/tmp/scvid_test_u.mp4";
+    const char* upkts = "/tmp/scvid_test_u.pkts";
+    ScvidEncoder* uenc = scvid_encoder_create(UW, UH, 24, 1, "libx264", 0,
+                                              18, KEYINT, 0, 0);
+    CHECK(uenc != nullptr, "unaligned encoder create");
+    std::vector<uint8_t> uframe((size_t)UW * UH * 3);
+    for (int i = 0; i < UN; ++i) {
+      for (int p = 0; p < UW * UH; ++p) {
+        uframe[3 * p + 0] = (uint8_t)((i * 16) % 224);
+        uframe[3 * p + 1] = (uint8_t)(((p % UW) * 239) / (UW - 1));
+        uframe[3 * p + 2] = 0;
+      }
+      CHECK(scvid_encoder_feed(uenc, uframe.data(), 1) == 0,
+            "unaligned encoder feed");
+    }
+    CHECK(scvid_encoder_flush(uenc) == 0, "unaligned encoder flush");
+    int64_t un = scvid_encoder_pending(uenc);
+    std::vector<uint8_t> udata(scvid_encoder_pending_bytes(uenc));
+    std::vector<uint64_t> usizes(un);
+    std::vector<uint8_t> ukeys(un);
+    std::vector<int64_t> upts(un), udts(un);
+    scvid_encoder_take(uenc, udata.data(), usizes.data(), ukeys.data(),
+                       upts.data(), udts.data());
+    int64_t uxsz = scvid_encoder_extradata(uenc, nullptr, 0);
+    std::vector<uint8_t> uextra(uxsz);
+    scvid_encoder_extradata(uenc, uextra.data(), uxsz);
+    CHECK(scvid_mp4_write(ump4, UW, UH, 24, 1, 1, 24, "h264",
+                          uextra.data(), uxsz, udata.data(),
+                          usizes.data(), ukeys.data(), upts.data(),
+                          udts.data(), un) == 0,
+          "unaligned mp4 write");
+    scvid_encoder_destroy(uenc);
+
+    ScvidIndex* uidx = scvid_ingest(ump4, upkts);
+    CHECK(uidx != nullptr, "unaligned ingest");
+    CHECK(uidx->width == UW && uidx->height == UH, "unaligned geometry");
+    FILE* uf = fopen(upkts, "rb");
+    CHECK(uf != nullptr, "unaligned packet file open");
+    long utotal = (long)(uidx->sample_offsets[un - 1] +
+                         uidx->sample_sizes[un - 1]);
+    std::vector<uint8_t> uall(utotal);
+    CHECK(fread(uall.data(), 1, uall.size(), uf) == uall.size(),
+          "unaligned packet read");
+    fclose(uf);
+    std::vector<uint64_t> uall_sizes(uidx->sample_sizes,
+                                     uidx->sample_sizes + un);
+    std::vector<uint8_t> uwant(un, 1);
+    const size_t ubytes = (size_t)un * UW * UH * 3;
+    const size_t canary = 256;
+    std::vector<uint8_t> uout(ubytes + canary);
+    memset(uout.data() + ubytes, 0xAB, canary);
+    // rgb24 path
+    ScvidDecoder* udec = scvid_decoder_create("h264", uidx->extradata,
+                                              uidx->extradata_size, UW,
+                                              UH, 1);
+    CHECK(udec != nullptr, "unaligned decoder create");
+    int64_t udims[2] = {0, 0};
+    int64_t ugot = scvid_decode_run(udec, uall.data(), uall_sizes.data(),
+                                    un, uwant.data(), un, 1, uout.data(),
+                                    (int64_t)ubytes, udims);
+    CHECK(ugot == un, "unaligned rgb24 decode emits every frame");
+    CHECK(udims[0] == UH && udims[1] == UW, "unaligned decoded geometry");
+    bool ucanary_ok = true;
+    for (size_t i = 0; i < canary; ++i)
+      if (uout[ubytes + i] != 0xAB) ucanary_ok = false;
+    CHECK(ucanary_ok, "unaligned rgb24 decode stays inside its buffer");
+    bool uids_ok = true;
+    for (int i = 0; i < UN; ++i) {
+      long sum = 0;
+      const uint8_t* fr = uout.data() + (size_t)i * UW * UH * 3;
+      for (int p = 0; p < UW * UH; ++p) sum += fr[3 * p];
+      if ((int)((sum / (UW * UH) + 8) / 16) % 14 !=
+          (i * 16 % 224 + 8) / 16 % 14)
+        uids_ok = false;
+    }
+    CHECK(uids_ok, "unaligned rgb24 frames carry the right content");
+    // yuv420 wire path exercises the planar copy/scratch flavor
+    scvid_decoder_reset(udec);
+    scvid_decoder_set_output_format(udec, 1);
+    const int64_t ch = (UH + 1) / 2, cw = (UW + 1) / 2;
+    const size_t ybytes = (size_t)un * (UW * UH + 2 * ch * cw);
+    std::vector<uint8_t> yout(ybytes + canary);
+    memset(yout.data() + ybytes, 0xCD, canary);
+    int64_t ygot = scvid_decode_run(udec, uall.data(), uall_sizes.data(),
+                                    un, uwant.data(), un, 1, yout.data(),
+                                    (int64_t)ybytes, udims);
+    CHECK(ygot == un, "unaligned yuv420 decode emits every frame");
+    bool ycanary_ok = true;
+    for (size_t i = 0; i < canary; ++i)
+      if (yout[ybytes + i] != 0xCD) ycanary_ok = false;
+    CHECK(ycanary_ok, "unaligned yuv420 decode stays inside its buffer");
+    scvid_decoder_destroy(udec);
+    scvid_index_free(uidx);
+    remove(ump4);
+    remove(upkts);
+  }
+
   printf("all native checks passed\n");
   return 0;
 }

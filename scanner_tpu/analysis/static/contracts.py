@@ -35,6 +35,13 @@ passes make each one checkable:
          actually registers, and the marker-delimited efficiency table
          in docs/observability.md (`efficiency-series:begin/end`) may
          not drift — all three pairings, both directions
+  SC310  frame-cache contract drift (engine/framecache.py): the
+         FRAMECACHE_SERIES tuple, the series the module actually
+         registers, and the marker-delimited table in
+         docs/observability.md (`framecache-series:begin/end`) may not
+         drift (all pairings, both directions); and the `[perf]`
+         frame_cache_* config keys config.default_config() declares
+         must be exactly framecache.CONFIG_KEYS (both directions)
 """
 
 from __future__ import annotations
@@ -311,6 +318,8 @@ class ContractPass(AnalysisPass):
         "SC308": "alert-rule drift (DEFAULT_RULES vs docs vs [alerts])",
         "SC309": "cost-model / efficiency-series drift (kernel cost "
                  "hooks, EFFICIENCY_SERIES, docs efficiency table)",
+        "SC310": "frame-cache contract drift (FRAMECACHE_SERIES, docs "
+                 "framecache table, [perf] frame_cache_* config keys)",
     }
 
     def run(self, project: Project) -> List[Finding]:
@@ -322,6 +331,7 @@ class ContractPass(AnalysisPass):
         out.extend(self._rpc_surface(project))
         out.extend(self._alert_rules(project))
         out.extend(self._cost_model(project))
+        out.extend(self._frame_cache(project))
         return out
 
     # -- SC301 / SC302 ---------------------------------------------------
@@ -740,6 +750,100 @@ class ContractPass(AnalysisPass):
                         "EFFICIENCY_SERIES has no such series",
                 path="docs/observability.md", line=1, scope="",
                 snippet=name))
+        return out
+
+    # -- SC310 -----------------------------------------------------------
+
+    _FC_DOC_BLOCK_RE = re.compile(
+        r"<!--\s*framecache-series:begin\s*-->(.*?)"
+        r"<!--\s*framecache-series:end\s*-->", re.S)
+
+    def _frame_cache(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        fmod = project.module("engine/framecache.py")
+        if fmod is None:
+            return out
+        declared = _module_tuple(fmod, "FRAMECACHE_SERIES")
+        if declared is not None:
+            declared_set = set(declared)
+            registered = {r.name for r in _metric_registrations(fmod)
+                          if r.name}
+            for name in sorted(registered - declared_set):
+                out.append(fmod.finding(
+                    "SC310",
+                    f"series `{name}` is registered in framecache but "
+                    "missing from FRAMECACHE_SERIES — the SC310 catalog "
+                    "contract cannot see it", fmod.tree))
+            for name in sorted(declared_set - registered):
+                out.append(fmod.finding(
+                    "SC310",
+                    f"FRAMECACHE_SERIES names `{name}` but framecache "
+                    "registers no such series", fmod.tree))
+            doc = _read_doc(project, "observability.md")
+            if doc:
+                block = self._FC_DOC_BLOCK_RE.search(doc)
+                if block is None:
+                    out.append(fmod.finding(
+                        "SC310",
+                        "framecache declares FRAMECACHE_SERIES but "
+                        "docs/observability.md has no framecache-series "
+                        "marker table (<!-- framecache-series:begin/end "
+                        "-->)", fmod.tree))
+                else:
+                    doc_names = {n for n in
+                                 _SERIES_RE.findall(block.group(1))}
+                    base_doc = set()
+                    for n in doc_names:
+                        for suf in _EXPOSITION_SUFFIXES:
+                            if n.endswith(suf) \
+                                    and n[:-len(suf)] in doc_names:
+                                break
+                        else:
+                            base_doc.add(n)
+                    for name in sorted(declared_set - base_doc):
+                        out.append(fmod.finding(
+                            "SC310",
+                            f"frame-cache series `{name}` is missing "
+                            "from the docs/observability.md "
+                            "framecache-series table", fmod.tree))
+                    for name in sorted(base_doc - declared_set):
+                        out.append(Finding(
+                            code="SC310",
+                            message=f"docs/observability.md "
+                                    f"framecache-series table lists "
+                                    f"`{name}` but framecache's "
+                                    "FRAMECACHE_SERIES has no such "
+                                    "series",
+                            path="docs/observability.md", line=1,
+                            scope="", snippet=name))
+        # [perf] frame_cache_* config keys <-> framecache.CONFIG_KEYS,
+        # both directions (the SC308 [alerts] pattern): a declared key
+        # the cache never reads is dead config; an accepted key config
+        # doesn't declare is unreachable
+        schema = _module_tuple(fmod, "CONFIG_KEYS")
+        cfg_mod = None
+        for m in project.modules:
+            if m.relpath.endswith("config.py") \
+                    and _default_config_keys(m):
+                cfg_mod = m
+                break
+        if schema is not None and cfg_mod is not None:
+            perf_keys = {k for sec, k in _default_config_keys(cfg_mod)
+                         if sec == "perf"
+                         and k.startswith("frame_cache")}
+            if perf_keys or schema:
+                for k in sorted(perf_keys - set(schema)):
+                    out.append(cfg_mod.finding(
+                        "SC310",
+                        f"config key `[perf] {k}` is declared but "
+                        "framecache.CONFIG_KEYS does not accept it",
+                        cfg_mod.tree))
+                for k in sorted(set(schema) - perf_keys):
+                    out.append(fmod.finding(
+                        "SC310",
+                        f"framecache.CONFIG_KEYS accepts `{k}` but "
+                        "config.default_config() declares no "
+                        f"`[perf] {k}`", fmod.tree))
         return out
 
     # -- SC306 / SC307 ---------------------------------------------------

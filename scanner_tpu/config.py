@@ -44,6 +44,20 @@ def default_config() -> Dict[str, Any]:
             # default) disables; SCANNER_TPU_COMPILATION_CACHE overrides
             # per process.
             "compilation_cache_dir": "",
+            # paged per-device HBM frame cache (engine/framecache.py):
+            # decoded frames are pooled in keyframe-aligned pages and
+            # reused across tasks (stencil overlap, Gather samplings,
+            # hot clips) instead of re-decoding + re-staging.  On by
+            # default; SCANNER_TPU_FRAME_CACHE=0 overrides per process.
+            "frame_cache_enabled": True,
+            # per-device capacity target in MB (LRU-evicted past it; a
+            # firing hbm_pressure alert shrinks it further);
+            # SCANNER_TPU_FRAME_CACHE_MB overrides per process.
+            "frame_cache_mb": 256,
+            # frames per cache page; 0 (the default) auto-derives the
+            # smallest keyframe-interval multiple >= 32 so pages map
+            # onto GOP-decodable units.
+            "frame_cache_page_frames": 0,
         },
         "memory": {
             # memory observability (util/memstats.py): per-device HBM
@@ -146,6 +160,26 @@ class Config:
         disabled (the default)."""
         d = self.config.get("perf", {}).get("compilation_cache_dir", "")
         return d or None
+
+    @property
+    def frame_cache_enabled(self) -> bool:
+        """Paged per-device HBM frame cache (the deployment default;
+        SCANNER_TPU_FRAME_CACHE overrides per process)."""
+        return bool(self.config.get("perf", {}).get(
+            "frame_cache_enabled", True))
+
+    @property
+    def frame_cache_mb(self) -> int:
+        """Per-device frame-cache capacity target in MB
+        (SCANNER_TPU_FRAME_CACHE_MB overrides per process)."""
+        return int(self.config.get("perf", {}).get("frame_cache_mb",
+                                                   256))
+
+    @property
+    def frame_cache_page_frames(self) -> int:
+        """Frames per frame-cache page (0 = keyframe-aligned auto)."""
+        return int(self.config.get("perf", {}).get(
+            "frame_cache_page_frames", 0))
 
     @property
     def memstats_enabled(self) -> bool:

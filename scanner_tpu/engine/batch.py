@@ -53,6 +53,35 @@ _M_D2H_SECONDS = _mx.registry().counter(
     "Seconds spent blocking on device->host fetches.")
 
 
+def staged_device_put(host: "np.ndarray", device, kind: str,
+                      fault_detail: str):
+    """The ONE engine host->device staging contract: the
+    memory.pressure fault site, RESOURCE_EXHAUSTED forensics
+    (site=staging), the shared h2d byte/second meters, and an
+    allocation-ledger registration under `kind`.  Used by to_device
+    AND the frame cache's fresh-row staging (engine/framecache.py), so
+    the chaos/forensics/metering behavior of the two paths can never
+    drift — and a cache-on/off A/B of `scanner_tpu_h2d_bytes_total`
+    bills the same meter on both sides."""
+    import jax
+    t0 = time.time()
+    lbl = _ms.device_label(device)
+    try:
+        if _faults.ACTIVE:
+            _faults.inject("memory.pressure", detail=fault_detail)
+        data = jax.device_put(host, device)
+    except Exception as e:
+        if _ms.is_oom(e):
+            _ms.note_oom(e, site="staging",
+                         detail=f"h2d {host.nbytes} bytes -> {lbl}")
+        raise
+    _M_H2D_SECONDS.inc(time.time() - t0)
+    _M_H2D_BYTES.inc(host.nbytes)
+    _ms.track_array(data, kind,
+                    device=lbl if device is not None else None)
+    return data
+
+
 def _is_jax(x) -> bool:
     # cheap structural check that avoids importing jax for pure-host runs
     return type(x).__module__.startswith("jax")
@@ -214,30 +243,13 @@ class ColumnBatch:
         A convert-marked batch ships its WIRE format (that is the point:
         1.5 B/px over the link, convert on device via converted())."""
         if isinstance(self.data, np.ndarray):
-            import jax
-            t0 = time.time()
-            lbl = _ms.device_label(device)
-            try:
-                # the memory.pressure fault site lives INSIDE the guard:
-                # an injected DeviceOutOfMemory takes the same forensics
-                # path a real RESOURCE_EXHAUSTED from device_put would
-                if _faults.ACTIVE:
-                    _faults.inject(
-                        "memory.pressure",
-                        detail=f"h2d:{lbl}:{self.data.nbytes}")
-                data = jax.device_put(self.data, device)
-            except Exception as e:
-                if _ms.is_oom(e):
-                    _ms.note_oom(e, site="staging",
-                                 detail=f"h2d {self.data.nbytes} bytes "
-                                        f"-> {lbl}")
-                raise
-            _M_H2D_SECONDS.inc(time.time() - t0)
-            _M_H2D_BYTES.inc(self.data.nbytes)
-            # allocation ledger: this staged batch is an engine-owned
-            # device buffer; released when the device array is collected
-            _ms.track_array(data, "staging",
-                            device=lbl if device is not None else None)
+            # the full staging contract — fault site, OOM forensics,
+            # h2d meters, ledger registration — lives in ONE place
+            # shared with the frame cache's staging path
+            data = staged_device_put(
+                self.data, device, "staging",
+                fault_detail=f"h2d:{_ms.device_label(device)}:"
+                             f"{self.data.nbytes}")
             return ColumnBatch(self.rows, data,
                                self.nulls, convert=self.convert)
         if device is not None and _is_jax(self.data):

@@ -109,6 +109,15 @@ class Client:
             if not os.environ.get("SCANNER_TPU_MEMSTATS"):
                 memstats.set_enabled(cfg.memstats_enabled)
             memstats.set_report_top_n(cfg.memstats_report_top_n)
+            # [perf] frame_cache_*: the paged HBM frame cache's
+            # deployment defaults; the SCANNER_TPU_FRAME_CACHE* env
+            # vars (read at import) win when set
+            from .framecache import (set_capacity_mb, set_enabled,
+                                     set_page_frames)
+            if not os.environ.get("SCANNER_TPU_FRAME_CACHE"):
+                set_enabled(cfg.frame_cache_enabled)
+            set_capacity_mb(cfg.frame_cache_mb)
+            set_page_frames(cfg.frame_cache_page_frames)
             # [alerts] section: health/SLO engine default + user rules;
             # the SCANNER_TPU_HEALTH env var (read at import) wins
             from ..util import health as _health_cfg
@@ -171,6 +180,7 @@ class Client:
             from ..util import coststats as _coststats
             from ..util import health as _health_st
             from ..util import memstats as _memstats
+            from . import framecache as _framecache
             self._metrics_server = MetricsServer(
                 port=metrics_port,
                 statusz=lambda: {"role": "client",
@@ -179,6 +189,8 @@ class Client:
                                                None),
                                  "health": _health_st.status_dict(),
                                  "memory": _memstats.status_dict(),
+                                 "framecache":
+                                     _framecache.status_dict(),
                                  "efficiency":
                                      _coststats.status_dict()},
                 healthz=lambda: {"role": "client"})

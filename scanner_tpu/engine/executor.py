@@ -39,6 +39,7 @@ from ..util import metrics as _mx
 from ..util import tracing as _tr
 from ..util.log import get_logger
 from ..util.profiler import Profiler
+from . import framecache as _fc
 from .batch import ColumnBatch, concat_batches
 from .evaluate import TaskEvaluator
 
@@ -163,6 +164,11 @@ class TaskItem:
     chunk_plans: Optional[List[A.TaskPlan]] = None
     chunk_q: Optional["queue.Queue"] = None
     chunk_abort: Optional[threading.Event] = None
+    # frame-cache page leases (engine/framecache.py): pages this task
+    # gathers from stay pinned — ineligible for eviction — until
+    # evaluation finishes (released by the executor; a finalizer on
+    # this TaskItem is the abort backstop)
+    cache_leases: Optional[List[Any]] = None
 
 
 class _StatefulChain:
@@ -247,6 +253,13 @@ class LocalExecutor:
         self.tracer = _tr.default_tracer()
         # trace_id of the last local run (Client.trace reads it)
         self.last_trace_id: Optional[str] = None
+        # frame-cache source identity: table ids are per-database and
+        # restart at 0 (and a database re-created at the same root
+        # would restart them too), so pages are keyed under a
+        # per-backend-object (root, seq) identity — no two Database
+        # objects in one process can ever alias each other's pages
+        # (engine/framecache.py db_cache_key)
+        self._cache_db_key = _fc.db_cache_key(db.backend)
 
     # ------------------------------------------------------------------
     # Job-set preparation (reference master.cpp:1367 process_job admission)
@@ -726,9 +739,11 @@ class LocalExecutor:
             # drop the failed attempt's staged columns/results NOW: a
             # task requeued after memory pressure must not keep holding
             # the very device buffers that caused it (the ledger
-            # releases as the arrays are collected)
+            # releases as the arrays are collected; cache pins likewise
+            # must not outlive the attempt)
             w.elements = None
             w.results = None
+            self._release_cache(w)
             if on_task_error is not None and on_task_error(w, e):
                 return
             _log.exception("task (%d,%d) failed; aborting pipeline",
@@ -835,6 +850,9 @@ class LocalExecutor:
                         if on_start is not None and on_start(w) is False:
                             if w.chunk_abort is not None:
                                 w.chunk_abort.set()  # unblock the loader
+                            # leases the producing loader adds after
+                            # this are released by its abort path
+                            self._release_cache(w)
                             self._task_trace_end(w, status="revoked")
                             continue  # revoked attempt: drop silently
                         t0 = time.time()
@@ -859,6 +877,10 @@ class LocalExecutor:
                         _M_DEV_TASKS.labels(device=lbl).inc()
                         _M_DEV_BUSY.labels(device=lbl).inc(dt)
                         w.elements = None
+                        # evaluation is done with the cached pages:
+                        # unpin them (the sink batches are the task's
+                        # own arrays, never cache pages)
+                        self._release_cache(w)
                     except Exception as e:  # noqa: BLE001
                         task_failed(w, e)
                         continue
@@ -993,6 +1015,7 @@ class LocalExecutor:
                     with self._task_scope(w):
                         self.load_task(info, w, tls)
                     if on_start is not None and on_start(w) is False:
+                        self._release_cache(w)
                         self._task_trace_end(w, status="revoked")
                         continue  # revoked attempt
                     t0 = time.time()
@@ -1020,6 +1043,7 @@ class LocalExecutor:
                     _M_DEV_TASKS.labels(device=lbl).inc()
                     _M_DEV_BUSY.labels(device=lbl).inc(dt)
                     w.elements = None
+                    self._release_cache(w)
                 except Exception as e:  # noqa: BLE001
                     if w.trace_span is not None:
                         w.trace_span.add_event(
@@ -1028,6 +1052,7 @@ class LocalExecutor:
                     self._task_trace_end(w, status="error")
                     w.elements = None
                     w.results = None
+                    self._release_cache(w)
                     if on_task_error is not None and on_task_error(w, e):
                         continue
                     raise
@@ -1087,7 +1112,7 @@ class LocalExecutor:
 
         def __init__(self, ex: "LocalExecutor", w: TaskItem, tls,
                      node_id: int, si, plans: List[A.TaskPlan],
-                     output_format: str):
+                     output_format: str, use_cache: bool = False):
             desc = si["table"]
             all_rows = np.unique(np.concatenate([
                 np.asarray(p.source_rows[node_id], np.int64)
@@ -1116,24 +1141,51 @@ class LocalExecutor:
             # (items of one table may differ — same rule as the
             # whole-task loader's per-item marks)
             item = desc.item_of_row(int(all_rows[0]))
-            item_start, _ = desc.item_bounds(item)
+            item_start, item_end = desc.item_bounds(item)
+            self._item_start = int(item_start)
             auto = ex._automata(tls, w.job, node_id, si, item,
                                 output_format=output_format)
             self.convert = (("yuv420", auto.vd.height, auto.vd.width)
                             if output_format == "yuv420" else None)
+            self._hw = (auto.vd.height, auto.vd.width)
+
+            # frame cache (engine/framecache.py): one plan for the
+            # whole task's rows, pinned up front — the decode stream
+            # then covers only the misses, and each chunk assembles as
+            # a page gather + a staging copy of its fresh rows
+            self._plan = None
+            self._cache = None
+            decode_rows = all_rows
+            if use_cache:
+                cache = _fc.cache()
+                plan = cache.plan(
+                    w.device, (ex._cache_db_key, desc.id), si["column"],
+                    item, output_format, all_rows - item_start,
+                    total_rows=item_end - item_start,
+                    keyint=ex._keyint_of(si))
+                _fc.attach_lease(w, plan.lease)
+                self._plan = plan
+                self._cache = cache
+                self._miss = set((plan.miss_rows
+                                  + item_start).tolist())
+                decode_rows = plan.miss_rows + item_start
 
             def gen():
                 for rr, fr in auto.stream_frames(
-                        (all_rows - item_start).tolist(),
+                        (decode_rows - item_start).tolist(),
                         packets_per_call=wp_est,
                         max_frames_per_yield=wp_est):
                     yield rr + item_start, fr
 
-            self._gen = gen()
+            self._gen = gen() if len(decode_rows) else iter(())
 
         def batch_for(self, rows: Sequence[int]) -> ColumnBatch:
             rows_arr = np.asarray(rows, np.int64)
-            need = set(rows_arr.tolist()) - self._buf.keys()
+            if self._plan is None:
+                need = set(rows_arr.tolist()) - self._buf.keys()
+            else:
+                need = (set(rows_arr.tolist()) & self._miss) \
+                    - self._buf.keys()
             t0 = time.time()
             decoded = 0
             while need:
@@ -1146,8 +1198,21 @@ class LocalExecutor:
                 lbl = threading.current_thread().name
                 _M_DECODED.labels(loader=lbl).inc(decoded)
                 _M_DECODE_SECONDS.labels(loader=lbl).inc(time.time() - t0)
-            data = np.stack([self._buf[int(r)] for r in rows_arr]) \
-                if len(rows_arr) else np.zeros((0,), np.uint8)
+            if self._plan is None:
+                data = np.stack([self._buf[int(r)] for r in rows_arr]) \
+                    if len(rows_arr) else np.zeros((0,), np.uint8)
+            else:
+                # page-gather assembly: fresh (miss) rows of this chunk
+                # feed page completion and stage once; resident rows
+                # gather from the pinned pages on this task's chip
+                fresh_g = sorted(set(rows_arr.tolist()) & self._miss)
+                fresh_local = np.asarray(fresh_g, np.int64) \
+                    - self._item_start
+                fresh_data = (np.stack([self._buf[r] for r in fresh_g])
+                              if fresh_g else np.zeros((0, 1), np.uint8))
+                data = self._cache.assemble_rows(
+                    self._plan, rows_arr - self._item_start,
+                    fresh_local, fresh_data, hw=self._hw)
             keep_from = self._keep_from[self._chunk_i]
             self._chunk_i += 1
             for r in [r for r in self._buf if r < keep_from]:
@@ -1164,8 +1229,9 @@ class LocalExecutor:
             if si.get("is_video") and "custom" not in si:
                 fmt = ("yuv420" if self._yuv_device_wire(info, nid)
                        else "rgb24")
-                feeds[nid] = self._VideoFeed(self, w, tls, nid, si,
-                                             w.chunk_plans, fmt)
+                feeds[nid] = self._VideoFeed(
+                    self, w, tls, nid, si, w.chunk_plans, fmt,
+                    use_cache=self._cache_eligible(info, nid))
         for plan in w.chunk_plans:
             elements: Dict[int, ColumnBatch] = {}
             t0 = time.time()
@@ -1204,6 +1270,14 @@ class LocalExecutor:
             self._chunk_put(w, _CHUNK_DONE, stop)
         except Exception as e:  # noqa: BLE001 — surfaces on the consumer
             self._chunk_put(w, (_CHUNK_ERR, e), stop)
+        finally:
+            # aborted task (consumer failure/revoke, pipeline stop):
+            # unpin frame-cache pages HERE — production has ended, so
+            # no later append races this release; the consumer's own
+            # release paths cover the normal completion order
+            if (w.chunk_abort is not None and w.chunk_abort.is_set()) \
+                    or (stop is not None and stop.is_set()):
+                self._release_cache(w)
 
     def _consume_iter(self, info: A.GraphInfo, te, w: TaskItem,
                       chunk_iter, fb_tls) -> Dict[int, ColumnBatch]:
@@ -1276,9 +1350,12 @@ class LocalExecutor:
                 info, w.job.jr, plan.output_range,
                 job_idx=w.job.job_idx, task_idx=w.task_idx)
             tmp = TaskItem(w.job, w.task_idx, plan.output_range,
-                           plan=plan2)
-            elements2 = self._load_sources(info, tmp, fb_tls)
-            return te.execute_task(w.job.jr, plan2, elements2)
+                           plan=plan2, device=w.device)
+            try:
+                elements2 = self._load_sources(info, tmp, fb_tls)
+                return te.execute_task(w.job.jr, plan2, elements2)
+            finally:
+                self._release_cache(tmp)
 
     def _evaluate_with_fallback(self, info: A.GraphInfo, te, w: TaskItem,
                                 fb_tls):
@@ -1480,6 +1557,14 @@ class LocalExecutor:
                 # util/image.cu:22).  SCANNER_TPU_YUV_DEVICE=0 opts out.
                 fmt = ("yuv420" if self._yuv_device_wire(info, node_id)
                        else "rgb24")
+                # paged frame cache (engine/framecache.py): rows already
+                # resident in HBM pages on this task's chip skip decode
+                # AND the np->device copy; only miss ranges decode
+                cached = self._load_video_cached(info, w, node_id, si,
+                                                 rows_l, fmt, tls)
+                if cached is not None:
+                    out[node_id] = cached
+                    continue
                 by_item: Dict[int, List[int]] = {}
                 for r in rows_l:
                     it = desc.item_of_row(r)
@@ -1524,6 +1609,90 @@ class LocalExecutor:
         codec = si.get("codec", "raw")
         return ColumnBatch.from_elements(
             rows_arr, [decode_element(v, codec) for v in vals])
+
+    def _cache_eligible(self, info: A.GraphInfo, node_id: int) -> bool:
+        """Frame-cache eligibility for one video column: the cache is
+        an HBM pool, so only device-staged columns qualify — and only
+        when the kill switch is up (SCANNER_TPU_FRAME_CACHE=0 /
+        [perf] frame_cache_enabled)."""
+        from .evaluate import _device_staging_enabled
+        return _fc.enabled() and _device_staging_enabled() \
+            and self._column_device_bound(info, node_id)
+
+    @staticmethod
+    def _keyint_of(si) -> int:
+        """Keyframe-interval estimate for page sizing (pages should map
+        onto GOP-decodable units); 0 = unknown."""
+        vd = si.get("video_meta")
+        ki = getattr(vd, "keyframe_indices", None) if vd is not None \
+            else None
+        if ki is not None and len(ki) > 1:
+            return int(np.median(np.diff(np.asarray(ki, np.int64))))
+        return 0
+
+    def _load_video_cached(self, info: A.GraphInfo, w: TaskItem,
+                           node_id: int, si, rows_l: List[int], fmt: str,
+                           tls) -> Optional[ColumnBatch]:
+        """The cache-consulting flavor of the whole-task video load:
+        plan (pin resident pages on this task's chip), decode only the
+        miss rows, offer them toward page completion, and assemble the
+        task's column as a page-table gather.  None = ineligible or
+        bypassed — the caller runs the direct decode+stage path."""
+        if not self._cache_eligible(info, node_id) or not rows_l:
+            return None
+        desc = si["table"]
+        items = {desc.item_of_row(int(r)) for r in rows_l}
+        if len(items) != 1:
+            # per-item geometry may differ (the ragged-concat path);
+            # pages are per-item, so a multi-item task stays direct
+            return None
+        item = items.pop()
+        start, end = desc.item_bounds(item)
+        local = np.asarray(rows_l, np.int64) - start
+        cache = _fc.cache()
+        plan = cache.plan(w.device, (self._cache_db_key, desc.id),
+                          si["column"], item, fmt, local,
+                          total_rows=end - start,
+                          keyint=self._keyint_of(si))
+        # pin BEFORE decoding: a decode failure routes through
+        # task_failed -> _release_cache, and the finalizer backstops
+        _fc.attach_lease(w, plan.lease)
+        miss = plan.miss_rows
+        hw = plan.hw
+        if len(miss):
+            auto = self._automata(tls, w.job, node_id, si, item,
+                                  output_format=fmt)
+            t0 = time.time()
+            frames = auto.get_frames(miss.tolist())
+            lbl = threading.current_thread().name
+            _M_DECODED.labels(loader=lbl).inc(len(miss))
+            _M_DECODE_SECONDS.labels(loader=lbl).inc(time.time() - t0)
+            hw = (auto.vd.height, auto.vd.width)
+        else:
+            frames = np.zeros((0, 1), np.uint8)
+        if fmt == "yuv420" and not (hw and hw[0]):
+            return None  # no geometry for the convert mark: bypass
+        try:
+            data = cache.assemble(plan, miss, frames, hw=hw)
+        except _fc.CacheBypass:
+            # falling back here re-decodes the miss rows on the direct
+            # path (double decode for this one task).  Acceptable: a
+            # bypass after plan() requires a pinned hit row to vanish,
+            # which pinning exists to prevent — this is a correctness
+            # backstop, not a path with a cost budget.
+            return None
+        convert = (("yuv420", hw[0], hw[1]) if fmt == "yuv420" else None)
+        return ColumnBatch(np.asarray(rows_l, np.int64), data,
+                           convert=convert)
+
+    def _release_cache(self, w: TaskItem) -> None:
+        """Unpin the task's frame-cache pages (evaluation is done with
+        them, or the task failed/was revoked).  Idempotent; leases a
+        dropped TaskItem never reaches release on are backstopped by
+        the finalizer attach_lease installed."""
+        leases, w.cache_leases = w.cache_leases, None
+        for lease in leases or ():
+            lease.release()
 
     def _automata(self, tls, job: JobContext, node_id: int, si,
                   item: int = 0, output_format: str = "rgb24"):

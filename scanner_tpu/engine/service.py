@@ -41,6 +41,7 @@ from ..util import tracing as _tracing
 from ..util.log import get_logger
 from ..util.metrics import MetricsServer, merge_snapshots
 from ..util.profiler import Profiler
+from . import framecache as _framecache
 from . import rpc
 from .evaluate import TaskEvaluator
 from .executor import _M_TASK_LATENCY, LocalExecutor, TaskItem
@@ -933,6 +934,10 @@ class Master:
                 # GetMemoryReport
                 "memory": dict(_memstats.status_dict(),
                                worker_reports=mem_reports),
+                # the Frame-cache panel: per-device page pool occupancy
+                # and hit rates (engine/framecache.py; a bare master
+                # usually has none — workers hold the pages)
+                "framecache": _framecache.status_dict(),
                 # the Efficiency panel: roofline table + compile-ledger
                 # summary (util/coststats.py; a bare master usually has
                 # none — workers carry the kernel calls)
@@ -1818,6 +1823,8 @@ class Worker:
             "health": _health.status_dict(),
             # the Memory panel: per-device HBM + allocation-ledger view
             "memory": _memstats.status_dict(),
+            # the Frame-cache panel: page pool occupancy + hit rates
+            "framecache": _framecache.status_dict(),
             # the Efficiency panel: per-op roofline + compile ledger
             "efficiency": _coststats.status_dict(),
         }

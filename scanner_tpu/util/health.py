@@ -371,6 +371,12 @@ class HealthEngine:
         self._stop = threading.Event()
         self._last_tick = 0.0
         self._tracer: Optional[Any] = None
+        # alert-transition listeners: the alerts -> actuation seam
+        # (ROADMAP item 5).  Called OUTSIDE the state lock with each
+        # transition dict; an actuator (e.g. the frame cache's
+        # hbm_pressure shrink, engine/framecache.py) reacts here
+        # instead of polling the firing list.
+        self._listeners: List[Callable[[dict], None]] = []
 
     # -- configuration ------------------------------------------------------
 
@@ -405,6 +411,21 @@ class HealthEngine:
         """Route alert transition instants to a specific component's
         flight recorder (a Worker's tracer labels them with its node)."""
         self._tracer = tracer
+
+    def add_listener(self, fn: Callable[[dict], None]) -> None:
+        """Register an alert-transition actuator (idempotent per
+        function object).  `fn` receives each transition dict
+        ({"state", "rule", "severity", "labels", "value"}) after the
+        metric/tracer side effects, outside the engine lock; exceptions
+        are swallowed (a broken actuator must not kill alerting)."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn: Callable[[dict], None]) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
 
     def rules(self) -> List[AlertRule]:
         with self._lock:
@@ -741,6 +762,16 @@ class HealthEngine:
             else:
                 _log.info("alert resolved: %s%s", t["rule"],
                           t["labels"] or "")
+        if transitions:
+            with self._lock:
+                listeners = list(self._listeners)
+            for fn in listeners:
+                for t in transitions:
+                    try:
+                        fn(t)
+                    except Exception:  # noqa: BLE001 — actuator bug
+                        # must not kill the alerting loop
+                        _log.exception("alert listener failed")
         return transitions
 
     def tick(self, now: Optional[float] = None) -> List[dict]:
@@ -859,6 +890,16 @@ def set_interval(seconds: float) -> None:
 
 def set_tracer(tracer: Any) -> None:
     engine().set_tracer(tracer)
+
+
+def add_listener(fn: Callable[[dict], None]) -> None:
+    """Register an alert-transition actuator with the process engine
+    (see HealthEngine.add_listener)."""
+    engine().add_listener(fn)
+
+
+def remove_listener(fn: Callable[[dict], None]) -> None:
+    engine().remove_listener(fn)
 
 
 def _quiet(extra_enabled: bool) -> Dict[str, Any]:

@@ -104,8 +104,8 @@ _M_LEDGER_RELEASES = _mx.registry().counter(
 _M_OOM = _mx.registry().counter(
     "scanner_tpu_device_oom_events_total",
     "RESOURCE_EXHAUSTED events observed at engine staging/dispatch "
-    "sites (real device OOMs, or memory.pressure fault injections), "
-    "by site.",
+    "sites and the absorbed frame-cache page-build site (real device "
+    "OOMs, or memory.pressure fault injections), by site.",
     labels=["site"])
 
 

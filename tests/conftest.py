@@ -33,8 +33,12 @@ def ledger_leak_guard():
     leaked = []
     for _ in range(4):
         gc.collect()
+        # kind=cache entries are the frame cache's resident pages and
+        # fill fragments (engine/framecache.py): pool-owned memory with
+        # its own LRU/pressure eviction — deliberate residency, not a
+        # staging leak
         leaked = [e for e in memstats.entries()
-                  if e["id"] not in before]
+                  if e["id"] not in before and e["kind"] != "cache"]
         if not leaked:
             break
     assert not leaked, (

@@ -560,6 +560,112 @@ def test_cost_model_missing_marker_table(tmp_path):
     assert any("marker" in m for m in msgs)
 
 
+def _framecache_repo(tmp_path,
+                     declared=("scanner_tpu_framecache_a",
+                               "scanner_tpu_framecache_b"),
+                     registered=("scanner_tpu_framecache_a",
+                                 "scanner_tpu_framecache_b"),
+                     doc_series=("scanner_tpu_framecache_a",
+                                 "scanner_tpu_framecache_b"),
+                     cfg_keys=("frame_cache_enabled", "frame_cache_mb"),
+                     schema_keys=("frame_cache_enabled",
+                                  "frame_cache_mb"),
+                     with_markers=True):
+    """Synthetic mini-repo for the SC310 frame-cache contract lints."""
+    _write(tmp_path, "setup.py", "# root marker\n")
+    regs = "\n        ".join(
+        f'_G{i} = _mx.registry().counter("{n}", "help text", '
+        f'labels=["device"])' for i, n in enumerate(registered))
+    decl = ", ".join(f'"{n}"' for n in declared)
+    schema = ", ".join(f'"{k}"' for k in schema_keys)
+    _write(tmp_path, "pkg/engine/framecache.py", f"""
+        from ..util import metrics as _mx
+
+        {regs}
+
+        FRAMECACHE_SERIES = ({decl},)
+
+        CONFIG_KEYS = ({schema},)
+    """)
+    _write(tmp_path, "pkg/util/metrics.py", """
+        def registry():
+            return None
+    """)
+    cfg = ", ".join(f'"{k}": 1' for k in cfg_keys)
+    _write(tmp_path, "pkg/config.py", f"""
+        def default_config():
+            return {{"perf": {{{cfg}}}}}
+    """)
+    rows = "\n".join(f"| `{n}` | counter | x |" for n in doc_series)
+    table = (f"<!-- framecache-series:begin -->\n"
+             f"| Series | Type | Meaning |\n|---|---|---|\n"
+             f"{rows}\n<!-- framecache-series:end -->\n"
+             if with_markers else rows)
+    all_series = sorted(set(declared) | set(registered) | set(doc_series))
+    keys = " ".join(f"`{k}`"
+                    for k in sorted(set(cfg_keys) | set(schema_keys)))
+    _write(tmp_path, "docs/observability.md", f"""
+        Catalog (every fixture series mentioned so SC301 stays quiet):
+        {" ".join(f"`{n}`" for n in all_series)}
+
+        Config keys documented for SC304: {keys}
+
+        {table}
+    """)
+    return tmp_path
+
+
+def test_framecache_clean_fixture_is_quiet(tmp_path):
+    _framecache_repo(tmp_path)
+    _, findings = _analyze(tmp_path, "pkg")
+    assert [f for f in findings if f.code == "SC310"] == []
+
+
+def test_framecache_series_all_pairings_both_directions(tmp_path):
+    _framecache_repo(
+        tmp_path,
+        declared=("scanner_tpu_framecache_a",
+                  "scanner_tpu_framecache_phantom"),
+        registered=("scanner_tpu_framecache_a",
+                    "scanner_tpu_framecache_unlisted"),
+        doc_series=("scanner_tpu_framecache_a",
+                    "scanner_tpu_framecache_ghost"))
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC310"]
+    assert any("scanner_tpu_framecache_unlisted" in m
+               and "missing from FRAMECACHE_SERIES" in m for m in msgs)
+    assert any("scanner_tpu_framecache_phantom" in m
+               and "registers no such series" in m for m in msgs)
+    assert any("scanner_tpu_framecache_phantom" in m
+               and "missing from the" in m for m in msgs)
+    assert any("scanner_tpu_framecache_ghost" in m
+               and "no such series" in m for m in msgs)
+    assert not any("`scanner_tpu_framecache_a`" in m for m in msgs)
+
+
+def test_framecache_missing_marker_table(tmp_path):
+    _framecache_repo(tmp_path, with_markers=False)
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC310"]
+    assert any("marker table" in m for m in msgs)
+
+
+def test_framecache_config_schema_both_directions(tmp_path):
+    _framecache_repo(
+        tmp_path,
+        cfg_keys=("frame_cache_enabled", "frame_cache_mb",
+                  "frame_cache_bogus"),
+        schema_keys=("frame_cache_enabled", "frame_cache_mb",
+                     "frame_cache_pages"))
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC310"]
+    assert any("[perf] frame_cache_bogus" in m
+               and "does not accept" in m for m in msgs)
+    assert any("`frame_cache_pages`" in m and "declares no" in m
+               for m in msgs)
+    assert not any("frame_cache_enabled" in m for m in msgs)
+
+
 def test_contract_rpc_contracts_table_both_directions(tmp_path):
     _write(tmp_path, "setup.py", "# root\n")
     _write(tmp_path, "pkg/rpcmod.py", """

@@ -95,7 +95,8 @@ def detail_digest(bench_dir):
     except (OSError, ValueError):
         return {}
     out = {"fps_by_config": {}, "task_latency": {}, "health": {},
-           "op_efficiency": {}, "baseline_metrics": {}}
+           "op_efficiency": {}, "frame_cache": {},
+           "baseline_metrics": {}}
     for d in detail:
         if not isinstance(d, dict):
             continue
@@ -109,6 +110,9 @@ def detail_digest(bench_dir):
                             if k not in ("config", "rpc_latency")}
         elif d.get("config") in ("op_efficiency", "op_efficiency_hw"):
             out["op_efficiency"][d["config"]] = {
+                k: v for k, v in d.items() if k != "config"}
+        elif d.get("config") in ("frame_cache", "frame_cache_hw"):
+            out["frame_cache"][d["config"]] = {
                 k: v for k, v in d.items() if k != "config"}
         elif d.get("config") == "baseline_metrics":
             out["baseline_metrics"] = d.get("metrics") or {}
@@ -264,6 +268,14 @@ def main(argv=None) -> int:
             print(f"  compile: {comp.get('compiles', 0)} in "
                   f"{comp.get('compile_seconds', 0)}s, cache hit rate "
                   + (f"{hr:.0%}" if hr is not None else "n/a"))
+        fcd = (detail.get("frame_cache") or {}).get("frame_cache")
+        if fcd and fcd.get("enabled"):
+            hr = fcd.get("hit_rate")
+            print(f"  frame cache: hit rate "
+                  + (f"{hr:.0%}" if hr is not None else "n/a")
+                  + f", decode saved {fcd.get('decode_seconds_saved')}s"
+                  f", h2d saved "
+                  f"{(fcd.get('h2d_bytes_saved') or 0) / 1e6:.1f} MB")
         if base_metrics:
             print("  baselines: " + "  ".join(
                 f"{k}={v.get('value')}" for k, v in

@@ -158,6 +158,15 @@ def digest(snap: dict) -> dict:
             snap, "scanner_tpu_device_hbm_limit_bytes", node)
         d["dev_ledger"] = _per_device(
             snap, "scanner_tpu_ledger_live_bytes", node)
+        # paged frame cache (engine/framecache.py): resident page bytes
+        # and hit/miss counters per device — a hot-clip workload should
+        # show CACHE MB climbing and CHIT% approaching 100
+        d["dev_cache"] = _per_device(
+            snap, "scanner_tpu_framecache_live_bytes", node)
+        d["dev_cache_hits"] = _per_device(
+            snap, "scanner_tpu_framecache_hits_total", node)
+        d["dev_cache_misses"] = _per_device(
+            snap, "scanner_tpu_framecache_misses_total", node)
         # compute-efficiency plane (util/coststats.py): XLA compiles by
         # persistent-cache outcome, and the per-(op, device) roofline
         # verdict at the steady-state bucket
@@ -260,15 +269,22 @@ def render(status: dict, cur: dict, prev: dict, master: str,
             limit = (d.get("dev_hbm_limit") or {}).get(dev, 0.0)
             ledger = (d.get("dev_ledger") or {}).get(dev, 0.0)
             pct = f"{hbm / limit * 100:>5.1f}%" if limit else "    -"
+            cache_mb = (d.get("dev_cache") or {}).get(dev, 0.0) / 1e6
+            chits = (d.get("dev_cache_hits") or {}).get(dev, 0.0)
+            cmiss = (d.get("dev_cache_misses") or {}).get(dev, 0.0)
+            chit = f"{chits / (chits + cmiss) * 100:>5.1f}%" \
+                if chits + cmiss else "    -"
             dev_rows.append(
                 f"{node:10} {dev:>10} {tasks:>7.0f} {busy:>8.1f} "
                 f"{min(util, 1.0) * 100:>6.1f}% {hbm / 1e6:>9.1f} "
-                f"{pct:>6} {ledger / 1e6:>9.1f}")
+                f"{pct:>6} {ledger / 1e6:>9.1f} {cache_mb:>9.1f} "
+                f"{chit:>6}")
     if dev_rows:
         lines.append("")
         lines.append(f"{'NODE':10} {'DEVICE':>10} {'TASKS':>7} "
                      f"{'BUSY s':>8} {'UTIL':>7} {'HBM MB':>9} "
-                     f"{'HBM%':>6} {'LEDG MB':>9}")
+                     f"{'HBM%':>6} {'LEDG MB':>9} {'CACHE MB':>9} "
+                     f"{'CHIT%':>6}")
         lines.extend(dev_rows)
     # per-op roofline (util/coststats.py): EFF% against the device peak
     # for the binding resource, at the steady-state bucket — a slow op
@@ -344,10 +360,17 @@ def json_doc(status: dict, cur: dict, master: str,
                         (d.get("dev_hbm_limit") or {}).get(dev, 0.0),
                     "ledger_live_bytes":
                         (d.get("dev_ledger") or {}).get(dev, 0.0),
+                    "framecache_live_bytes":
+                        (d.get("dev_cache") or {}).get(dev, 0.0),
+                    "framecache_hits":
+                        (d.get("dev_cache_hits") or {}).get(dev, 0.0),
+                    "framecache_misses":
+                        (d.get("dev_cache_misses") or {}).get(dev, 0.0),
                 }
                 for dev in sorted(set(d.get("dev_tasks") or {})
                                   | set(d.get("dev_hbm") or {})
-                                  | set(d.get("dev_ledger") or {}))
+                                  | set(d.get("dev_ledger") or {})
+                                  | set(d.get("dev_cache") or {}))
             },
             # compute-efficiency plane: compile counts by cache outcome
             # (+ derived hit rate) and the per-op roofline rows the

@@ -398,6 +398,86 @@ print("EFF_DIGEST " + json.dumps(out))
 """
 
 
+_FRAMECACHE_AB = r"""
+import json, os, tempfile, time
+import jax
+from scanner_tpu import (CacheMode, Client, NamedStream, NamedVideoStream,
+                         PerfParams)
+import scanner_tpu.kernels
+from scanner_tpu import video as scv
+from scanner_tpu.engine import framecache
+from scanner_tpu.util.metrics import registry
+
+assert jax.devices()[0].platform == "tpu"
+root = tempfile.mkdtemp(prefix="fc_hw_")
+vid = os.path.join(root, "v.mp4")
+N = 384
+scv.synthesize_video(vid, num_frames=N, width=640, height=480, fps=24,
+                     keyint=32)
+sc = Client(db_path=os.path.join(root, "db"))
+sc.ingest_videos([("bench", vid)])
+
+def tot(name):
+    s = registry().snapshot().get(name, {})
+    return sum(x["value"] for x in s.get("samples", []))
+
+def run(name):
+    frames = sc.io.Input([NamedVideoStream(sc, "bench")])
+    out = NamedStream(sc, name)
+    d0, b0 = tot("scanner_tpu_decode_seconds_total"), \
+        tot("scanner_tpu_h2d_bytes_total")
+    t0 = time.time()
+    sc.run(sc.io.Output(sc.ops.Histogram(frame=frames), [out]),
+           PerfParams.manual(32, 96), cache_mode=CacheMode.Overwrite,
+           show_progress=False)
+    return {"fps": round(N / (time.time() - t0), 1),
+            "decode_s": round(
+                tot("scanner_tpu_decode_seconds_total") - d0, 3),
+            "h2d_bytes": tot("scanner_tpu_h2d_bytes_total") - b0}
+
+framecache.cache().clear()
+h0, m0 = tot("scanner_tpu_framecache_hits_total"), \
+    tot("scanner_tpu_framecache_misses_total")
+on_cold = run("fc_cold")
+h1, m1 = tot("scanner_tpu_framecache_hits_total"), \
+    tot("scanner_tpu_framecache_misses_total")
+on_warm = run("fc_warm")
+hits = tot("scanner_tpu_framecache_hits_total") - h0
+misses = tot("scanner_tpu_framecache_misses_total") - m0
+wh = tot("scanner_tpu_framecache_hits_total") - h1
+wm = tot("scanner_tpu_framecache_misses_total") - m1
+framecache.set_enabled(False)
+off = run("fc_off")
+framecache.set_enabled(True)
+out = {
+    "device": str(jax.devices()[0]),
+    "frames": N,
+    "hit_rate": round(hits / (hits + misses), 4) if hits + misses else None,
+    "warm_hit_rate": round(wh / (wh + wm), 4) if wh + wm else None,
+    "on_cold": on_cold, "on_warm": on_warm, "off": off,
+    "decode_seconds_saved": round(off["decode_s"] - on_warm["decode_s"], 3),
+    "h2d_bytes_saved": off["h2d_bytes"] - on_warm["h2d_bytes"],
+    "framecache": framecache.status_dict(),
+}
+sc.stop()
+# bank the hardware frame-cache digest with the round's bench evidence
+# (same file bench.py writes its digests to) — the ISSUE asks for a
+# frame_cache_hw baseline on the next healthy capture window
+path = os.path.join(os.getcwd(), "BENCH_DETAIL.json")
+try:
+    detail = json.load(open(path))
+    if not isinstance(detail, list):
+        detail = [detail]
+except Exception:
+    detail = []
+detail.append({"config": "frame_cache_hw",
+               "clock": time.strftime("%Y-%m-%dT%H:%M:%S"), **out})
+with open(path, "w") as f:
+    json.dump(detail, f, indent=1)
+print("FRAMECACHE_AB " + json.dumps(out))
+"""
+
+
 def tunnel_up() -> bool:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from tpu_capture import tunnel_up as probe  # same probe + env override
@@ -453,6 +533,10 @@ def main() -> int:
         "hardware roofline digest (util/coststats.py -> "
         "BENCH_DETAIL.json op_efficiency_hw)", code=_EFF_DIGEST,
         timeout=1200, marker="EFF_DIGEST ")
+    results["frame_cache"] = run_step(
+        "paged frame-cache cross-task reuse A/B (engine/framecache.py "
+        "-> BENCH_DETAIL.json frame_cache_hw)", code=_FRAMECACHE_AB,
+        timeout=1200, marker="FRAMECACHE_AB ")
     results["op_bench"] = run_step(
         "per-op device/host A/B (tools/op_bench.py -> OP_BENCH.json)",
         argv=[sys.executable, "tools/op_bench.py"], timeout=1200)
