@@ -490,6 +490,120 @@ def main():
 
         _fc_d = _fc_digest()
         detail.append(_fc_d)
+
+        # remediation digest (engine/controller.py): a bounded live
+        # preemption drill — tiny in-process cluster, one of two
+        # workers preempted mid-bulk (the worker.preempt chaos site) —
+        # banking the recovery time (preemption notice -> bulk
+        # complete, i.e. how fast the cluster re-absorbs reclaimed
+        # capacity's work) plus the controller's decision counters, so
+        # tools/bench_history.py gates the close-the-loop trajectory
+        # like any other metric
+        def _remediation_digest() -> dict:
+            import struct as _struct
+            import threading as _threading
+
+            from scanner_tpu import Kernel, register_op
+            from scanner_tpu.engine import controller as _ctrl
+            from scanner_tpu.engine.service import Master, Worker
+            from scanner_tpu.util import faults as _faults
+
+            if not _ctrl.enabled():
+                return {"config": "remediation", "enabled": False}
+
+            def _pk(v: int) -> bytes:
+                return _struct.pack("<q", v)
+
+            @register_op(name="BenchRemSleep")
+            class BenchRemSleep(Kernel):
+                # slow enough that the bulk (24 tasks across 2
+                # workers) outlives the 2nd-heartbeat preemption at
+                # ~2 s — the drill must reclaim capacity MID-bulk
+                def execute(self, x: bytes) -> bytes:
+                    time.sleep(0.2)
+                    return _pk(2 * _struct.unpack("<q", x)[0])
+
+            def _tot(name: str) -> float:
+                s = registry().snapshot().get(name, {})
+                return sum(x["value"] for x in s.get("samples", []))
+
+            def _by_labels(name: str) -> dict:
+                return labeled_samples(registry().snapshot(), name)
+
+            rdb = os.path.join(root, "rem_db")
+            n_rows = 48
+            seed2 = Client(db_path=rdb)
+            seed2.new_table("rem_src", ["output"],
+                            [[_pk(100 + i)] for i in range(n_rows)])
+            master = Master(db_path=rdb, no_workers_timeout=30.0)
+            addr = f"localhost:{master.port}"
+            workers = [Worker(addr, db_path=rdb) for _ in range(2)]
+            rc = Client(db_path=rdb, master=addr)
+            strikes0 = _tot("scanner_tpu_blacklist_strikes_total")
+            trans0 = {k: v for k, v in _by_labels(
+                "scanner_tpu_alerts_transitions_total").items()}
+            victim = workers[0]
+            preempt_at = [None]
+
+            def _watch() -> None:
+                while preempt_at[0] is None:
+                    if victim.preempting():
+                        preempt_at[0] = time.time()
+                        return
+                    time.sleep(0.01)
+
+            try:
+                _faults.install(
+                    f"worker.preempt:raise:"
+                    f"match={victim.worker_id}:n=2:times=1")
+                w_t = _threading.Thread(target=_watch, daemon=True)
+                w_t.start()
+                col = rc.io.Input([NamedStream(rc, "rem_src")])
+                col = rc.ops.BenchRemSleep(x=col)
+                out = NamedStream(rc, "rem_out")
+                rc.run(rc.io.Output(col, [out]),
+                       PerfParams.manual(2, 2),
+                       cache_mode=CacheMode.Overwrite,
+                       show_progress=False)
+                done_at = time.time()
+                rows_ok = len(list(out.load())) == n_rows
+                recovery = round(done_at - preempt_at[0], 3) \
+                    if preempt_at[0] is not None \
+                    and preempt_at[0] < done_at else None
+                trans1 = _by_labels(
+                    "scanner_tpu_alerts_transitions_total")
+                return {
+                    "config": "remediation", "enabled": True,
+                    "rows_ok": rows_ok,
+                    "preemption_recovery_s": recovery,
+                    "preemptions": _tot(
+                        "scanner_tpu_worker_preemptions_total"),
+                    "preempt_notices": _tot(
+                        "scanner_tpu_worker_preempt_notices_total"),
+                    "strike_delta": _tot(
+                        "scanner_tpu_blacklist_strikes_total")
+                    - strikes0,
+                    "alert_transitions": {
+                        k: v - trans0.get(k, 0.0)
+                        for k, v in trans1.items()
+                        if v - trans0.get(k, 0.0)},
+                    "remediations": _by_labels(
+                        "scanner_tpu_remediations_total"),
+                }
+            finally:
+                _faults.clear()
+                rc.stop()
+                for w in workers:
+                    w.stop()
+                master.stop()
+
+        try:
+            _rem_d = _remediation_digest()
+        except Exception as e:  # noqa: BLE001 — bench must not die on
+            # the remediation drill
+            _rem_d = {"config": "remediation",
+                      "error": f"{type(e).__name__}: {e}"}
+        detail.append(_rem_d)
         # stable per-direction baseline keys (ROADMAP "bank per-item
         # baselines for the new directions"): one flat entry with a
         # declared better= direction per metric, so
@@ -526,6 +640,9 @@ def main():
                 "frame_cache_h2d_bytes_saved": {
                     "value": _fc_d.get("h2d_bytes_saved"),
                     "better": "higher"},
+                "preemption_recovery_s": {
+                    "value": _rem_d.get("preemption_recovery_s"),
+                    "better": "lower"},
             },
         })
         # health digest (util/health.py): alert transitions fired during

@@ -42,6 +42,14 @@ passes make each one checkable:
          drift (all pairings, both directions); and the `[perf]`
          frame_cache_* config keys config.default_config() declares
          must be exactly framecache.CONFIG_KEYS (both directions)
+  SC311  remediation contract drift (engine/controller.py): every
+         DEFAULT_PLAYBOOKS entry must bind an alert that exists in
+         health.DEFAULT_RULES; the playbook names and alert bindings
+         must match the marker-delimited playbook matrix in
+         docs/robustness.md (`remediation-playbooks:begin/end`), both
+         directions; and the `[remediation]` config keys
+         config.default_config() declares must be exactly
+         controller.CONFIG_KEYS (both directions)
 """
 
 from __future__ import annotations
@@ -320,6 +328,9 @@ class ContractPass(AnalysisPass):
                  "hooks, EFFICIENCY_SERIES, docs efficiency table)",
         "SC310": "frame-cache contract drift (FRAMECACHE_SERIES, docs "
                  "framecache table, [perf] frame_cache_* config keys)",
+        "SC311": "remediation contract drift (DEFAULT_PLAYBOOKS vs "
+                 "health rules vs docs playbook matrix vs "
+                 "[remediation] config keys)",
     }
 
     def run(self, project: Project) -> List[Finding]:
@@ -332,6 +343,7 @@ class ContractPass(AnalysisPass):
         out.extend(self._alert_rules(project))
         out.extend(self._cost_model(project))
         out.extend(self._frame_cache(project))
+        out.extend(self._remediation(project))
         return out
 
     # -- SC301 / SC302 ---------------------------------------------------
@@ -844,6 +856,129 @@ class ContractPass(AnalysisPass):
                         f"framecache.CONFIG_KEYS accepts `{k}` but "
                         "config.default_config() declares no "
                         f"`[perf] {k}`", fmod.tree))
+        return out
+
+    # -- SC311 -----------------------------------------------------------
+
+    _PB_DOC_BLOCK_RE = re.compile(
+        r"<!--\s*remediation-playbooks:begin\s*-->(.*?)"
+        r"<!--\s*remediation-playbooks:end\s*-->", re.S)
+    # matrix rows lead `| `playbook` | `alert` | ...`
+    _PB_DOC_ROW_RE = re.compile(
+        r"^\|\s*`([a-z0-9_]+)`\s*\|\s*`([a-z0-9_]+)`", re.M)
+
+    @staticmethod
+    def _default_playbooks(mod: ModuleInfo
+                           ) -> Optional[List[Tuple[str, str, ast.AST]]]:
+        """(name, alert, node) per element of the module-level
+        DEFAULT_PLAYBOOKS tuple — the literal `name=`/`alert=` kwargs
+        of each playbook constructor call."""
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and stmt.targets[0].id == "DEFAULT_PLAYBOOKS" \
+                    and isinstance(stmt.value, (ast.Tuple, ast.List)):
+                out: List[Tuple[str, str, ast.AST]] = []
+                for el in stmt.value.elts:
+                    if not isinstance(el, ast.Call):
+                        continue
+                    name = alert = None
+                    for kw in el.keywords:
+                        if kw.arg == "name":
+                            name = _const_str(kw.value)
+                        elif kw.arg == "alert":
+                            alert = _const_str(kw.value)
+                    if name is not None and alert is not None:
+                        out.append((name, alert, el))
+                return out
+        return None
+
+    def _remediation(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        cmod = project.module("engine/controller.py")
+        if cmod is None:
+            return out
+        playbooks = self._default_playbooks(cmod)
+        if playbooks:
+            # direction 1: every playbook binds a REAL alert — an
+            # action wired to a rule name the health engine never
+            # evaluates can never fire
+            hmod = project.module("util/health.py")
+            rule_names = {n for n, _node in
+                          (self._default_rule_names(hmod) or ())} \
+                if hmod is not None else None
+            if rule_names is not None:
+                for name, alert, node in playbooks:
+                    if alert not in rule_names:
+                        out.append(cmod.finding(
+                            "SC311",
+                            f"playbook `{name}` binds alert `{alert}` "
+                            "but health.DEFAULT_RULES has no such rule "
+                            "— the playbook can never fire", node))
+            # directions 2+3: playbook names + alert bindings <-> the
+            # docs/robustness.md marker matrix, both ways
+            doc = _read_doc(project, "robustness.md")
+            block = self._PB_DOC_BLOCK_RE.search(doc) if doc else None
+            if doc and block is None:
+                out.append(cmod.finding(
+                    "SC311",
+                    "controller declares DEFAULT_PLAYBOOKS but docs/"
+                    "robustness.md has no remediation-playbooks marker "
+                    "table (<!-- remediation-playbooks:begin/end -->) — "
+                    "operators cannot see what auto-remediates",
+                    cmod.tree))
+            elif block is not None:
+                doc_rows = dict(
+                    self._PB_DOC_ROW_RE.findall(block.group(1)))
+                by_name = {n: (a, node) for n, a, node in playbooks}
+                for name, (alert, node) in sorted(by_name.items()):
+                    if name not in doc_rows:
+                        out.append(cmod.finding(
+                            "SC311",
+                            f"playbook `{name}` is missing from the "
+                            "docs/robustness.md remediation-playbooks "
+                            "matrix", node))
+                    elif doc_rows[name] != alert:
+                        out.append(cmod.finding(
+                            "SC311",
+                            f"playbook `{name}` binds alert `{alert}` "
+                            f"but the docs matrix row says "
+                            f"`{doc_rows[name]}`", node))
+                for name in sorted(set(doc_rows) - set(by_name)):
+                    out.append(Finding(
+                        code="SC311",
+                        message=f"docs/robustness.md "
+                                f"remediation-playbooks matrix lists "
+                                f"`{name}` but controller."
+                                "DEFAULT_PLAYBOOKS has no such "
+                                "playbook",
+                        path="docs/robustness.md", line=1, scope="",
+                        snippet=name))
+        # [remediation] config keys <-> controller.CONFIG_KEYS, both
+        # directions (the SC308/[alerts] pattern)
+        schema = _module_tuple(cmod, "CONFIG_KEYS")
+        cfg_mod = None
+        for m in project.modules:
+            if m.relpath.endswith("config.py") \
+                    and _default_config_keys(m):
+                cfg_mod = m
+                break
+        if schema is not None and cfg_mod is not None:
+            declared = {k for sec, k in _default_config_keys(cfg_mod)
+                        if sec == "remediation"}
+            if declared:
+                for k in sorted(declared - set(schema)):
+                    out.append(cfg_mod.finding(
+                        "SC311",
+                        f"config key `[remediation] {k}` is declared "
+                        "but controller.CONFIG_KEYS does not accept "
+                        "it", cfg_mod.tree))
+                for k in sorted(set(schema) - declared):
+                    out.append(cmod.finding(
+                        "SC311",
+                        f"controller.CONFIG_KEYS accepts `{k}` but "
+                        "config.default_config() declares no "
+                        f"`[remediation] {k}`", cmod.tree))
         return out
 
     # -- SC306 / SC307 ---------------------------------------------------

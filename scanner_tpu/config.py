@@ -90,6 +90,23 @@ def default_config() -> Dict[str, Any]:
             # docs/observability.md §Health & SLOs.  "" = defaults only.
             "rules": "",
         },
+        "remediation": {
+            # the alert->action remediation controller
+            # (engine/controller.py): autoscaling, preemption drain,
+            # admission pause, frame-cache shrink, ladder re-warm.  On
+            # by default; SCANNER_TPU_REMEDIATION=0 overrides per
+            # process (the signal-only kill switch).
+            "enabled": True,
+            # dry-run: playbooks decide (cooldown/hysteresis/rate
+            # limit, audit, metrics) but never invoke their action —
+            # the staging-environment mode.
+            "dry_run": False,
+            # autoscaler replica bounds ([min,max]) used when a master
+            # runs with autoscale=True (docs/robustness.md
+            # §Remediation playbooks).
+            "autoscale_min": 1,
+            "autoscale_max": 8,
+        },
         "faults": {
             # deterministic fault-injection plan (docs/robustness.md for
             # the clause syntax; util/faults.py implements it).  "" (the
@@ -209,6 +226,26 @@ class Config:
         """User alert rules ([alerts] rules clause spec), "" = only the
         built-in default ruleset."""
         return str(self.config.get("alerts", {}).get("rules", "") or "")
+
+    @property
+    def remediation_enabled(self) -> bool:
+        """Alert->action remediation controller (the deployment
+        default; SCANNER_TPU_REMEDIATION overrides per process)."""
+        return bool(self.config.get("remediation", {}).get("enabled",
+                                                           True))
+
+    @property
+    def remediation_dry_run(self) -> bool:
+        """Remediation dry-run: decisions audit but never actuate."""
+        return bool(self.config.get("remediation", {}).get("dry_run",
+                                                           False))
+
+    @property
+    def remediation_autoscale_bounds(self) -> tuple:
+        """(min, max) worker replica bounds for the autoscaler."""
+        r = self.config.get("remediation", {})
+        return (int(r.get("autoscale_min", 1)),
+                int(r.get("autoscale_max", 8)))
 
     @property
     def faults_plan(self) -> Optional[str]:

@@ -169,6 +169,17 @@ class ClusterConfig:
     # the ConfigMap's [alerts] section so every pod's engine evaluates
     # them.  "" = defaults only.
     alert_rules: str = ""
+    # alert->action remediation (engine/controller.py), wired into the
+    # ConfigMap's [remediation] section for every pod.  False =
+    # signal-only (alerts fire, nothing actuates); dry_run keeps the
+    # decision pipeline + audit live without invoking actions.  The
+    # autoscaler bounds feed Master(autoscale=True); the production
+    # actuator is Cluster.scale (scale-down drains pods via SIGTERM ->
+    # Worker.drain, so in-flight tasks are never killed).
+    remediation: bool = True
+    remediation_dry_run: bool = False
+    autoscale_min: int = 1
+    autoscale_max: int = 8
 
     def price_per_hour(self) -> float:
         return (self.master_cpus * CPU_PRICE_PER_CORE
@@ -293,6 +304,12 @@ def config_manifest(cfg: ClusterConfig) -> Dict:
             "compilation_cache_dir": cfg.compilation_cache_dir}
     if cfg.alert_rules:
         sections["alerts"] = {"rules": cfg.alert_rules}
+    sections["remediation"] = {
+        "enabled": cfg.remediation,
+        "dry_run": cfg.remediation_dry_run,
+        "autoscale_min": cfg.autoscale_min,
+        "autoscale_max": cfg.autoscale_max,
+    }
     toml = dump_toml(sections)
     return {
         "apiVersion": "v1", "kind": "ConfigMap",
@@ -616,3 +633,11 @@ class Cluster:
 
     def master_address(self) -> str:
         return f"{self.cfg.id}-master:{self.cfg.master_port}"
+
+    def scale_actuator(self):
+        """The autoscaler-facing replica setter
+        (``Master(autoscale=True, scale_actuator=cluster.scale_actuator())``):
+        just ``Cluster.scale`` — kubernetes removes surplus pods via
+        SIGTERM, which ``start_worker`` maps to ``Worker.drain``, so an
+        autoscale-down never kills in-flight tasks."""
+        return self.scale

@@ -123,6 +123,15 @@ class Client:
             from ..util import health as _health_cfg
             if not os.environ.get("SCANNER_TPU_HEALTH"):
                 _health_cfg.set_enabled(cfg.alerts_enabled)
+            # [remediation] section: the alert->action controller's
+            # deployment defaults; SCANNER_TPU_REMEDIATION (read at
+            # import) is the per-process kill switch and wins
+            from . import controller as _ctrl_cfg
+            if not os.environ.get("SCANNER_TPU_REMEDIATION"):
+                _ctrl_cfg.set_enabled(cfg.remediation_enabled)
+            _ctrl_cfg.set_dry_run(cfg.remediation_dry_run)
+            _ctrl_cfg.set_autoscale_bounds(
+                *cfg.remediation_autoscale_bounds)
             # applied in both directions (like [trace]): a config with
             # rules="" CLEARS user rules an earlier config installed —
             # removed rules' states resolve instead of firing forever
@@ -180,6 +189,7 @@ class Client:
             from ..util import coststats as _coststats
             from ..util import health as _health_st
             from ..util import memstats as _memstats
+            from . import controller as _ctrl_st
             from . import framecache as _framecache
             self._metrics_server = MetricsServer(
                 port=metrics_port,
@@ -192,7 +202,9 @@ class Client:
                                  "framecache":
                                      _framecache.status_dict(),
                                  "efficiency":
-                                     _coststats.status_dict()},
+                                     _coststats.status_dict(),
+                                 "remediation":
+                                     _ctrl_st.status_dict()},
                 healthz=lambda: {"role": "client"})
 
         self.ops = O.OpGenerator()
@@ -213,6 +225,11 @@ class Client:
         # when SCANNER_TPU_HEALTH=0 / [alerts] enabled=false
         from ..util import health as _health
         _health.ensure_started()
+        # remediation controller (engine/controller.py): local-mode
+        # runs get the worker-local playbooks (frame-cache shrink,
+        # ladder re-warm); no-op when SCANNER_TPU_REMEDIATION=0
+        from . import controller as _ctrl
+        _ctrl.ensure_started()
 
     # -- context manager ----------------------------------------------------
 

@@ -32,6 +32,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+import weakref
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -310,7 +311,12 @@ class KernelInstance:
         cfg = O.KernelConfig(device=node.effective_device(),
                              args=dict(node.init_args),
                              devices=devices or [])
-        self.kernel = self.spec.kernel_factory(cfg, **node.init_args)
+        # canonical class identity: an unpickled job spec can carry a
+        # cloudpickle by-value class COPY of a locally-registered op;
+        # instantiating the registered original keeps class-level state
+        # (and identity-sensitive tests) on one class object
+        factory = O.registry.canonical_factory(self.spec)
+        self.kernel = factory(cfg, **node.init_args)
         self.profiler = profiler
         # the chip this instance's calls are pinned to (evaluator
         # affinity); None = jax default placement.  Committed inputs on
@@ -329,6 +335,12 @@ class KernelInstance:
         self._warm_lock = threading.Lock()
         self._warm_state = "idle"
         self._warm_done = threading.Event()
+        # serializes kernel.execute between the evaluation thread and a
+        # warm-up/re-warm thread: two concurrent execute() calls on one
+        # kernel instance are not guaranteed safe, and the ensure_warm
+        # handshake alone cannot cover a MID-RUN rewarm (the
+        # recompile_storm remediation).  Uncontended in steady state.
+        self._call_lock = threading.Lock()
 
     def setup(self, fetch: bool = True) -> None:
         if not self._did_setup:
@@ -428,9 +440,10 @@ class KernelInstance:
                     # ladder rung is attributed to (op, device, bucket)
                     # — with the persistent cache configured, a warmed
                     # restart records it as a `hit`
-                    with _cs.observe_compiles(self.node.name,
-                                              self.dev_label, b,
-                                              f"warmup:b{b}"):
+                    with self._call_lock, \
+                            _cs.observe_compiles(self.node.name,
+                                                 self.dev_label, b,
+                                                 f"warmup:b{b}"):
                         self.kernel.execute(*args)
                 except Exception:  # noqa: BLE001 — warm-up is best-effort
                     _log.debug("precompile of %s at batch %d failed",
@@ -460,6 +473,26 @@ class KernelInstance:
 
     def close(self) -> None:
         self.kernel.close()
+
+
+# every live TaskEvaluator, weakly held: the recompile_storm
+# remediation playbook (engine/controller.py) re-warms bucket ladders
+# process-wide through rewarm_all() without owning evaluator lifetimes
+_LIVE_EVALUATORS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def rewarm_all() -> int:
+    """Re-schedule the bucket-ladder warm-up on every live evaluator
+    (the recompile_storm -> ladder_rewarm remediation action).
+    Returns the total number of kernels scheduled; best-effort — an
+    evaluator failing to re-warm never raises out of the actuator."""
+    total = 0
+    for te in list(_LIVE_EVALUATORS):
+        try:
+            total += te.rewarm()
+        except Exception:  # noqa: BLE001 — remediation is best-effort
+            _log.exception("ladder re-warm failed for an evaluator")
+    return total
 
 
 class TaskEvaluator:
@@ -504,34 +537,78 @@ class TaskEvaluator:
         # executor (engine geometry is not knowable from the graph
         # alone); evaluation threads join per-kernel via ensure_warm().
         self._precompile_thread: Optional[threading.Thread] = None
+        self._precompile_hint = precompile
         if precompile is not None and _precompile_enabled() \
                 and _bucketing_enabled():
-            h, w, wp = precompile
-            targets: List[Tuple[KernelInstance, List[int]]] = []
-            for ki in self.kernels.values():
-                n = ki.node
-                if n.effective_device() != DeviceType.TPU \
-                        or n.effective_batch() <= 1 \
-                        or ki.spec.is_stateful or ki.spec.variadic \
-                        or not _source_geometry_inputs(n):
-                    continue
-                # same per-call cap derivation as _run_kernel
-                if n.batch is None and wp:
-                    cap = max(1, min(n.effective_batch(), int(wp)))
-                else:
-                    cap = max(1, n.effective_batch())
+            targets = self._warm_targets(precompile)
+            for ki, _ladder in targets:
                 ki._warm_state = "pending"
-                targets.append((ki, bucket_ladder(cap)))
-            if targets:
-                def warm() -> None:
-                    for ki, ladder in targets:
-                        ki.precompile(ladder, h, w)
+            self._spawn_warm(targets, precompile)
+        # live-evaluator registry: the recompile_storm remediation
+        # (engine/controller.py -> rewarm_all) re-schedules ladder
+        # warm-ups on whatever evaluators currently exist; weak so a
+        # closed/forgotten evaluator never pins its kernels alive
+        _LIVE_EVALUATORS.add(self)
 
-                self._precompile_thread = threading.Thread(
-                    target=warm, name="precompile", daemon=True)
-                self._precompile_thread.start()
+    def _warm_targets(self, precompile: Tuple[int, int, int]
+                      ) -> List[Tuple["KernelInstance", List[int]]]:
+        """The warm-up-eligible kernels and their ladders (shared by
+        the constructor warm-up and rewarm)."""
+        _h, _w, wp = precompile
+        targets: List[Tuple[KernelInstance, List[int]]] = []
+        for ki in self.kernels.values():
+            n = ki.node
+            if n.effective_device() != DeviceType.TPU \
+                    or n.effective_batch() <= 1 \
+                    or ki.spec.is_stateful or ki.spec.variadic \
+                    or not _source_geometry_inputs(n):
+                continue
+            # same per-call cap derivation as _run_kernel
+            if n.batch is None and wp:
+                cap = max(1, min(n.effective_batch(), int(wp)))
+            else:
+                cap = max(1, n.effective_batch())
+            targets.append((ki, bucket_ladder(cap)))
+        return targets
+
+    def _spawn_warm(self, targets, precompile) -> None:
+        if not targets:
+            return
+        h, w, _wp = precompile
+
+        def warm() -> None:
+            for ki, ladder in targets:
+                ki.precompile(ladder, h, w)
+
+        self._precompile_thread = threading.Thread(
+            target=warm, name="precompile", daemon=True)
+        self._precompile_thread.start()
+
+    def rewarm(self) -> int:
+        """Re-schedule the bucket-ladder warm-up (the recompile_storm
+        remediation): kernels whose warm-up is idle or done go back to
+        pending and a fresh warm-up thread re-executes their ladders —
+        with the persistent compilation cache configured this re-pins
+        executables at cache-hit cost.  Mid-flight warm-ups and claims
+        by racing real calls are respected (the same
+        ensure_warm/_call_lock handshake as construction).  Returns
+        the number of kernels scheduled."""
+        hint = self._precompile_hint
+        if hint is None or not _precompile_enabled() \
+                or not _bucketing_enabled():
+            return 0
+        claimed: List[Tuple[KernelInstance, List[int]]] = []
+        for ki, ladder in self._warm_targets(hint):
+            with ki._warm_lock:
+                if ki._warm_state in ("idle", "done"):
+                    ki._warm_state = "pending"
+                    ki._warm_done.clear()
+                    claimed.append((ki, ladder))
+        self._spawn_warm(claimed, hint)
+        return len(claimed)
 
     def close(self) -> None:
+        _LIVE_EVALUATORS.discard(self)
         for ki in self.kernels.values():
             ki.close()
 
@@ -901,7 +978,7 @@ class TaskEvaluator:
                                 # XLA compile inside lands in the
                                 # compile ledger under this (op,
                                 # device, bucket)
-                                with _cs.observe_compiles(
+                                with ki._call_lock, _cs.observe_compiles(
                                         n.name, ki.dev_label,
                                         len(exec_sel), repr(sig[1:])):
                                     res = ki.kernel.execute(*args)
@@ -910,7 +987,8 @@ class TaskEvaluator:
                                 # call times only itself
                                 res = _cs.block_until_ready(res)
                             else:
-                                res = ki.kernel.execute(*args)
+                                with ki._call_lock:
+                                    res = ki.kernel.execute(*args)
                             if track_cost and not new_sig:
                                 # measured call seconds joined with the
                                 # analytical descriptor; first calls of
@@ -944,7 +1022,8 @@ class TaskEvaluator:
                                 if has_stencil and is_array_data(a):
                                     e = list(a[0])
                                 row_args.append(e)
-                            res = ki.kernel.execute(*row_args)
+                            with ki._call_lock:
+                                res = ki.kernel.execute(*row_args)
                             emit_result(compute[live], _single(res, n, out_cols))
                         i = j
                 if run_secs > 0:

@@ -678,6 +678,11 @@ class LocalExecutor:
         # LocalExecutor embedding, spawned test workers)
         from ..util import health as _health
         _health.ensure_started()
+        # and the remediation controller rides the same alerts: local
+        # runs get the worker-local playbooks (frame-cache shrink,
+        # ladder re-warm) with no cluster in sight
+        from . import controller as _controller
+        _controller.ensure_started()
         if os.environ.get("SCANNER_TPU_NO_PIPELINING", "0") not in \
                 ("0", "", "false"):
             return self._run_serial(info, source, on_start, on_done,

@@ -870,8 +870,14 @@ _CACHE_LOCK = threading.Lock()
 
 
 def _on_alert(transition: Dict[str, Any]) -> None:
-    """Health-engine listener: hbm_pressure firing -> shrink + evict
-    (alerts -> actuation, the ROADMAP item 5 seed)."""
+    """The shrink actuation: hbm_pressure firing -> shrink + evict.
+    Registered with the remediation controller as the
+    ``shrink_frame_cache`` action behind the ``frame_cache_shrink``
+    playbook (engine/controller.py — this was the PR 10 hard-wired
+    health listener, generalized: cooldown, dry-run, audit and the
+    SCANNER_TPU_REMEDIATION kill switch now apply).  Still callable
+    directly with a transition dict — the rule/state filter stays so
+    private health engines can use it as a bare listener in tests."""
     if transition.get("rule") != "hbm_pressure" \
             or transition.get("state") != "firing":
         return
@@ -884,14 +890,18 @@ def _on_alert(transition: Dict[str, Any]) -> None:
 
 
 def cache() -> FrameCache:
-    """The process-wide pool (created on first use; registers the
-    hbm_pressure actuation listener with the health engine)."""
+    """The process-wide pool (created on first use; binds the
+    hbm_pressure shrink to the remediation controller's
+    frame_cache_shrink playbook).  With SCANNER_TPU_REMEDIATION=0 the
+    controller never attaches to the health engine, so the cache is
+    signal-only: the alert fires, nothing shrinks."""
     global _CACHE
     with _CACHE_LOCK:
         if _CACHE is None:
             _CACHE = FrameCache()
-            from ..util import health as _health
-            _health.add_listener(_on_alert)
+            from . import controller as _controller
+            _controller.register_action("shrink_frame_cache", _on_alert)
+            _controller.ensure_started()
         return _CACHE
 
 

@@ -10,6 +10,7 @@ via storage.metadata pack/unpack).
 
 from __future__ import annotations
 
+import random as _random
 import threading
 import time
 from concurrent import futures
@@ -27,6 +28,15 @@ from ..util.retry import call_with_backoff
 
 _log = get_logger("rpc")
 
+# per-process jitter factor for the channel reconnect pacing below: a
+# fleet of workers that all lost the same master would otherwise share
+# identical backoff caps and redial in lockstep — every survivor of a
+# master restart hitting the fresh listener in the same 100 ms window.
+# One multiplicative draw per process (seeded from the default RNG, so
+# distinct across forks) decorrelates the fleet; call-level full-jitter
+# backoff (util/retry.py) plus the process retry budget handle the rest.
+_RECONNECT_JITTER = _random.uniform(0.7, 1.3)
+
 GRPC_OPTIONS = [
     ("grpc.max_send_message_length", 1 << 30),
     ("grpc.max_receive_message_length", 1 << 30),
@@ -36,10 +46,11 @@ GRPC_OPTIONS = [
     # server — would otherwise accumulate minutes of redial delay and
     # stay UNAVAILABLE long after the peer is actually back.  Our own
     # call-level full-jitter backoff handles politeness; the channel
-    # just needs to redial promptly.
-    ("grpc.initial_reconnect_backoff_ms", 100),
-    ("grpc.min_reconnect_backoff_ms", 100),
-    ("grpc.max_reconnect_backoff_ms", 2000),
+    # just needs to redial promptly (with the per-process jitter above
+    # so a whole fleet does not redial on one clock).
+    ("grpc.initial_reconnect_backoff_ms", int(100 * _RECONNECT_JITTER)),
+    ("grpc.min_reconnect_backoff_ms", int(100 * _RECONNECT_JITTER)),
+    ("grpc.max_reconnect_backoff_ms", int(2000 * _RECONNECT_JITTER)),
 ]
 
 # server-side handler latency (includes msgpack (de)serialization, not
@@ -246,6 +257,8 @@ def wait_for_server(address: str, service: str, method: str = "Ping",
                 return
         finally:
             c.close()
-        time.sleep(0.25)
+        # jittered poll: a fleet of workers waiting out one master
+        # restart must not re-probe on a shared 250 ms clock
+        time.sleep(_random.uniform(0.15, 0.35))
     raise RpcError(f"{service} at {address} not reachable "
                    f"after {timeout}s")

@@ -41,6 +41,7 @@ from ..util import tracing as _tracing
 from ..util.log import get_logger
 from ..util.metrics import MetricsServer, merge_snapshots
 from ..util.profiler import Profiler
+from . import controller as _controller
 from . import framecache as _framecache
 from . import rpc
 from .evaluate import TaskEvaluator
@@ -153,6 +154,21 @@ _M_DRAINS = _mx.registry().counter(
     "scanner_tpu_worker_drains_total",
     "Workers that deregistered via SIGTERM drain (finish in-flight "
     "tasks, stop pulling, UnregisterWorker).")
+_M_PREEMPTIONS = _mx.registry().counter(
+    "scanner_tpu_worker_preemptions_total",
+    "Preemption notices this worker received (spot/preemptible TPU "
+    "reclaim, or the worker.preempt chaos site): each one starts a "
+    "routine drain with the master fencing assignment first.")
+_M_PREEMPT_NOTICES = _mx.registry().counter(
+    "scanner_tpu_worker_preempt_notices_total",
+    "Preemption notices the master observed on worker heartbeats "
+    "(master view; survives the preempted worker's exit) — assignment "
+    "to the worker is fenced from the first notice.")
+_M_ADMISSION_PAUSED = _mx.registry().gauge(
+    "scanner_tpu_master_admission_paused",
+    "1 while the master's job admission is paused by the "
+    "admission_pause remediation playbook (sustained backpressure "
+    "shed); NewJob answers a retryable admission_paused reply.")
 _M_JOBS_BLACKLISTED = _mx.registry().counter(
     "scanner_tpu_jobs_blacklisted_total",
     "Jobs removed from their bulk after repeated task failures.")
@@ -187,6 +203,15 @@ class _WorkerInfo:
     address: str
     last_seen: float
     active: bool = True
+    # spot/preemptible reclaim notice seen on a heartbeat: assignment
+    # to this worker is FENCED (NextWork answers wait) while its drain
+    # completes — requeues of whatever it cannot finish stay strike-free
+    preempting: bool = False
+    # alert rule names this worker reported firing on its last
+    # heartbeat — the cross-node signal feed for the remediation
+    # controller (stage_backpressure lives in worker processes; the
+    # master's local health engine cannot see it)
+    firing: Set[str] = field(default_factory=set)
 
 
 @dataclass
@@ -393,7 +418,15 @@ class Master:
                  enable_watchdog: bool = False,
                  storage_type: str = "posix",
                  metrics_port: Optional[int] = None,
-                 metrics_host: str = "0.0.0.0"):
+                 metrics_host: str = "0.0.0.0",
+                 # remediation (engine/controller.py): True builds an
+                 # AutoscaleConfig from the [remediation] bounds, or
+                 # pass a config; scale_actuator is the pluggable
+                 # replica setter (deploy.Cluster.scale in prod, a
+                 # callback in tests; None = audit-only, the desired
+                 # count still lands on the autoscale gauge)
+                 autoscale=None,
+                 scale_actuator=None):
         self.db = Database(make_storage(storage_type, db_path=db_path))
         self.no_workers_timeout = no_workers_timeout
         self.enable_watchdog = enable_watchdog
@@ -461,6 +494,28 @@ class Master:
         # master always evaluates them — /healthz, GetJobStatus and
         # GetHealth report the roll-up
         _health.ensure_started()
+        # remediation (engine/controller.py): the master owns the
+        # admission gate and the autoscaler, so it binds their actions
+        # here; the scan loop ticks the controller (hysteresis holds)
+        # and feeds worker-reported alerts + the autoscale observation.
+        # All of it is inert under SCANNER_TPU_REMEDIATION=0.
+        self._admission_paused: Optional[str] = None
+        self._worker_firing: Set[str] = set()
+        self.autoscaler: Optional[_controller.Autoscaler] = None
+        if autoscale:
+            cfg = autoscale if isinstance(
+                autoscale, _controller.AutoscaleConfig) else \
+                _controller.AutoscaleConfig(
+                    *_controller.autoscale_bounds())
+            self.autoscaler = _controller.Autoscaler(
+                cfg, actuator=scale_actuator)
+        if _controller.ensure_started() is not None:
+            _controller.register_action("pause_admission",
+                                        self._pause_admission)
+            _controller.register_action("resume_admission",
+                                        self._resume_admission)
+            _controller.register_action("autoscale",
+                                        self._autoscale_nudge)
         self._scan_thread = threading.Thread(
             target=self._scan_loop, name="master-scan", daemon=True)
         self._scan_thread.start()
@@ -502,6 +557,19 @@ class Master:
                 # stale worker rejoining after removal: re-register
                 return {"reregister": True, "active_bulk": None}
             w.last_seen = time.time()
+            # preemption notice: fence assignment NOW — the worker's
+            # drain completes on its own clock, but no new task may be
+            # handed to reclaimed capacity in the meantime
+            if req.get("preempting") and not w.preempting:
+                w.preempting = True
+                _M_PREEMPT_NOTICES.inc()
+                _mlog.warning(
+                    "worker %d advertised preemption: assignment "
+                    "fenced, drain in progress", wid)
+            # firing alert names ride every beat (tiny: a sorted list
+            # of rule-name strings) — the scan loop folds them into
+            # cluster-level remediation transitions
+            w.firing = set(req.get("firing") or ())
             active = self._bulk.bulk_id \
                 if self._bulk and not self._bulk.finished else None
         return {"reregister": False, "active_bulk": active}
@@ -513,6 +581,15 @@ class Master:
         mutates database metadata and must not interleave."""
         with self._admit_lock:
             with self._lock:
+                if self._admission_paused:
+                    # load shedding (admission_pause playbook): answer
+                    # retryable instead of queueing work onto a
+                    # backpressured cluster — ClusterClient.run retries
+                    # with the hinted delay until resume or deadline
+                    return {"error": "admission paused: "
+                                     f"{self._admission_paused}",
+                            "admission_paused": True,
+                            "retry_after": 1.0}
                 if self._bulk is not None and not self._bulk.finished:
                     return {"error": "a bulk job is already active"}
             # one trace_id per job: the submitting client's context (the
@@ -604,6 +681,12 @@ class Master:
             w = self._workers.get(wid)
             if w is None or not w.active:
                 return {"status": "none"}
+            if w.preempting:
+                # assignment fence: reclaimed capacity gets nothing new
+                # while its drain completes (the worker's own drain
+                # stops pulls too — this covers the notice->drain race
+                # and externally-observed preemptions)
+                return {"status": "wait"}
             if window:
                 # per-worker in-flight window: don't let one node's
                 # loaders hoard the queue while its siblings idle
@@ -941,7 +1024,14 @@ class Master:
                 # the Efficiency panel: roofline table + compile-ledger
                 # summary (util/coststats.py; a bare master usually has
                 # none — workers carry the kernel calls)
-                "efficiency": _coststats.status_dict()}
+                "efficiency": _coststats.status_dict(),
+                # the Remediation panel: playbook table + newest audit
+                # entries, plus this master's gates
+                "remediation": dict(
+                    _controller.status_dict(),
+                    admission_paused=self._admission_paused,
+                    autoscale_desired=self.autoscaler.desired()
+                    if self.autoscaler else None)}
 
     def _rpc_get_metrics(self, req: dict) -> dict:
         """Cluster-wide metrics: this process's snapshot plus every live
@@ -1037,6 +1127,92 @@ class Master:
     def _rpc_poke(self, req: dict) -> dict:
         self._last_poke = time.time()
         return {"ok": True}
+
+    # -- remediation actions (engine/controller.py binds these) -------------
+
+    def _pause_admission(self, transition: dict) -> str:
+        """admission_pause playbook, firing side: running bulks keep
+        flowing; NEW NewJob admissions answer retryable until the
+        backpressure resolves and the hysteresis hold elapses."""
+        reason = transition.get("rule", "backpressure")
+        lbl = transition.get("labels") or {}
+        if lbl:
+            reason += "[" + ",".join(
+                f"{k}={v}" for k, v in sorted(lbl.items())) + "]"
+        with self._lock:
+            self._admission_paused = reason
+        _M_ADMISSION_PAUSED.set(1)
+        return f"admission paused ({reason})"
+
+    def _resume_admission(self, transition: dict) -> str:
+        with self._lock:
+            self._admission_paused = None
+        _M_ADMISSION_PAUSED.set(0)
+        return "admission resumed"
+
+    def _autoscale_nudge(self, transition: dict) -> Optional[str]:
+        """autoscale_up playbook: a device_saturation firing transition
+        makes the autoscaler re-evaluate immediately instead of waiting
+        for the next periodic observation."""
+        target = self._autoscale_observe()
+        return None if target is None else f"desired={target}"
+
+    def _autoscale_observe(self) -> Optional[int]:
+        """Feed the autoscaler one observation of the cluster: live
+        worker count (preempting workers excluded — their capacity is
+        already leaving), master queue depth + outstanding tasks, and
+        how many workers report device_saturation firing."""
+        a = self.autoscaler
+        if a is None:
+            return None
+        with self._lock:
+            workers = sum(1 for w in self._workers.values()
+                          if w.active and not w.preempting)
+            saturated = sum(
+                1 for w in self._workers.values()
+                if w.active and "device_saturation" in w.firing)
+            bulk = self._bulk
+            if bulk is not None and not bulk.finished:
+                queued = bulk.q_count()
+                outstanding = len(bulk.outstanding)
+            else:
+                queued = outstanding = 0
+        # the master's own engine may also see saturation (in-process
+        # clusters share one registry) — count it once
+        if not saturated and any(
+                f.get("rule") == "device_saturation"
+                for f in _health.status_dict().get("firing", ())):
+            saturated = 1
+        return a.observe(workers=workers, queued=queued,
+                         outstanding=outstanding,
+                         saturated_workers=saturated)
+
+    def _fold_worker_alerts(self) -> None:
+        """Translate worker-reported firing alerts (heartbeat `firing`
+        field) into cluster-level transitions for the remediation
+        controller: stage_backpressure fires inside worker processes,
+        but the admission gate it must actuate lives here."""
+        if not _controller.enabled():
+            return
+        with self._lock:
+            union: Set[str] = set()
+            for w in self._workers.values():
+                if w.active:
+                    union |= w.firing
+            fired = union - self._worker_firing
+            resolved = self._worker_firing - union
+            self._worker_firing = union
+        ctrl = _controller.controller()
+        for rule in sorted(fired):
+            ctrl.on_transition({"state": "firing", "rule": rule,
+                                "severity": "warning",
+                                "labels": {"source": "workers"},
+                                "value": None})
+        for rule in sorted(resolved):
+            ctrl.on_transition({"state": "resolved", "rule": rule,
+                                "severity": "warning",
+                                "labels": {"source": "workers"},
+                                "value": None})
 
     def _rpc_post_profile(self, req: dict) -> dict:
         with self._lock:
@@ -1587,6 +1763,18 @@ class Master:
                     and finished_bulk_id != self._cleared_bulk_id:
                 self._clear_bulk_checkpoint(finished_bulk_id)
                 self._cleared_bulk_id = finished_bulk_id
+            # remediation drive (outside the control lock; everything
+            # below no-ops under SCANNER_TPU_REMEDIATION=0): fold
+            # worker-reported alerts into cluster transitions, run
+            # hysteresis-held resolve actions, observe the autoscaler
+            if _controller.enabled():
+                try:
+                    self._fold_worker_alerts()
+                    _controller.controller().tick(now)
+                    self._autoscale_observe()
+                except Exception:  # noqa: BLE001 — remediation must
+                    # never kill the liveness scan
+                    _mlog.exception("remediation tick failed")
 
     def _requeue_worker_tasks(self, wid: int) -> None:
         bulk = self._bulk
@@ -1618,6 +1806,27 @@ class Master:
         with self._lock:
             for w in self._workers.values():
                 _M_HB_AGE.remove_labels(worker=str(w.worker_id))
+        # unbind this master's remediation actions (owner-checked: a
+        # NEWER master's re-registration in the same process must
+        # survive this one's delayed stop): a later transition must not
+        # actuate a dead instance — and the bound methods would
+        # otherwise pin the whole Master object alive.  If admission
+        # was paused, clear the gate + gauge on the way out: the
+        # resume action is gone, so the pending hysteresis resolve
+        # could never reset them in a process that outlives the master
+        # (the same dead-master-alerts-forever class the heartbeat-age
+        # gauge cleanup above handles).
+        if _controller.enabled():
+            for name, fn in (("pause_admission", self._pause_admission),
+                             ("resume_admission",
+                              self._resume_admission),
+                             ("autoscale", self._autoscale_nudge)):
+                _controller.unregister_action(name, owner=fn)
+            with self._lock:
+                was_paused = self._admission_paused is not None
+                self._admission_paused = None
+            if was_paused:
+                _M_ADMISSION_PAUSED.set(0)
 
 
 # ---------------------------------------------------------------------------
@@ -1669,6 +1878,11 @@ class Worker:
         # SIGTERM drain mode (start_worker wires the signal): stop
         # pulling, finish in-flight tasks, deregister, then shut down
         self._draining = threading.Event()
+        # preemption notice (spot/preemptible reclaim, or the
+        # worker.preempt chaos site): drain as above, but ALSO
+        # advertise the notice on every heartbeat so the master fences
+        # assignment before the drain completes
+        self._preempting = False
         self._server = rpc.RpcServer(WORKER_SERVICE, {
             "Ping": lambda req: {"ok": True},
             # serves the master's cluster-wide metrics aggregation
@@ -1700,6 +1914,10 @@ class Worker:
         # land on THIS worker's flight recorder (node-labeled)
         _health.set_tracer(self.tracer)
         _health.ensure_started()
+        # remediation controller: worker-local playbooks (frame-cache
+        # shrink, ladder re-warm) actuate here; master-side ones stay
+        # unbound no-ops in this process
+        _controller.ensure_started()
         self.executor = LocalExecutor(
             self.db, self.profiler,
             num_load_workers=num_load_workers,
@@ -1750,6 +1968,17 @@ class Worker:
 
     def _heartbeat_loop(self) -> None:
         while not self._shutdown.is_set():
+            # spot-reclaim notice check: the worker.preempt chaos site
+            # models the cloud metadata server announcing preemption —
+            # a raise here IS the notice (routine drain + heartbeat
+            # advertisement), distinct from worker.heartbeat below
+            # which drops the beat itself
+            try:
+                if _faults.ACTIVE:
+                    _faults.inject("worker.preempt",
+                                   detail=str(self.worker_id))
+            except Exception:  # noqa: BLE001 — the injected reclaim
+                self.preempt("injected spot reclaim")
             try:
                 if _faults.ACTIVE:
                     _faults.inject("worker.heartbeat",
@@ -1761,8 +1990,14 @@ class Worker:
             # period) instead of the 30s client default: a hung master
             # must cost one missed beat, not pin this thread long
             # enough for the stale scan to remove a healthy worker
+            try:
+                firing = _health.firing_rules()
+            except Exception:  # noqa: BLE001 — liveness > health detail
+                firing = []
             hb = self.master.try_call("Heartbeat", worker_id=self.worker_id,
-                                      timeout=PING_TIMEOUT)
+                                      timeout=PING_TIMEOUT,
+                                      preempting=self._preempting,
+                                      firing=firing)
             if hb is not None:
                 if hb.get("reregister"):
                     # don't rejoin a cluster we are leaving
@@ -1795,6 +2030,25 @@ class Worker:
     def draining(self) -> bool:
         return self._draining.is_set()
 
+    def preempt(self, reason: str = "spot reclaim") -> None:
+        """Preemption-as-routine: a reclaim notice starts an ordinary
+        drain (finish in-flight, stop pulling, deregister) AND
+        advertises itself on every remaining heartbeat so the master
+        fences assignment immediately — anything this worker cannot
+        finish inside the reclaim window requeues strike-free via the
+        normal drain/stale paths.  Idempotent."""
+        if self._preempting:
+            return
+        self._preempting = True
+        _M_PREEMPTIONS.inc()
+        _wlog.warning("worker %d: preemption notice (%s) — fencing via "
+                      "heartbeat, draining in-flight tasks",
+                      self.worker_id, reason)
+        self.drain()
+
+    def preempting(self) -> bool:
+        return self._preempting
+
     def _finish_drain(self) -> None:
         """In-flight work is done: leave the cluster cleanly.  The
         explicit UnregisterWorker makes the master requeue-check and
@@ -1815,6 +2069,7 @@ class Worker:
             "worker_id": getattr(self, "worker_id", None),
             "master": master.address if master else None,
             "draining": self._draining.is_set(),
+            "preempting": self._preempting,
             "bulk_id": getattr(self, "_bulk_id", None),
             "pipeline_instances": ex.pipeline_instances if ex else None,
             "num_load_workers": ex.num_load_workers if ex else None,
@@ -1827,6 +2082,9 @@ class Worker:
             "framecache": _framecache.status_dict(),
             # the Efficiency panel: per-op roofline + compile ledger
             "efficiency": _coststats.status_dict(),
+            # the Remediation panel: playbooks bound in THIS process
+            # (frame-cache shrink, ladder re-warm) + audit tail
+            "remediation": _controller.status_dict(),
         }
 
     # ------------------------------------------------------------------
@@ -2135,7 +2393,18 @@ class ClusterClient:
         spec = cloudpickle.dumps({
             "outputs": list(outputs), "perf": perf,
             "cache_mode": cache_mode.value})
-        reply = self.master.call("NewJob", spec=spec, timeout=120.0)
+        # load shedding (admission_pause remediation playbook): a
+        # paused master answers retryable instead of admitting onto a
+        # backpressured cluster — back off and retry until it resumes,
+        # bounded by the same deadline a dead master gets
+        admit_deadline = time.time() + self.master_down_timeout
+        while True:
+            reply = self.master.call("NewJob", spec=spec, timeout=120.0)
+            if reply.get("admission_paused") \
+                    and time.time() < admit_deadline:
+                time.sleep(float(reply.get("retry_after") or 1.0))
+                continue
+            break
         if "error" in reply:
             raise JobException(reply["error"])
         bulk_id = reply["bulk_id"]

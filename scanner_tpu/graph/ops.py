@@ -10,7 +10,9 @@ The engine decides host-vs-TPU placement from OpSpec.device.
 
 from __future__ import annotations
 
+import dataclasses
 import inspect
+import threading
 import typing
 from dataclasses import dataclass, field
 from typing import (Any, Callable, Dict, List, Optional, Sequence, Tuple,
@@ -117,6 +119,77 @@ class OpSpec:
     def is_stateful(self) -> bool:
         return self.unbounded_state or self.bounded_state is not None
 
+    def __reduce__(self):
+        """Serialize with the kernel class hidden behind a NESTED
+        cloudpickle blob, restored through the local registry first
+        (`_restore_op_spec`).
+
+        Job specs travel as cloudpickle blobs, and test/user modules
+        often ride by value (``register_pickle_by_value``).  Unpickling
+        a by-value class in the SAME process is not a no-op even when
+        cloudpickle's tracker dedupes it back to the original class
+        object: the restore re-applies the pickled class ``__dict__``
+        onto the original, silently REBINDING every class attribute to
+        a dump-time copy (a mutable registry like ``executed_on = []``
+        loses all appends made since the dump — the
+        test_distributed_histogram registry-identity flake, where a
+        late-joining worker's spec load wiped the list mid-run).
+        Nesting the class blob means a process whose registry already
+        holds the op NEVER deserializes the class at all — the
+        registered spec IS the identity; only a process without the
+        registration (a spawned worker that never imported the
+        defining module) pays the class unpickle, where there is no
+        original to clobber."""
+        fields_d = {f.name: getattr(self, f.name)
+                    for f in dataclasses.fields(self)
+                    if f.name != "kernel_factory"}
+        fac = self.kernel_factory
+        if fac is None:
+            return (_restore_op_spec, (fields_d, None, None))
+        identity = (getattr(fac, "__module__", None),
+                    getattr(fac, "__qualname__", None))
+        # reentrancy guard: the class's own dump reaches its `_op_spec`
+        # backref and would recurse dumps(class) forever; the nested
+        # copy travels factory-less (the outer spec carries the blob)
+        active = getattr(_SPEC_REDUCE_GUARD, "active", None)
+        if active is None:
+            active = _SPEC_REDUCE_GUARD.active = set()
+        if id(fac) in active:
+            return (_restore_op_spec, (fields_d, identity, None))
+        active.add(id(fac))
+        try:
+            import cloudpickle
+            blob = cloudpickle.dumps(fac)
+        finally:
+            active.discard(id(fac))
+        return (_restore_op_spec, (fields_d, identity, blob))
+
+
+_SPEC_REDUCE_GUARD = threading.local()
+
+
+def _restore_op_spec(fields_d: Dict[str, Any],
+                     identity: Optional[Tuple],
+                     blob: Optional[bytes]) -> "OpSpec":
+    """Unpickle-side twin of OpSpec.__reduce__: when the local registry
+    holds a same-named op whose class matches the dump-time identity
+    (module + qualname), the REGISTERED spec is returned verbatim —
+    one canonical identity per process, zero class deserialization.
+    Otherwise the embedded class blob is loaded (spawned workers)."""
+    name = fields_d.get("name")
+    if identity is not None and name is not None and registry.has(name):
+        local = registry.get(name)
+        lf = local.kernel_factory
+        if lf is not None and (getattr(lf, "__module__", None),
+                               getattr(lf, "__qualname__", None)) \
+                == tuple(identity):
+            return local
+    factory = None
+    if blob is not None:
+        import cloudpickle
+        factory = cloudpickle.loads(blob)
+    return OpSpec(kernel_factory=factory, **fields_d)
+
 
 class OpRegistry:
     def __init__(self):
@@ -135,6 +208,35 @@ class OpRegistry:
 
     def has(self, name: str) -> bool:
         return name in self._ops
+
+    def canonical_factory(self, spec: OpSpec) -> Optional[Callable]:
+        """Resolve a spec's kernel factory to ONE canonical class.
+
+        Job specs travel as cloudpickle blobs; with
+        ``register_pickle_by_value`` the kernel class rides by value,
+        and the unpickled spec can carry a *class copy* distinct from
+        the locally-registered original (cloudpickle's class tracker
+        is best-effort).  In-process clusters then split identity:
+        kernels execute on the copy while everything that looked the
+        class up by name (tests, class-level state, re-registration)
+        holds the original.  When the local registry has a same-named
+        op whose class is the same module+qualname, the registered
+        class IS the op — return it; otherwise (spawned workers that
+        never imported the defining module, genuinely different ops)
+        the spec's own factory stands."""
+        fac = spec.kernel_factory
+        local = self._ops.get(spec.name)
+        if fac is None or local is None or local.kernel_factory is None:
+            return fac
+        lf = local.kernel_factory
+        if lf is fac:
+            return fac
+        if (getattr(lf, "__module__", None)
+                == getattr(fac, "__module__", None)
+                and getattr(lf, "__qualname__", None)
+                == getattr(fac, "__qualname__", None)):
+            return lf
+        return fac
 
     def names(self) -> List[str]:
         return sorted(self._ops)

@@ -89,6 +89,9 @@ SITES = (
     "pipeline.eval",      # engine/executor.py evaluate stage, per task
     "pipeline.save",      # engine/executor.py save stage, per task
     "worker.heartbeat",   # engine/service.py heartbeat loop, per beat
+    "worker.preempt",     # engine/service.py heartbeat loop, per beat:
+                          # a raise models a spot/preemptible reclaim
+                          # notice -> Worker.preempt() routine drain
     "memory.pressure",    # engine/batch.py to_device staging, per h2d
 )
 
@@ -411,6 +414,12 @@ NAMED_PLANS = {
     # (top ledger entries with owning task/trace), staged buffers freed,
     # strike-free transient requeue, bit-exact completion
     "memory-pressure": "memory.pressure:raise:exc=oom:n=1:times=1",
+    # spot reclaim notice on the armed worker's 2nd heartbeat ->
+    # Worker.preempt(): master fences assignment from the notice,
+    # in-flight tasks drain, leftovers requeue strike-free, siblings
+    # re-absorb the work (chaos_run arms ONE of N workers, so N=3 is
+    # the headline "preempt ~30% of workers mid-bulk" plan)
+    "worker-preempt": "worker.preempt:raise:n=2:times=1",
 }
 
 

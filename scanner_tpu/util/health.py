@@ -921,6 +921,18 @@ def rollup() -> Dict[str, Any]:
     return {"status": st["status"], "reasons": st["reasons"]}
 
 
+def firing_rules() -> List[str]:
+    """Names of the rules currently firing in this process (sorted,
+    deduped; [] when no engine ever started).  The compact form worker
+    heartbeats advertise every beat so the master can fold worker-side
+    alerts into cluster-level remediation transitions without a second
+    RPC (engine/service.py; engine/controller.py acts on them)."""
+    if _ENGINE is None:
+        return []
+    return sorted({f["rule"] for f in _ENGINE.firing()
+                   if f.get("rule")})
+
+
 def alertz_dict() -> Dict[str, Any]:
     if _ENGINE is None:
         out = _quiet(_ENABLED)

@@ -1,0 +1,613 @@
+"""Remediation controller suite (engine/controller.py + the service
+wiring): synthetic-clock playbook units (cooldown, hysteresis,
+dry-run, rate limit, audit), autoscaler A/B under synthetic
+saturation, the preemption-notice assignment fence, admission pause,
+scale-down-never-kills-in-flight, and the headline chaos e2e —
+preempt ~30% of workers mid-bulk under load, output bit-exact,
+requeues strike-free, no `unhealthy` roll-up page after rule
+hold-down (docs/robustness.md §Remediation playbooks)."""
+
+import collections
+import struct
+import sys
+import threading
+import time
+
+import cloudpickle
+import pytest
+
+from scanner_tpu import (CacheMode, Client, Kernel, NamedStream,
+                         PerfParams, register_op)
+from scanner_tpu.engine import controller as ctl
+from scanner_tpu.engine.service import Master, Worker, _BulkJob
+from scanner_tpu.util import faults
+from scanner_tpu.util import health as _health
+from scanner_tpu.util import metrics as _mx
+from scanner_tpu.util import retry as _retry
+
+# test kernels travel inside the job spec
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+pytestmark = pytest.mark.chaos
+
+N_ROWS = 48
+
+
+def _pk(v: int) -> bytes:
+    return struct.pack("<q", v)
+
+
+@register_op(name="CtlSlowDouble")
+class CtlSlowDouble(Kernel):
+    def execute(self, x: bytes) -> bytes:
+        time.sleep(0.25)
+        return _pk(2 * struct.unpack("<q", x)[0])
+
+
+EXPECT = [_pk(2 * (100 + i)) for i in range(N_ROWS)]
+
+
+def _counter(name: str, **labels) -> float:
+    entry = _mx.registry().snapshot().get(name, {})
+    total = 0.0
+    for s in entry.get("samples", []):
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            total += s["value"]
+    return total
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# playbook units (private controller, synthetic clock)
+# ---------------------------------------------------------------------------
+
+def _mk(playbooks, t0=1000.0):
+    clock = [t0]
+    c = ctl.RemediationController(playbooks=playbooks,
+                                  clock=lambda: clock[0])
+    return c, clock
+
+
+def _fire(rule, **labels):
+    return {"state": "firing", "rule": rule, "severity": "warning",
+            "labels": labels, "value": 1.0}
+
+
+def test_playbook_cooldown_is_per_label_group():
+    pb = ctl.Playbook(name="p", alert="hbm_pressure", action="act",
+                      cooldown_s=10.0, max_per_window=100)
+    c, clock = _mk([pb])
+    calls = []
+    c.register_action("act", lambda t: calls.append(t["labels"]))
+    c.on_transition(_fire("hbm_pressure", device="tpu:0"))
+    c.on_transition(_fire("hbm_pressure", device="tpu:0"))  # cooldown
+    # a DIFFERENT chip is not blocked by tpu:0's cooldown
+    c.on_transition(_fire("hbm_pressure", device="tpu:1"))
+    assert calls == [{"device": "tpu:0"}, {"device": "tpu:1"}]
+    outcomes = [a["outcome"] for a in c.audit()]
+    assert outcomes == ["applied", "cooldown", "applied"]
+    # past the cooldown the same chip acts again
+    clock[0] += 11.0
+    c.on_transition(_fire("hbm_pressure", device="tpu:0"))
+    assert len(calls) == 3
+
+
+def test_playbook_hysteresis_holds_and_refire_cancels():
+    pb = ctl.Playbook(name="p", alert="stage_backpressure",
+                      action="on", resolve_action="off",
+                      cooldown_s=0.0, hysteresis_s=5.0)
+    c, clock = _mk([pb])
+    calls = []
+    c.register_action("on", lambda t: calls.append("on"))
+    c.register_action("off", lambda t: calls.append("off"))
+    c.on_transition(_fire("stage_backpressure", stage="save"))
+    c.on_transition(dict(_fire("stage_backpressure", stage="save"),
+                         state="resolved"))
+    c.tick()                       # hold not elapsed
+    assert calls == ["on"]
+    clock[0] += 3.0
+    # alert re-fires inside the hold: the pending resolve is cancelled
+    c.on_transition(_fire("stage_backpressure", stage="save"))
+    clock[0] += 10.0
+    c.tick()
+    assert "off" not in calls
+    c.on_transition(dict(_fire("stage_backpressure", stage="save"),
+                         state="resolved"))
+    clock[0] += 6.0
+    c.tick()
+    assert calls[-1] == "off"
+
+
+def test_playbook_rate_limit_and_unbound_and_error():
+    pb = ctl.Playbook(name="p", alert="recompile_storm", action="act",
+                      cooldown_s=0.0, max_per_window=2, window_s=60.0)
+    c, clock = _mk([pb])
+    # unbound: no action registered yet
+    c.on_transition(_fire("recompile_storm"))
+    assert c.audit()[-1]["outcome"] == "unbound"
+
+    n = [0]
+
+    def act(t):
+        n[0] += 1
+        if n[0] == 2:
+            raise RuntimeError("boom")
+        return f"ok{n[0]}"
+
+    c.register_action("act", act)
+    c.on_transition(_fire("recompile_storm"))          # applied
+    c.on_transition(_fire("recompile_storm"))          # applied -> error
+    c.on_transition(_fire("recompile_storm"))          # rate limited
+    outcomes = [a["outcome"] for a in c.audit()]
+    assert outcomes == ["unbound", "applied", "error", "rate_limited"]
+    assert c.audit()[1]["detail"] == "ok1"
+    assert "boom" in c.audit()[2]["detail"]
+    # the window slides: actions return after it passes
+    clock[0] += 61.0
+    c.on_transition(_fire("recompile_storm"))
+    assert c.audit()[-1]["outcome"] == "applied"
+
+
+def test_playbook_dry_run_audits_without_invoking(monkeypatch):
+    pb = ctl.Playbook(name="p", alert="hbm_pressure", action="act",
+                      cooldown_s=30.0)
+    c, _clock = _mk([pb])
+    calls = []
+    c.register_action("act", lambda t: calls.append(1))
+    monkeypatch.setattr(ctl, "_DRY_RUN", True)
+    c.on_transition(_fire("hbm_pressure", device="tpu:0"))
+    assert calls == []
+    assert c.audit()[-1]["outcome"] == "dry_run"
+    assert _counter("scanner_tpu_remediations_total", playbook="p",
+                    action="act", outcome="dry_run") >= 1
+    # dry-run records gate state: the staging decision sequence must
+    # match production's (dry_run then cooldown, not dry_run forever)
+    c.on_transition(_fire("hbm_pressure", device="tpu:0"))
+    assert c.audit()[-1]["outcome"] == "cooldown"
+    assert calls == []
+
+
+def test_resolve_waits_for_every_label_group():
+    """One stage recovering must not resume admission while another is
+    still backpressured: the resolve reversal runs only once EVERY
+    firing label-group of the alert has resolved."""
+    pb = ctl.Playbook(name="p", alert="stage_backpressure",
+                      action="on", resolve_action="off",
+                      cooldown_s=0.0, hysteresis_s=0.0)
+    c, _clock = _mk([pb])
+    calls = []
+    c.register_action("on", lambda t: calls.append("on"))
+    c.register_action("off", lambda t: calls.append("off"))
+    c.on_transition(_fire("stage_backpressure", stage="load"))
+    c.on_transition(_fire("stage_backpressure", stage="save"))
+    c.on_transition(dict(_fire("stage_backpressure", stage="load"),
+                         state="resolved"))
+    assert "off" not in calls          # save still fires
+    c.on_transition(dict(_fire("stage_backpressure", stage="save"),
+                         state="resolved"))
+    assert calls[-1] == "off"
+
+
+def test_autoscaler_rolls_back_desired_on_actuator_failure():
+    """A failed actuation (transient k8s API error) must not latch the
+    new desired count — later observations keep retrying, paced by the
+    cooldown, until the actuator succeeds."""
+    clock = [9000.0]
+    boom = [True]
+    applied = []
+
+    def actuator(n):
+        if boom[0]:
+            raise RuntimeError("kubectl down")
+        applied.append(n)
+
+    ctrl = ctl.RemediationController(playbooks=[],
+                                     clock=lambda: clock[0])
+    a = ctl.Autoscaler(
+        ctl.AutoscaleConfig(min_replicas=1, max_replicas=4,
+                            queue_per_worker=2.0, up_cooldown_s=10.0),
+        actuator=actuator, controller=ctrl, clock=lambda: clock[0])
+    assert a.observe(workers=1, queued=8, outstanding=0) is None
+    assert ctrl.audit()[-1]["outcome"] == "error"
+    assert a.desired() == 1            # rolled back, not latched
+    # after the cooldown the same signal retries and succeeds
+    boom[0] = False
+    clock[0] += 11.0
+    assert a.observe(workers=1, queued=8, outstanding=0) == 4
+    assert applied == [4] and a.desired() == 4
+
+
+def test_unregister_action_is_owner_checked():
+    c, _clock = _mk([])
+    old = lambda t: "old"      # noqa: E731
+    new = lambda t: "new"      # noqa: E731
+    c.register_action("act", old)
+    c.register_action("act", new)      # latest wins
+    c.unregister_action("act", owner=old)   # stale owner: no-op
+    with c._lock:
+        assert c._actions.get("act") is new
+    c.unregister_action("act", owner=new)
+    with c._lock:
+        assert "act" not in c._actions
+
+
+def test_master_stop_clears_pause_gauge_and_keeps_sibling(tmp_path):
+    """A master stopped while admission is paused must reset the
+    process-wide gauge/gate, and its stop must not strip a newer
+    same-process master's action bindings."""
+    a = Master(db_path=str(tmp_path / "a"), no_workers_timeout=30.0)
+    b = Master(db_path=str(tmp_path / "b"), no_workers_timeout=30.0)
+    a._pause_admission(_fire("stage_backpressure"))
+    assert _counter("scanner_tpu_master_admission_paused") == 1
+    a.stop()
+    assert _counter("scanner_tpu_master_admission_paused") == 0
+    # b's bindings (latest registration) survived a's stop
+    with ctl.controller()._lock:
+        cur = ctl.controller()._actions.get("pause_admission")
+    assert cur == b._pause_admission
+    b.stop()
+
+
+def test_disabled_controller_is_signal_only(monkeypatch):
+    pb = ctl.Playbook(name="p", alert="hbm_pressure", action="act",
+                      cooldown_s=0.0)
+    c, _clock = _mk([pb])
+    calls = []
+    c.register_action("act", lambda t: calls.append(1))
+    monkeypatch.setattr(ctl, "_ENABLED", False)
+    c.on_transition(_fire("hbm_pressure", device="tpu:0"))
+    c.tick()
+    assert calls == [] and c.audit() == []
+    assert ctl.ensure_started() is None
+
+
+def test_default_playbooks_bind_known_alerts():
+    rules = {r.name for r in _health.DEFAULT_RULES}
+    for pb in ctl.DEFAULT_PLAYBOOKS:
+        assert pb.alert in rules, pb.name
+
+
+def test_ladder_rewarm_action_through_playbook(monkeypatch):
+    from scanner_tpu.engine import evaluate as _evaluate
+    monkeypatch.setattr(_evaluate, "rewarm_all", lambda: 3)
+    c, _clock = _mk([p for p in ctl.default_playbooks()
+                     if p.name == "ladder_rewarm"])
+    c.register_action("rewarm_ladders", ctl._rewarm_ladders)
+    c.on_transition(_fire("recompile_storm"))
+    entry = c.audit()[-1]
+    assert entry["outcome"] == "applied"
+    assert entry["detail"] == "rewarmed 3 kernel ladder(s)"
+
+
+def test_rewarm_all_empty_registry_is_zero():
+    # no live evaluators in this moment -> 0, never an exception
+    from scanner_tpu.engine import evaluate as _evaluate
+    assert isinstance(_evaluate.rewarm_all(), int)
+
+
+# ---------------------------------------------------------------------------
+# autoscaler units (synthetic clock, callback actuator)
+# ---------------------------------------------------------------------------
+
+def _mk_autoscaler(**cfg_kw):
+    clock = [5000.0]
+    scaled = []
+    cfg = ctl.AutoscaleConfig(**cfg_kw)
+    ctrl = ctl.RemediationController(playbooks=[],
+                                     clock=lambda: clock[0])
+    a = ctl.Autoscaler(cfg, actuator=scaled.append, controller=ctrl,
+                       clock=lambda: clock[0])
+    return a, clock, scaled, ctrl
+
+
+def test_autoscaler_converges_within_bounds_with_cooldowns():
+    a, clock, scaled, _c = _mk_autoscaler(
+        min_replicas=1, max_replicas=4, queue_per_worker=2.0,
+        up_cooldown_s=10.0, down_cooldown_s=10.0, idle_grace_s=5.0)
+    # synthetic saturation + deep backlog: wants 4 (clamped from 5+)
+    assert a.observe(workers=1, queued=10, outstanding=2,
+                     saturated_workers=1) == 4
+    assert scaled == [4]
+    # cooldown: an immediate second up-signal does nothing
+    assert a.observe(workers=1, queued=20, outstanding=0,
+                     saturated_workers=1) is None
+    assert scaled == [4]
+    # the clamp holds whatever the backlog says
+    clock[0] += 11.0
+    assert a.observe(workers=4, queued=100, outstanding=0,
+                     saturated_workers=4) is None  # already at max
+    assert a.desired() == 4
+
+
+def test_autoscaler_scales_down_one_step_only_when_idle():
+    a, clock, scaled, _c = _mk_autoscaler(
+        min_replicas=1, max_replicas=4, queue_per_worker=2.0,
+        up_cooldown_s=0.0, down_cooldown_s=0.0, idle_grace_s=5.0)
+    a.observe(workers=1, queued=8, outstanding=0)     # up to 4
+    assert a.desired() == 4
+    # work still queued/outstanding: NEVER scales down
+    a.observe(workers=4, queued=0, outstanding=1)
+    clock[0] += 100.0
+    a.observe(workers=4, queued=0, outstanding=1)
+    assert a.desired() == 4
+    # idle, but the grace period must elapse first
+    a.observe(workers=4, queued=0, outstanding=0)
+    assert a.desired() == 4
+    clock[0] += 6.0
+    a.observe(workers=4, queued=0, outstanding=0)
+    assert a.desired() == 3 and scaled[-1] == 3
+    # one step at a time, re-armed only after another full grace
+    a.observe(workers=3, queued=0, outstanding=0)
+    assert a.desired() == 3
+    clock[0] += 6.0
+    a.observe(workers=3, queued=0, outstanding=0)
+    assert a.desired() == 2
+    # never below min
+    for _ in range(5):
+        clock[0] += 6.0
+        a.observe(workers=2, queued=0, outstanding=0)
+    assert a.desired() == 1
+
+
+def test_autoscaler_dry_run_and_unbound(monkeypatch):
+    a, _clock, scaled, c = _mk_autoscaler(
+        min_replicas=1, max_replicas=4, queue_per_worker=1.0,
+        up_cooldown_s=0.0)
+    monkeypatch.setattr(ctl, "_DRY_RUN", True)
+    assert a.observe(workers=1, queued=4, outstanding=0) == 4
+    assert scaled == []
+    assert c.audit()[-1]["outcome"] == "dry_run"
+    monkeypatch.setattr(ctl, "_DRY_RUN", False)
+    a2 = ctl.Autoscaler(ctl.AutoscaleConfig(max_replicas=4,
+                                            up_cooldown_s=0.0),
+                        actuator=None, controller=c,
+                        clock=lambda: 1.0)
+    assert a2.observe(workers=1, queued=40, outstanding=0) == 4
+    assert c.audit()[-1]["outcome"] == "unbound"
+
+
+def test_autoscaler_disabled_is_inert(monkeypatch):
+    a, _clock, scaled, c = _mk_autoscaler(up_cooldown_s=0.0)
+    monkeypatch.setattr(ctl, "_ENABLED", False)
+    assert a.observe(workers=1, queued=100, outstanding=0) is None
+    assert scaled == [] and c.audit() == []
+
+
+# ---------------------------------------------------------------------------
+# retry budget (util/retry.py)
+# ---------------------------------------------------------------------------
+
+def test_retry_budget_floor_and_deposit():
+    b = _retry.RetryBudget(max_tokens=4.0, token_ratio=1.0)
+    assert b.take() and b.take()
+    assert not b.take()            # at the floor (max/2)
+    b.on_success()
+    assert b.take()
+    b.reset()
+    assert b.tokens() == 4.0
+
+
+def test_call_with_backoff_respects_budget():
+    b = _retry.RetryBudget(max_tokens=4.0, token_ratio=1.0)
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        raise ConnectionError("down")
+
+    before = _counter("scanner_tpu_retry_budget_exhausted_total")
+    with pytest.raises(ConnectionError):
+        _retry.call_with_backoff(
+            flaky, is_transient=lambda e: True, retries=10,
+            base=0.0001, cap=0.001, budget=b, label="unit")
+    # 2 retries allowed (tokens 4 -> floor 2), then fail-fast
+    assert calls[0] == 3
+    assert _counter("scanner_tpu_retry_budget_exhausted_total",
+                    site="unit") >= 1
+    assert _counter("scanner_tpu_retry_budget_exhausted_total") > before
+    # successes refill: the shared-path deposit happens on return
+    b.on_success()
+    assert _retry.call_with_backoff(
+        lambda: 7, is_transient=lambda e: True, budget=b) == 7
+
+
+# ---------------------------------------------------------------------------
+# master wiring units (no pipeline)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def bare_master(tmp_path):
+    m = Master(db_path=str(tmp_path / "db"), no_workers_timeout=30.0)
+    yield m
+    m.stop()
+
+
+def test_admission_pause_gates_new_job_and_resumes(bare_master):
+    m = bare_master
+    m._pause_admission(_fire("stage_backpressure", source="workers"))
+    reply = m._rpc_new_job({"spec": b"irrelevant"})
+    assert reply.get("admission_paused") is True
+    assert "admission paused" in reply["error"]
+    assert reply.get("retry_after")
+    assert _counter("scanner_tpu_master_admission_paused") == 1
+    m._resume_admission({})
+    assert m._admission_paused is None
+    assert _counter("scanner_tpu_master_admission_paused") == 0
+
+
+def test_worker_alert_fold_drives_admission_playbook(bare_master):
+    """A worker-side stage_backpressure alert (heartbeat `firing`
+    field) reaches the master's admission gate through the scan-loop
+    fold, and resumes after resolve + hysteresis."""
+    m = bare_master
+    wid = m._rpc_register_worker({"address": ""})["worker_id"]
+    m._rpc_heartbeat({"worker_id": wid,
+                      "firing": ["stage_backpressure"]})
+    m._fold_worker_alerts()
+    deadline = time.time() + 2.0
+    while m._admission_paused is None and time.time() < deadline:
+        time.sleep(0.01)
+    assert m._admission_paused is not None
+    # backpressure clears -> resolve arms the hysteresis hold; the
+    # master's scan loop ticks the controller every 0.5 s
+    m._rpc_heartbeat({"worker_id": wid, "firing": []})
+    m._fold_worker_alerts()
+    deadline = time.time() + 10.0
+    while m._admission_paused is not None and time.time() < deadline:
+        time.sleep(0.05)
+    assert m._admission_paused is None
+
+
+def test_preemption_notice_fences_assignment(bare_master):
+    m = bare_master
+    w0 = m._rpc_register_worker({"address": ""})["worker_id"]
+    w1 = m._rpc_register_worker({"address": ""})["worker_id"]
+    bulk = _BulkJob(bulk_id=0, spec_blob=b"", task_timeout=0.0)
+    bulk.job_tasks[0] = {(0, t) for t in range(4)}
+    for t in range(4):
+        bulk.task_rows[(0, t)] = 1
+    bulk.queue[0] = collections.deque(range(4))
+    bulk.job_rr.append(0)
+    bulk.total_tasks = 4
+    with m._lock:
+        m._bulk = bulk
+        m._history[0] = bulk
+    before = _counter("scanner_tpu_worker_preempt_notices_total")
+    m._rpc_heartbeat({"worker_id": w0, "preempting": True})
+    assert _counter(
+        "scanner_tpu_worker_preempt_notices_total") == before + 1
+    # the fenced worker gets nothing new; a healthy sibling does
+    assert m._rpc_next_work(
+        {"worker_id": w0, "bulk_id": 0})["status"] == "wait"
+    assert m._rpc_next_work(
+        {"worker_id": w1, "bulk_id": 0})["status"] == "task"
+    # the notice is idempotent (one counter bump per worker)
+    m._rpc_heartbeat({"worker_id": w0, "preempting": True})
+    assert _counter(
+        "scanner_tpu_worker_preempt_notices_total") == before + 1
+
+
+def test_master_statusz_carries_remediation_panel(bare_master):
+    st = bare_master._statusz()
+    assert "remediation" in st
+    names = {p["name"] for p in st["remediation"]["playbooks"]}
+    assert {"admission_pause", "frame_cache_shrink",
+            "ladder_rewarm", "autoscale_up"} <= names
+
+
+# ---------------------------------------------------------------------------
+# cluster e2e (in-process master + workers)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def cluster3(tmp_path):
+    """Master + 3 in-process workers over a packed-int source table."""
+    db_path = str(tmp_path / "db")
+    seed = Client(db_path=db_path)
+    seed.new_table("ctl_src", ["output"],
+                   [[_pk(100 + i)] for i in range(N_ROWS)])
+    master = Master(db_path=db_path, no_workers_timeout=30.0)
+    addr = f"localhost:{master.port}"
+    workers = [Worker(addr, db_path=db_path) for _ in range(3)]
+    sc = Client(db_path=db_path, master=addr)
+    yield sc, master, workers, db_path
+    faults.clear()
+    sc.stop()
+    for w in workers:
+        w.stop()
+    master.stop()
+
+
+def _run_golden(sc, out_name: str, **perf_kw):
+    col = sc.io.Input([NamedStream(sc, "ctl_src")])
+    col = sc.ops.CtlSlowDouble(x=col)
+    out = NamedStream(sc, out_name)
+    sc.run(sc.io.Output(col, [out]), PerfParams.manual(2, 2, **perf_kw),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    return [bytes(r) for r in out.load()]
+
+
+def test_preempt_30pct_mid_bulk_bit_exact_no_strikes(cluster3):
+    """The headline chaos plan (ISSUE/ROADMAP item 5): preempt ~30% of
+    workers (1 of 3) mid-bulk under load.  Output bit-exact vs a clean
+    run, requeues strike-free, the master fenced the victim, and no
+    `unhealthy` roll-up page stands once the rule hold-downs pass."""
+    sc, master, workers, _dbp = cluster3
+    victim = workers[1]
+    strikes0 = _counter("scanner_tpu_blacklist_strikes_total")
+    notices0 = _counter("scanner_tpu_worker_preempt_notices_total")
+    # 2nd heartbeat after arming ≈ 1–2 s in: mid-bulk for this load
+    # (48 rows x 0.25 s / 3 workers ≈ 4 s)
+    faults.install(f"worker.preempt:raise:"
+                   f"match={victim.worker_id}:n=2:times=1")
+    got = _run_golden(sc, "ctl_faulted")
+    assert faults.fired("worker.preempt") == 1, \
+        "preemption never fired (bulk too fast?)"
+    faults.clear()
+    golden = _run_golden(sc, "ctl_clean")
+    assert got == golden == EXPECT
+    # strike-free: a preemption is routine, not a task failure
+    assert _counter("scanner_tpu_blacklist_strikes_total") == strikes0
+    # the master saw the notice (fence) and the worker drained out
+    assert _counter(
+        "scanner_tpu_worker_preempt_notices_total") == notices0 + 1
+    assert victim.preempting() and victim.draining()
+    assert _counter("scanner_tpu_worker_preemptions_total") >= 1
+    # the cluster re-absorbed the work on the two survivors
+    st = master._rpc_job_status({})
+    assert st["num_workers"] == 2
+    # no standing page after hold-down: give the health engine a few
+    # ticks past every default rule's for_seconds, then require the
+    # master roll-up not unhealthy and no heartbeat-stale alert for
+    # the departed worker (its gauge child was dropped at drain)
+    deadline = time.time() + 8.0
+    while time.time() < deadline:
+        h = _health.status_dict()
+        stale = [f for f in h.get("firing", ())
+                 if f.get("rule") == "worker_heartbeat_stale"]
+        if h.get("status") != "unhealthy" and not stale:
+            break
+        time.sleep(0.25)
+    h = _health.status_dict()
+    assert h.get("status") != "unhealthy", h
+    assert not [f for f in h.get("firing", ())
+                if f.get("rule") == "worker_heartbeat_stale"], h
+
+
+def test_scale_down_drain_never_kills_in_flight(cluster3):
+    """The autoscaler's scale-down contract end to end: reducing
+    capacity through the drain path mid-bulk loses no work — the
+    drained worker finishes what it holds, the rest requeues
+    strike-free, output stays bit-exact."""
+    sc, master, workers, _dbp = cluster3
+    strikes0 = _counter("scanner_tpu_blacklist_strikes_total")
+    drains0 = _counter("scanner_tpu_worker_drains_total")
+    result = {}
+
+    def run():
+        result["rows"] = _run_golden(sc, "ctl_scaledown")
+
+    t = threading.Thread(target=run)
+    t.start()
+    time.sleep(1.5)               # mid-bulk
+    # what deploy.Cluster.scale does to the surplus pod: SIGTERM ->
+    # drain (finish in-flight, deregister) — never a kill
+    workers[2].drain()
+    t.join(timeout=120)
+    assert not t.is_alive()
+    assert result["rows"] == EXPECT
+    assert _counter("scanner_tpu_blacklist_strikes_total") == strikes0
+    assert _counter("scanner_tpu_worker_drains_total") == drains0 + 1
+    assert master._rpc_job_status({})["num_workers"] == 2
+
+
+def test_named_plan_worker_preempt_registered():
+    assert "worker-preempt" in faults.NAMED_PLANS
+    rules = faults.parse_plan(faults.NAMED_PLANS["worker-preempt"])
+    assert rules[0].site == "worker.preempt"

@@ -312,6 +312,95 @@ def test_stage_rows_not_double_counted_on_retry():
     assert bulk.stage_rows == {"load": 8, "evaluate": 8, "save": 0}
 
 
+def test_ops_registry_resolves_canonical_class_identity():
+    """The PR 10 flake root cause, pinned: a cloudpickle
+    register_pickle_by_value round-trip of the job spec can hand the
+    evaluator a *class copy* of a registered op — kernels then record
+    class-level state (DistHist.executed_on) on the copy while readers
+    hold the original.  The registry resolves a same-named,
+    same-qualname factory back to the registered original; genuinely
+    different classes (spawned workers, name reuse) pass through."""
+    import dataclasses
+
+    from scanner_tpu.graph import ops as O
+
+    spec = DistHist._op_spec
+    assert O.registry.canonical_factory(spec) is DistHist
+
+    # simulate the by-value copy cloudpickle mints when its class
+    # tracker misses: same module + qualname, different object
+    copy_cls = type(DistHist.__name__, (Kernel,), {
+        "__module__": DistHist.__module__,
+        "__qualname__": DistHist.__qualname__,
+        "executed_on": [],
+        "execute": DistHist.execute,
+    })
+    assert copy_cls is not DistHist
+    spec_copy = dataclasses.replace(spec, kernel_factory=copy_cls)
+    assert O.registry.canonical_factory(spec_copy) is DistHist
+
+    # a same-named class from a DIFFERENT module is NOT the same op:
+    # the spec's own factory stands (spawned-worker semantics)
+    alien = type(DistHist.__name__, (Kernel,), {
+        "__module__": "somewhere.else",
+        "__qualname__": DistHist.__qualname__,
+    })
+    spec_alien = dataclasses.replace(spec, kernel_factory=alien)
+    assert O.registry.canonical_factory(spec_alien) is alien
+
+    # and the evaluator path instantiates the canonical class: a
+    # KernelInstance built from a copy-carrying node runs the ORIGINAL
+    # (whose executed_on the flaky test reads), not the copy
+    from scanner_tpu.engine.evaluate import KernelInstance
+    from scanner_tpu.util.profiler import Profiler
+
+    inp = O.OpNode(O.INPUT_OP, {})
+    node = O.OpNode("DistHist", {"frame": inp.outputs[0]})
+    node.spec = spec_copy
+    ki = KernelInstance(node, Profiler(node="test"))
+    assert type(ki.kernel) is DistHist
+    ki.close()
+
+
+def test_op_spec_roundtrip_resolves_registry_and_preserves_state():
+    """The actual flake mechanism, pinned: unpickling a by-value class
+    in the SAME process re-applies its pickled __dict__ onto the
+    deduped original, REBINDING class attributes to dump-time copies —
+    DistHist.executed_on appends made after the dump vanished when a
+    late-joining worker loaded the job spec.  OpSpec.__reduce__ now
+    nests the class blob and the restore resolves through the
+    registry, so an in-process round trip touches no class state and
+    returns THE registered spec object."""
+    from scanner_tpu.graph import ops as O
+
+    spec = DistHist._op_spec
+    blob = cloudpickle.dumps(spec)
+    before = DistHist.executed_on
+    DistHist.executed_on.append("sentinel-after-dump")
+    try:
+        spec2 = cloudpickle.loads(blob)
+        # canonical identity: the registered spec itself comes back
+        assert spec2 is O.registry.get("DistHist")
+        assert spec2.kernel_factory is DistHist
+        # and the round trip did NOT clobber class state: the list is
+        # the same object and the post-dump append survived
+        assert DistHist.executed_on is before
+        assert "sentinel-after-dump" in DistHist.executed_on
+    finally:
+        DistHist.executed_on.clear()
+    # a process WITHOUT the registration still reconstructs a working
+    # spec from the nested class blob (the spawned-worker path)
+    orig = O.registry._ops.pop("DistHist")
+    try:
+        spec3 = cloudpickle.loads(blob)
+        assert spec3 is not orig
+        assert spec3.kernel_factory is not None
+        assert spec3.kernel_factory.__qualname__ == "DistHist"
+        assert spec3.name == "DistHist"
+    finally:
+        O.registry._ops["DistHist"] = orig
+
+
 def test_cluster_profiles(cluster):
     sc, master, workers, _dbp, _addr = cluster
     frame = sc.io.Input([NamedVideoStream(sc, "test1")])

@@ -666,6 +666,120 @@ def test_framecache_config_schema_both_directions(tmp_path):
     assert not any("frame_cache_enabled" in m for m in msgs)
 
 
+def _remediation_repo(tmp_path,
+                      code_pbs=(("pb_a", "rule_a"), ("pb_b", "rule_b")),
+                      rule_names=("rule_a", "rule_b"),
+                      doc_rows=(("pb_a", "rule_a"), ("pb_b", "rule_b")),
+                      cfg_keys=("enabled", "dry_run"),
+                      schema_keys=("enabled", "dry_run"),
+                      with_markers=True):
+    """Synthetic mini-repo for the SC311 remediation contract lints."""
+    _write(tmp_path, "setup.py", "# root marker\n")
+    pbs = ",\n            ".join(
+        f'Playbook(name="{n}", alert="{a}", action="act_{n}")'
+        for n, a in code_pbs)
+    schema = ", ".join(f'"{k}"' for k in schema_keys)
+    _write(tmp_path, "pkg/engine/controller.py", f"""
+        def Playbook(**kw):
+            return kw
+
+        CONFIG_KEYS = ({schema},)
+
+        DEFAULT_PLAYBOOKS = (
+            {pbs},
+        )
+    """)
+    rules = ",\n            ".join(
+        f'Rule(name="{n}", series="scanner_tpu_x")' for n in rule_names)
+    _write(tmp_path, "pkg/util/health.py", f"""
+        def Rule(**kw):
+            return kw
+
+        DEFAULT_RULES = (
+            {rules},
+        )
+    """)
+    cfg = ", ".join(f'"{k}": 1' for k in cfg_keys)
+    _write(tmp_path, "pkg/config.py", f"""
+        def default_config():
+            return {{"remediation": {{{cfg}}}}}
+    """)
+    rows = "\n".join(f"| `{n}` | `{a}` | act | 5 s | env |"
+                     for n, a in doc_rows)
+    table = (f"<!-- remediation-playbooks:begin -->\n"
+             f"| Playbook | Alert | Action | Cooldown | Kill switch |\n"
+             f"|---|---|---|---|---|\n"
+             f"{rows}\n<!-- remediation-playbooks:end -->\n"
+             if with_markers else rows)
+    keys = " ".join(f"`{k}`"
+                    for k in sorted(set(cfg_keys) | set(schema_keys)))
+    _write(tmp_path, "docs/robustness.md", f"""
+        Remediation playbook matrix:
+
+        {table}
+    """)
+    _write(tmp_path, "docs/observability.md", f"""
+        Config keys documented for SC304: {keys}
+    """)
+    return tmp_path
+
+
+def test_remediation_clean_fixture_is_quiet(tmp_path):
+    _remediation_repo(tmp_path)
+    _, findings = _analyze(tmp_path, "pkg")
+    assert [f for f in findings if f.code == "SC311"] == []
+
+
+def test_remediation_unknown_alert_binding(tmp_path):
+    _remediation_repo(tmp_path,
+                      code_pbs=(("pb_a", "rule_a"),
+                                ("pb_b", "rule_ghost")),
+                      doc_rows=(("pb_a", "rule_a"),
+                                ("pb_b", "rule_ghost")))
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC311"]
+    assert any("`pb_b`" in m and "rule_ghost" in m
+               and "no such rule" in m for m in msgs)
+    assert not any("`pb_a`" in m for m in msgs)
+
+
+def test_remediation_docs_matrix_both_directions(tmp_path):
+    _remediation_repo(tmp_path,
+                      code_pbs=(("pb_a", "rule_a"),
+                                ("pb_undoc", "rule_b")),
+                      doc_rows=(("pb_a", "rule_b"),
+                                ("pb_ghost", "rule_a")))
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC311"]
+    # code playbook absent from docs
+    assert any("`pb_undoc`" in m and "missing from" in m for m in msgs)
+    # docs row with no code playbook
+    assert any("`pb_ghost`" in m and "no such playbook" in m
+               for m in msgs)
+    # alert binding mismatch between code and the docs row
+    assert any("`pb_a`" in m and "docs matrix row says" in m
+               for m in msgs)
+
+
+def test_remediation_missing_marker_table(tmp_path):
+    _remediation_repo(tmp_path, with_markers=False)
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC311"]
+    assert any("marker table" in m for m in msgs)
+
+
+def test_remediation_config_schema_both_directions(tmp_path):
+    _remediation_repo(tmp_path,
+                      cfg_keys=("enabled", "dry_run", "bogus"),
+                      schema_keys=("enabled", "dry_run", "min_only"))
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC311"]
+    assert any("[remediation] bogus" in m and "does not accept" in m
+               for m in msgs)
+    assert any("`min_only`" in m and "declares no" in m for m in msgs)
+    assert not any("enabled" in m for m in msgs)
+
+
 def test_contract_rpc_contracts_table_both_directions(tmp_path):
     _write(tmp_path, "setup.py", "# root\n")
     _write(tmp_path, "pkg/rpcmod.py", """

@@ -95,7 +95,7 @@ def detail_digest(bench_dir):
     except (OSError, ValueError):
         return {}
     out = {"fps_by_config": {}, "task_latency": {}, "health": {},
-           "op_efficiency": {}, "frame_cache": {},
+           "op_efficiency": {}, "frame_cache": {}, "remediation": {},
            "baseline_metrics": {}}
     for d in detail:
         if not isinstance(d, dict):
@@ -114,6 +114,9 @@ def detail_digest(bench_dir):
         elif d.get("config") in ("frame_cache", "frame_cache_hw"):
             out["frame_cache"][d["config"]] = {
                 k: v for k, v in d.items() if k != "config"}
+        elif d.get("config") == "remediation":
+            out["remediation"] = {k: v for k, v in d.items()
+                                  if k != "config"}
         elif d.get("config") == "baseline_metrics":
             out["baseline_metrics"] = d.get("metrics") or {}
     return out
@@ -276,6 +279,16 @@ def main(argv=None) -> int:
                   + f", decode saved {fcd.get('decode_seconds_saved')}s"
                   f", h2d saved "
                   f"{(fcd.get('h2d_bytes_saved') or 0) / 1e6:.1f} MB")
+        rem = detail.get("remediation") or {}
+        if rem.get("enabled"):
+            n_applied = sum(
+                v for k, v in (rem.get("remediations") or {}).items()
+                if "applied" in k)
+            print(f"  remediation: preemption recovery "
+                  f"{rem.get('preemption_recovery_s')}s, "
+                  f"{int(rem.get('preemptions') or 0)} preemption(s), "
+                  f"strikes {int(rem.get('strike_delta') or 0)}, "
+                  f"{int(n_applied)} action(s) applied")
         if base_metrics:
             print("  baselines: " + "  ".join(
                 f"{k}={v.get('value')}" for k, v in

@@ -220,12 +220,21 @@ def main() -> int:
                             for s in entry.get("samples", []))
         crashed = [p for p in procs
                    if p.poll() == faults.CRASH_EXIT_CODE]
+        # a preempted worker drains and exits 0 BEFORE the metric poll,
+        # taking its own faults counter with it — the master-side
+        # preemption-notice counter is the surviving evidence
+        preempt_notices = sum(
+            s.get("value", 0) for s in snap.get(
+                "scanner_tpu_worker_preempt_notices_total",
+                {}).get("samples", []))
         print(f"\nfault fired: local={int(local_fired)} "
               f"cluster-metric={int(cluster_fired)} "
-              f"injected-crashes={len(crashed)}")
+              f"injected-crashes={len(crashed)} "
+              f"preempt-notices={int(preempt_notices)}")
         print(f"output bit-exact to clean run: {exact} "
               f"({len(got)} rows)")
         fired = bool(local_fired or cluster_fired or crashed
+                     or preempt_notices
                      or respawned.get("rc") == faults.CRASH_EXIT_CODE)
         rc = 0 if (exact and fired) else 1
         if not fired:
