@@ -604,6 +604,129 @@ def main():
             _rem_d = {"config": "remediation",
                       "error": f"{type(e).__name__}: {e}"}
         detail.append(_rem_d)
+
+        # failover digest (engine/journal.py): a bounded live master-
+        # failover drill — in-process 2-worker cluster, the master
+        # stopped abruptly mid-bulk (no checkpoint clear, journal-only
+        # durability: checkpoint_frequency=0) and a successor started
+        # on the same port — banking the recovery time (kill -> bulk
+        # complete) and how many acknowledged completions the
+        # successor failed to restore (the journal's whole point: 0),
+        # so tools/bench_history.py gates the durable-control-plane
+        # trajectory like any other metric
+        def _failover_digest() -> dict:
+            import socket as _socket
+            import struct as _struct
+            import threading as _threading
+
+            from scanner_tpu import Kernel, register_op
+            from scanner_tpu.engine.service import Master, Worker
+
+            def _pk(v: int) -> bytes:
+                return _struct.pack("<q", v)
+
+            def _tot(name: str) -> float:
+                s = registry().snapshot().get(name, {})
+                return sum(x["value"] for x in s.get("samples", []))
+
+            @register_op(name="BenchFoSleep")
+            class BenchFoSleep(Kernel):
+                # slow enough that the bulk (24 tasks across 2
+                # workers) outlives the mid-bulk master kill
+                def execute(self, x: bytes) -> bytes:
+                    time.sleep(0.15)
+                    return _pk(2 * _struct.unpack("<q", x)[0])
+
+            fdb = os.path.join(root, "fo_db")
+            n_rows = 48
+            seedf = Client(db_path=fdb)
+            seedf.new_table("fo_src", ["output"],
+                            [[_pk(100 + i)] for i in range(n_rows)])
+            with _socket.socket() as s:
+                s.bind(("localhost", 0))
+                port = s.getsockname()[1]
+            m1 = Master(db_path=fdb, port=port, no_workers_timeout=60.0)
+            addr = f"localhost:{port}"
+            workers = [Worker(addr, db_path=fdb) for _ in range(2)]
+            fc = Client(db_path=fdb, master=addr)
+            result: dict = {}
+            m2 = None
+
+            def _job() -> None:
+                try:
+                    col = fc.io.Input([NamedStream(fc, "fo_src")])
+                    col = fc.ops.BenchFoSleep(x=col)
+                    out = NamedStream(fc, "fo_out")
+                    fc.run(fc.io.Output(col, [out]),
+                           PerfParams.manual(2, 2,
+                                             checkpoint_frequency=0),
+                           cache_mode=CacheMode.Overwrite,
+                           show_progress=False)
+                    result["rows"] = len(list(out.load()))
+                except Exception as e:  # noqa: BLE001
+                    result["error"] = f"{type(e).__name__}: {e}"
+
+            try:
+                jt = _threading.Thread(target=_job, daemon=True)
+                jt.start()
+                deadline = time.time() + 60
+                while time.time() < deadline:
+                    with m1._lock:
+                        b = m1._bulk
+                        if b is not None and len(b.done) >= 4:
+                            break
+                    time.sleep(0.02)
+                m1.stop()  # abrupt: bulk still active, nothing cleared
+                with m1._lock:
+                    done_at_kill = len(m1._bulk.done) \
+                        if m1._bulk else 0
+                kill_at = time.time()
+                # successor on the SAME port (workers redial it); the
+                # just-freed port can linger briefly
+                for _ in range(20):
+                    try:
+                        m2 = Master(db_path=fdb, port=port,
+                                    no_workers_timeout=60.0)
+                        break
+                    except Exception:  # noqa: BLE001 — port lingering
+                        time.sleep(0.25)
+                restored = 0
+                if m2 is not None:
+                    with m2._lock:
+                        restored = len(m2._bulk.done) \
+                            if m2._bulk else 0
+                jt.join(timeout=120)
+                done_at = time.time()
+                recovery = round(done_at - kill_at, 3) \
+                    if result.get("rows") == n_rows else None
+                return {
+                    "config": "failover",
+                    "rows_ok": result.get("rows") == n_rows,
+                    "error": result.get("error"),
+                    "done_at_kill": done_at_kill,
+                    "done_restored": restored,
+                    "tasks_lost_on_recovery":
+                        max(0, done_at_kill - restored),
+                    "failover_recovery_s": recovery,
+                    "journal_appends": _tot(
+                        "scanner_tpu_journal_appends_total"),
+                    "journal_replayed": _tot(
+                        "scanner_tpu_journal_replayed_records_total"),
+                }
+            finally:
+                fc.stop()
+                for w in workers:
+                    w.stop()
+                if m2 is not None:
+                    m2.stop()
+
+        try:
+            _fo_d = _failover_digest()
+        except Exception as e:  # noqa: BLE001 — bench must not die on
+            # the failover drill
+            _fo_d = {"config": "failover",
+                     "error": f"{type(e).__name__}: {e}"}
+        detail.append(_fo_d)
         # stable per-direction baseline keys (ROADMAP "bank per-item
         # baselines for the new directions"): one flat entry with a
         # declared better= direction per metric, so
@@ -642,6 +765,12 @@ def main():
                     "better": "higher"},
                 "preemption_recovery_s": {
                     "value": _rem_d.get("preemption_recovery_s"),
+                    "better": "lower"},
+                "failover_recovery_s": {
+                    "value": _fo_d.get("failover_recovery_s"),
+                    "better": "lower"},
+                "tasks_lost_on_recovery": {
+                    "value": _fo_d.get("tasks_lost_on_recovery"),
                     "better": "lower"},
             },
         })

@@ -50,6 +50,16 @@ passes make each one checkable:
          directions; and the `[remediation]` config keys
          config.default_config() declares must be exactly
          controller.CONFIG_KEYS (both directions)
+  SC312  generation-fence routing drift (engine/service.py +
+         engine/journal.py): every RPC_CONTRACTS entry classified
+         `idempotent=False` must register its MASTER_SERVICE handler
+         wrapped in the generation-fence helper (`self._fenced(...)`),
+         and every fence-wrapped registration must be classified
+         non-idempotent — a mutating handler outside the fence lets a
+         superseded (stale) master keep accepting mutations; and the
+         `[robustness]` journal_* config keys config.default_config()
+         declares must be exactly journal.CONFIG_KEYS (both
+         directions)
 """
 
 from __future__ import annotations
@@ -331,6 +341,9 @@ class ContractPass(AnalysisPass):
         "SC311": "remediation contract drift (DEFAULT_PLAYBOOKS vs "
                  "health rules vs docs playbook matrix vs "
                  "[remediation] config keys)",
+        "SC312": "generation-fence routing drift (idempotent=False "
+                 "RPC_CONTRACTS entries vs _fenced-wrapped master "
+                 "handlers vs [robustness] journal config keys)",
     }
 
     def run(self, project: Project) -> List[Finding]:
@@ -344,6 +357,7 @@ class ContractPass(AnalysisPass):
         out.extend(self._cost_model(project))
         out.extend(self._frame_cache(project))
         out.extend(self._remediation(project))
+        out.extend(self._fence_routing(project))
         return out
 
     # -- SC301 / SC302 ---------------------------------------------------
@@ -979,6 +993,131 @@ class ContractPass(AnalysisPass):
                         f"controller.CONFIG_KEYS accepts `{k}` but "
                         "config.default_config() declares no "
                         f"`[remediation] {k}`", cmod.tree))
+        return out
+
+    # -- SC312 -----------------------------------------------------------
+
+    @staticmethod
+    def _contract_idempotency(mod: ModuleInfo) -> Optional[Dict[str, object]]:
+        """{method: idempotent-const-or-None} from the module-level
+        RPC_CONTRACTS dict literal (None when the flag is not a bool
+        constant — SC307 already flags incomplete entries)."""
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and stmt.targets[0].id == "RPC_CONTRACTS" \
+                    and isinstance(stmt.value, ast.Dict):
+                out: Dict[str, object] = {}
+                for k, v in zip(stmt.value.keys, stmt.value.values):
+                    ks = _const_str(k)
+                    if ks is None:
+                        continue
+                    idem = None
+                    if isinstance(v, ast.Dict):
+                        for vk, vv in zip(v.keys, v.values):
+                            if _const_str(vk) == "idempotent" \
+                                    and isinstance(vv, ast.Constant) \
+                                    and isinstance(vv.value, bool):
+                                idem = vv.value
+                    out[ks] = idem
+                return out
+        return None
+
+    @staticmethod
+    def _master_registrations(mod: ModuleInfo
+                              ) -> Dict[str, Tuple[bool, ast.AST]]:
+        """{method: (fence_wrapped, key_node)} from the RpcServer
+        registration whose service argument resolves through
+        MASTER_SERVICE — the fence only guards the master's control
+        plane (worker-service handlers are all idempotent reads)."""
+        out: Dict[str, Tuple[bool, ast.AST]] = {}
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and (dotted_name(node.func) or "").split(".")[-1]
+                    == "RpcServer" and len(node.args) >= 2
+                    and isinstance(node.args[1], ast.Dict)):
+                continue
+            svc = dotted_name(node.args[0]) or ""
+            if not svc.split(".")[-1] == "MASTER_SERVICE":
+                continue
+            for k, v in zip(node.args[1].keys, node.args[1].values):
+                name = _const_str(k)
+                if name is None:
+                    continue
+                wrapped = isinstance(v, ast.Call) and (
+                    dotted_name(v.func) or "").split(".")[-1] \
+                    == "_fenced"
+                out[name] = (wrapped, k)
+        return out
+
+    def _fence_routing(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        cmod: Optional[ModuleInfo] = None
+        contracts: Optional[Dict[str, object]] = None
+        for mod in project.modules:
+            got = self._contract_idempotency(mod)
+            if got is not None:
+                cmod, contracts = mod, got
+                break
+        if cmod is not None and contracts is not None:
+            registered = self._master_registrations(cmod)
+            if registered:
+                # direction 1: every mutating (idempotent=False)
+                # contract routes its master handler through the fence
+                for name, idem in sorted(contracts.items()):
+                    if idem is not False or name not in registered:
+                        continue
+                    wrapped, node = registered[name]
+                    if not wrapped:
+                        out.append(cmod.finding(
+                            "SC312",
+                            f"RPC `{name}` is classified "
+                            "idempotent=False but its master handler "
+                            "is registered without the generation-"
+                            "fence wrapper (`self._fenced(...)`) — a "
+                            "superseded (stale) master would keep "
+                            "accepting this mutation", node))
+                # direction 2: every fence-wrapped registration is
+                # classified non-idempotent — fencing a read means the
+                # table and the code disagree about what mutates
+                for name, (wrapped, node) in sorted(registered.items()):
+                    if wrapped and contracts.get(name) is not False:
+                        out.append(cmod.finding(
+                            "SC312",
+                            f"master handler `{name}` is wrapped in "
+                            "the generation fence but RPC_CONTRACTS "
+                            "does not classify it idempotent=False — "
+                            "the table and the fence routing disagree "
+                            "about whether it mutates", node))
+        # [robustness] journal_* config keys <-> journal.CONFIG_KEYS,
+        # both directions (the SC308/SC310/SC311 pattern)
+        jmod = project.module("engine/journal.py")
+        schema = _module_tuple(jmod, "CONFIG_KEYS") \
+            if jmod is not None else None
+        cfg_mod = None
+        for m in project.modules:
+            if m.relpath.endswith("config.py") \
+                    and _default_config_keys(m):
+                cfg_mod = m
+                break
+        if jmod is not None and schema is not None \
+                and cfg_mod is not None:
+            declared = {k for sec, k in _default_config_keys(cfg_mod)
+                        if sec == "robustness"
+                        and k.startswith("journal")}
+            if declared or schema:
+                for k in sorted(declared - set(schema)):
+                    out.append(cfg_mod.finding(
+                        "SC312",
+                        f"config key `[robustness] {k}` is declared "
+                        "but journal.CONFIG_KEYS does not accept it",
+                        cfg_mod.tree))
+                for k in sorted(set(schema) - declared):
+                    out.append(jmod.finding(
+                        "SC312",
+                        f"journal.CONFIG_KEYS accepts `{k}` but "
+                        "config.default_config() declares no "
+                        f"`[robustness] {k}`", jmod.tree))
         return out
 
     # -- SC306 / SC307 ---------------------------------------------------

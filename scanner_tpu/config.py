@@ -107,6 +107,22 @@ def default_config() -> Dict[str, Any]:
             "autoscale_min": 1,
             "autoscale_max": 8,
         },
+        "robustness": {
+            # write-ahead bulk journal (engine/journal.py): between
+            # checkpoints the master appends completion/strike/
+            # blacklist/admission events as checksummed segment
+            # objects, so a master kill -9 mid-bulk loses ZERO
+            # acknowledged completions (docs/robustness.md §Durable
+            # control plane).  On by default; SCANNER_TPU_JOURNAL=0
+            # overrides per process (recovery then rides the
+            # checkpoint window alone).
+            "journal_enabled": True,
+            # records per journal segment before rotation (bounds the
+            # open-segment rewrite cost and the per-segment blast
+            # radius of a torn tail); SCANNER_TPU_JOURNAL_ROTATE
+            # overrides per process.
+            "journal_rotate_records": 256,
+        },
         "faults": {
             # deterministic fault-injection plan (docs/robustness.md for
             # the clause syntax; util/faults.py implements it).  "" (the
@@ -246,6 +262,20 @@ class Config:
         r = self.config.get("remediation", {})
         return (int(r.get("autoscale_min", 1)),
                 int(r.get("autoscale_max", 8)))
+
+    @property
+    def journal_enabled(self) -> bool:
+        """Write-ahead bulk journal (the deployment default;
+        SCANNER_TPU_JOURNAL overrides per process)."""
+        return bool(self.config.get("robustness", {}).get(
+            "journal_enabled", True))
+
+    @property
+    def journal_rotate_records(self) -> int:
+        """Records per journal segment before rotation
+        (SCANNER_TPU_JOURNAL_ROTATE overrides per process)."""
+        return int(self.config.get("robustness", {}).get(
+            "journal_rotate_records", 256))
 
     @property
     def faults_plan(self) -> Optional[str]:

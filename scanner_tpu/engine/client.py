@@ -123,6 +123,15 @@ class Client:
             from ..util import health as _health_cfg
             if not os.environ.get("SCANNER_TPU_HEALTH"):
                 _health_cfg.set_enabled(cfg.alerts_enabled)
+            # [robustness] section: the master's write-ahead bulk
+            # journal defaults; SCANNER_TPU_JOURNAL* env vars (read at
+            # import) win per process
+            from . import journal as _journal_cfg
+            if not os.environ.get("SCANNER_TPU_JOURNAL"):
+                _journal_cfg.set_enabled(cfg.journal_enabled)
+            if not os.environ.get("SCANNER_TPU_JOURNAL_ROTATE"):
+                _journal_cfg.set_rotate_records(
+                    cfg.journal_rotate_records)
             # [remediation] section: the alert->action controller's
             # deployment defaults; SCANNER_TPU_REMEDIATION (read at
             # import) is the per-process kill switch and wins

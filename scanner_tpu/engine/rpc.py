@@ -196,14 +196,29 @@ class RpcClient:
         if tp is not None:
             payload.setdefault(_tracing.TRACEPARENT_KEY, tp)
         req = pack(payload)
+        # fault-plan selector detail: "<method>@<peer>" — method=/peer=
+        # rule keys (and plain match= substrings) select per-RPC-method
+        # and per-peer, so a plan can model an ASYMMETRIC partition
+        # (this peer unreachable, others fine)
+        detail = f"{method}@{self.address}"
 
         def attempt():
             # chaos hook fires per ATTEMPT (inside the backoff loop): an
             # injected UNAVAILABLE storm exercises the same retry path a
             # flapping network would
             if _faults.ACTIVE:
-                _faults.inject("rpc.client.call", detail=method)
-            return fn(req, timeout=timeout or self._timeout)
+                _faults.inject("rpc.client.call", detail=detail)
+            raw_reply = fn(req, timeout=timeout or self._timeout)
+            if _faults.ACTIVE and _faults.take_duplicate(
+                    "rpc.client.call", detail=detail):
+                # duplicate delivery: the identical request hits the
+                # server a second time and the first reply is dropped —
+                # at-least-once semantics after an ambiguous timeout.
+                # Only the server-side idempotency/dedup machinery
+                # (RPC_CONTRACTS, NewJob admission tokens) may make
+                # this safe; that is exactly what the drill verifies.
+                raw_reply = fn(req, timeout=timeout or self._timeout)
+            return raw_reply
 
         try:
             raw = call_with_backoff(

@@ -32,6 +32,7 @@ import cloudpickle
 from ..common import CacheMode, JobException, PerfParams, ScannerException
 from ..storage import Database, make_storage
 from ..storage import metadata as md
+from ..storage.items import seal_blob
 from ..util import coststats as _coststats
 from ..util import faults as _faults
 from ..util import health as _health
@@ -43,6 +44,7 @@ from ..util.metrics import MetricsServer, merge_snapshots
 from ..util.profiler import Profiler
 from . import controller as _controller
 from . import framecache as _framecache
+from . import journal as _journal
 from . import rpc
 from .evaluate import TaskEvaluator
 from .executor import _M_TASK_LATENCY, LocalExecutor, TaskItem
@@ -73,7 +75,14 @@ WORKER_SERVICE = "scanner.Worker"
 # profile state and must only ride the UNAVAILABLE-only retry path,
 # where the request provably never reached the server).  scanner-check
 # SC307 enforces that this table and the registered handler dicts stay
-# in sync; new handlers must be classified here to land.
+# in sync; new handlers must be classified here to land.  Every
+# idempotent=False entry additionally routes through the master's
+# generation-fence wrapper (`Master._fenced`) so a superseded master
+# cannot accept mutations — scanner-check SC312 keeps the table and
+# the wrapped registrations in sync both directions.
+# (NewJob stays classified non-idempotent: the admission-token dedupe
+# makes a RETRY safe end-to-end, but only when the caller re-presents
+# the token — the blind transport-level retry this flag governs does.)
 RPC_CONTRACTS = {
     "Ping":             {"timeout_s": PING_TIMEOUT, "idempotent": True},
     "RegisterWorker":   {"timeout_s": 30.0, "idempotent": False},
@@ -172,6 +181,12 @@ _M_ADMISSION_PAUSED = _mx.registry().gauge(
 _M_JOBS_BLACKLISTED = _mx.registry().counter(
     "scanner_tpu_jobs_blacklisted_total",
     "Jobs removed from their bulk after repeated task failures.")
+_M_ADMISSION_DEDUP = _mx.registry().counter(
+    "scanner_tpu_admission_dedup_total",
+    "NewJob admissions deduplicated by client-minted admission token: "
+    "a retry after an ambiguous timeout (or across a master restart) "
+    "returned the already-admitted bulk id instead of double-running "
+    "the bulk.")
 
 
 def _is_transient_failure(exc: BaseException) -> bool:
@@ -328,6 +343,10 @@ class _BulkJob:
     stage_seen: Dict[str, Set[Tuple[int, int]]] = field(
         default_factory=lambda: {"load": set(), "evaluate": set()})
 
+    # client-minted admission token (NewJob dedupe): persisted with the
+    # checkpoint/journal so a retried NewJob returns this bulk's id
+    # even across a master restart
+    admission_token: str = ""
     # wall-clock end of the bulk; 0 while running.  Status fps/elapsed
     # freeze here so querying a historical bulk an hour later does not
     # decay its throughput toward zero.
@@ -449,32 +468,56 @@ class Master:
         self._no_worker_since = time.time()
         self._cleared_bulk_id: Optional[int] = None
         self._shutdown = threading.Event()
+        # durable control plane (engine/journal.py): claim a monotonic
+        # master generation via storage CAS — every mutating RPC reply
+        # is stamped with it, checkpoint/journal paths are scoped by
+        # it, and a master that sees a newer claim fences itself.
+        self.generation = _journal.claim_generation(self.db.backend)
+        self._fence = threading.Event()
+        self._journal: Optional[_journal.BulkJournal] = (
+            _journal.BulkJournal(self.db.backend, self.generation)
+            if _journal.enabled() else None)
+        # NewJob admission-token dedupe: token -> bulk_id, bounded by
+        # the insertion ring (a retry after an ambiguous timeout — or
+        # across a master restart, via the journaled admit record —
+        # returns the existing bulk instead of double-running it)
+        self._admission_tokens: Dict[str, int] = {}
+        self._admission_token_ring: Deque[str] = deque()
+        # a forced-generation (SCANNER_TPU_MASTER_GENERATION) master
+        # may already be stale at startup: fence BEFORE recovery so it
+        # neither adopts nor persists anything
+        self._check_fence()
         # resume an interrupted bulk BEFORE serving RPCs: workers that
         # re-register see the restored bulk as active and pull its
         # remaining tasks (reference recover_and_init_database,
         # master.cpp:1311 + checkpoint master.cpp:1100-1113)
-        self._recover_bulk()
+        if not self._fence.is_set():
+            self._recover_bulk()
+        # every idempotent=False (mutating) handler routes through the
+        # generation fence (scanner-check SC312 pins this wrapping to
+        # the RPC_CONTRACTS table, both directions)
         self._server = rpc.RpcServer(MASTER_SERVICE, {
             "Ping": self._rpc_ping,
-            "RegisterWorker": self._rpc_register_worker,
+            "RegisterWorker": self._fenced(self._rpc_register_worker),
             "UnregisterWorker": self._rpc_unregister_worker,
             "Heartbeat": self._rpc_heartbeat,
-            "NewJob": self._rpc_new_job,
+            "NewJob": self._fenced(self._rpc_new_job),
             "GetJob": self._rpc_get_job,
-            "NextWork": self._rpc_next_work,
-            "StartedWork": self._rpc_started_work,
+            "NextWork": self._fenced(self._rpc_next_work),
+            "StartedWork": self._fenced(self._rpc_started_work),
             "EvalDone": self._rpc_eval_done,
-            "FinishedWork": self._rpc_finished_work,
-            "FailedWork": self._rpc_failed_work,
+            "FinishedWork": self._fenced(self._rpc_finished_work),
+            "FailedWork": self._fenced(self._rpc_failed_work),
             "GetJobStatus": self._rpc_job_status,
             "GetMetrics": self._rpc_get_metrics,
             "GetHealth": self._rpc_get_health,
             "PokeWatchdog": self._rpc_poke,
-            "PostProfile": self._rpc_post_profile,
+            "PostProfile": self._fenced(self._rpc_post_profile),
             "GetProfiles": self._rpc_get_profiles,
-            "ShipSpans": self._rpc_ship_spans,
+            "ShipSpans": self._fenced(self._rpc_ship_spans),
             "GetTrace": self._rpc_get_trace,
-            "ShipMemoryReport": self._rpc_ship_memory_report,
+            "ShipMemoryReport": self._fenced(
+                self._rpc_ship_memory_report),
             "GetMemoryReport": self._rpc_get_memory_report,
             "GetCompileLedger": self._rpc_get_compile_ledger,
             "Shutdown": self._rpc_shutdown,
@@ -519,6 +562,67 @@ class Master:
         self._scan_thread = threading.Thread(
             target=self._scan_loop, name="master-scan", daemon=True)
         self._scan_thread.start()
+
+    # -- generation fence (engine/journal.py) -------------------------------
+
+    def _fenced(self, fn):
+        """Generation-fence guard every mutating (idempotent=False)
+        master handler routes through (scanner-check SC312): a fenced
+        — superseded — master accepts ZERO mutations, and live replies
+        are stamped with this master's generation so workers can latch
+        it and NACK anything older."""
+        def guard(req: dict) -> dict:
+            if self._fence.is_set():
+                _journal.count_stale_rejection("master")
+                return {"error": "master fenced: generation "
+                                 f"{self.generation} superseded",
+                        "fenced": True, "generation": self.generation}
+            reply = fn(req)
+            if isinstance(reply, dict):
+                reply.setdefault("generation", self.generation)
+            return reply
+        guard.__name__ = getattr(fn, "__name__", "handler")
+        return guard
+
+    def _check_fence(self) -> bool:
+        """One storage poll: has a newer generation been claimed?  Run
+        at startup and by the scan loop (~2 s cadence) — path scoping
+        already protects storage structurally, this closes the RPC
+        window too."""
+        if self._fence.is_set():
+            return True
+        try:
+            newest = _journal.highest_claimed(self.db.backend)
+        except Exception:  # noqa: BLE001 — a flaky storage poll must
+            return False   # not fence a healthy master
+        if newest > self.generation:
+            self._fence_out(newest)
+            return True
+        return False
+
+    def _fence_out(self, newest: int) -> None:
+        self._fence.set()
+        _mlog.error(
+            "master generation %d FENCED: generation %d has been "
+            "claimed on this db — rejecting all mutating RPCs, "
+            "persistence stopped (a successor owns the bulk now)",
+            self.generation, newest)
+
+    def _journal_append(self, recs) -> None:
+        """Durably journal control-plane events.  Callers invoke this
+        OUTSIDE self._lock (storage writes must not stall heartbeats)
+        and BEFORE acking the RPC that caused them (write-ahead: an
+        acked completion is never lost).  A fenced master journals
+        nothing."""
+        if not recs or self._journal is None or self._fence.is_set():
+            return
+        try:
+            self._journal.append(*recs)
+        except Exception:  # noqa: BLE001 — durability is best-effort
+            # past the checkpoint floor: a journal write failure must
+            # not fail the task completion that triggered it
+            _mlog.exception("bulk journal append failed (recovery "
+                            "falls back to the checkpoint window)")
 
     # -- rpc handlers -------------------------------------------------------
 
@@ -572,15 +676,39 @@ class Master:
             w.firing = set(req.get("firing") or ())
             active = self._bulk.bulk_id \
                 if self._bulk and not self._bulk.finished else None
-        return {"reregister": False, "active_bulk": active}
+        # the generation rides every beat so workers latch the newest
+        # master even between assignments (Heartbeat itself stays
+        # idempotent — no fence guard needed to read liveness)
+        return {"reregister": False, "active_bulk": active,
+                "generation": self.generation}
 
     def _rpc_new_job(self, req: dict) -> dict:
         """Admit a bulk job: resolve perf, create output tables, build the
         task queue (reference master.cpp:1367 process_job).  The admission
         lock serializes concurrent NewJob calls end-to-end — prepare()
         mutates database metadata and must not interleave."""
+        token = req.get("token") or ""
         with self._admit_lock:
             with self._lock:
+                # idempotent admission: a client retrying NewJob after
+                # an ambiguous timeout (or across a master restart —
+                # tokens ride the checkpoint/journal) gets the bulk it
+                # already admitted, never a double-run.  Checked under
+                # the admission lock so a retry racing the original
+                # admission blocks until the token is recorded.
+                if token and token in self._admission_tokens:
+                    _M_ADMISSION_DEDUP.inc()
+                    bid = self._admission_tokens[token]
+                    _mlog.info("NewJob token %s deduplicated to "
+                               "bulk %d", token[:12], bid)
+                    return {"bulk_id": bid, "dedup": True}
+                if req.get("resolve"):
+                    # lookup-only probe (client ride-through after a
+                    # failover): an unknown token must NOT admit a
+                    # fresh bulk as a side effect — the client decides
+                    # what to do with a lost bulk, not this handler
+                    return {"error": "unknown admission token",
+                            "unknown_token": True}
                 if self._admission_paused:
                     # load shedding (admission_pause playbook): answer
                     # retryable instead of queueing work onto a
@@ -622,9 +750,12 @@ class Master:
                     task_timeout=float(getattr(perf, "task_timeout", 0.0)),
                     checkpoint_frequency=int(
                         getattr(perf, "checkpoint_frequency", 0) or 0),
-                    sticky=sticky,
+                    sticky=sticky, admission_token=token,
                     trace_id=trace_id, trace_parent=trace_parent)
                 self._next_bulk_id += 1
+                if token:
+                    self._record_admission_token_locked(
+                        token, bulk.bulk_id)
                 for job in jobs:
                     if job.skipped:
                         continue
@@ -641,20 +772,24 @@ class Master:
                         sorted(t for _j, t in tasks))
                     bulk.job_rr.append(job.job_idx)
                     bulk.total_tasks += len(tasks)
-                self._bulk = bulk
-                self._no_worker_since = time.time()
                 if bulk.total_tasks == 0:
                     bulk.mark_finished()
+            # persist admission state BEFORE publishing the bulk
+            # (outside the control-plane lock; still under the
+            # admission lock): the checkpoint write resets the journal
+            # for the new bulk, and a worker must not be able to
+            # complete — and journal — a task that reset would then
+            # delete.  A master crash mid-bulk resumes from here.
+            if not bulk.finished:
+                self._persist_bulk_checkpoint(bulk)
+            with self._lock:
+                self._bulk = bulk
+                self._no_worker_since = time.time()
                 self._history[bulk.bulk_id] = bulk
                 self._trim_history_locked()
                 _mlog.info(
                     "bulk %d admitted: %d jobs, %d tasks",
                     bulk.bulk_id, len(bulk.job_tasks), bulk.total_tasks)
-            # persist admission state (outside the control-plane lock;
-            # still under the admission lock) so a master crash mid-bulk
-            # can resume instead of orphaning the job
-            if not bulk.finished:
-                self._persist_bulk_checkpoint(bulk)
             return {"bulk_id": bulk.bulk_id}
 
     def _rpc_get_job(self, req: dict) -> dict:
@@ -810,6 +945,7 @@ class Master:
 
     def _rpc_finished_work(self, req: dict) -> dict:
         key = (req["job_idx"], req["task_idx"])
+        recs: List[dict] = []
         with self._lock:
             self._touch_worker(req.get("worker_id"))
             bulk = self._bulk
@@ -837,6 +973,7 @@ class Master:
             if key in bulk.done or key[0] in bulk.blacklisted_jobs:
                 return {"ok": True}
             bulk.done.add(key)
+            recs.append({"t": "done", "j": key[0], "k": key[1]})
             bulk.job_done[key[0]] = bulk.job_done.get(key[0], 0) + 1
             bulk.stage_rows["save"] += bulk.task_rows.get(key, 0)
             _M_TASKS_DONE.inc()
@@ -847,11 +984,15 @@ class Master:
                         "(%d/%d done)", key[0], key[1],
                         req.get("worker_id", -1), len(bulk.done),
                         bulk.total_tasks)
-            self._maybe_finish_job(bulk, key[0])
+            self._maybe_finish_job(bulk, key[0], recs=recs)
             need_ckpt = (bulk.checkpoint_frequency > 0 and not bulk.finished
                          and len(bulk.done) % bulk.checkpoint_frequency == 0)
             self._maybe_finish_bulk(bulk)
             finished_now = bulk.finished
+        # write-ahead: the completion is durable in the journal BEFORE
+        # this handler acks — a kill -9 after the ack cannot lose it
+        # (outside the control lock; storage must not stall heartbeats)
+        self._journal_append(recs)
         if need_ckpt:
             # periodic metadata checkpoint: a master restart mid-bulk finds
             # committed-so-far tables in the megafile and resumes from the
@@ -868,6 +1009,7 @@ class Master:
     def _rpc_failed_work(self, req: dict) -> dict:
         key = (req["job_idx"], req["task_idx"])
         err = req.get("error", "")
+        recs: List[dict] = []
         with self._lock:
             self._touch_worker(req.get("worker_id"))
             bulk = self._bulk
@@ -880,9 +1022,12 @@ class Master:
             self._unassign(bulk, key)
             if key in bulk.done:
                 return {"ok": True}
+            strike_free = False
             if req.get("transient"):
                 tn = bulk.transient_failures.get(key, 0) + 1
                 bulk.transient_failures[key] = tn
+                recs.append({"t": "transient", "j": key[0],
+                             "k": key[1], "n": tn})
                 if tn <= MAX_TRANSIENT_FAILURES:
                     _M_TRANSIENT.inc()
                     _M_TASK_RETRIES.inc()
@@ -893,27 +1038,34 @@ class Master:
                         req.get("worker_id", -1), tn,
                         MAX_TRANSIENT_FAILURES, err)
                     bulk.q_push(key, front=True)
-                    return {"ok": True}
-                # a "transient" failure that never stops isn't: fall
-                # through and strike like a deterministic one
-            n = bulk.failures.get(key, 0) + 1
-            bulk.failures[key] = n
-            _M_STRIKES.inc()
-            _mlog.warning("task (%d,%d) failed on worker %d "
-                          "(failure %d/%d): %s", key[0], key[1],
-                          req.get("worker_id", -1), n, MAX_TASK_FAILURES,
-                          err)
-            blacklisted_now = False
-            if n >= MAX_TASK_FAILURES:
-                # job blacklisting (reference master.cpp:2161-2191): one
-                # poison stream cannot sink the bulk job
-                self._blacklist_job(bulk, key[0], err)
-                blacklisted_now = True
-            else:
-                bulk.q_push(key, front=True)
-                _M_TASK_RETRIES.inc()
-            self._maybe_finish_bulk(bulk)
-            finished_now = bulk.finished
+                    strike_free = True
+                # past the cap, a "transient" failure that never stops
+                # isn't: fall through and strike like any other
+            blacklisted_now = finished_now = False
+            if not strike_free:
+                n = bulk.failures.get(key, 0) + 1
+                bulk.failures[key] = n
+                recs.append({"t": "strike", "j": key[0], "k": key[1],
+                             "n": n})
+                _M_STRIKES.inc()
+                _mlog.warning("task (%d,%d) failed on worker %d "
+                              "(failure %d/%d): %s", key[0], key[1],
+                              req.get("worker_id", -1), n,
+                              MAX_TASK_FAILURES, err)
+                if n >= MAX_TASK_FAILURES:
+                    # job blacklisting (reference master.cpp:2161-2191):
+                    # one poison stream cannot sink the bulk job
+                    self._blacklist_job(bulk, key[0], err, recs=recs)
+                    blacklisted_now = True
+                else:
+                    bulk.q_push(key, front=True)
+                    _M_TASK_RETRIES.inc()
+                self._maybe_finish_bulk(bulk)
+                finished_now = bulk.finished
+        # write-ahead: durable before the ack (outside the lock)
+        self._journal_append(recs)
+        if strike_free:
+            return {"ok": True}
         if blacklisted_now and not finished_now:
             # a restarted master must not resurrect the poisoned job
             self._persist_bulk_progress(bulk)
@@ -1009,6 +1161,11 @@ class Master:
             mem_reports = len(self._mem_reports)
         return {"role": "master", "workers": workers,
                 "bulk_id": bulk_id, "bulk": status,
+                # the fencing epoch (docs/robustness.md §Durable
+                # control plane): fenced=True means a successor owns
+                # this db and every mutating RPC here is rejected
+                "generation": self.generation,
+                "fenced": self._fence.is_set(),
                 # the Health panel: this process's roll-up + firing
                 # alerts (util/health.py; outside the control lock)
                 "health": _health.status_dict(),
@@ -1437,12 +1594,28 @@ class Master:
 
     # -- bulk checkpoint / recovery -----------------------------------------
 
-    def _persist_bulk_checkpoint(self, bulk: _BulkJob) -> None:
-        """Write the admission state needed to resume this bulk after a
+    def _record_admission_token_locked(self, token: str,
+                                       bulk_id: int) -> None:
+        """Remember a NewJob admission token for dedupe, bounded by the
+        insertion ring.  Caller holds self._lock."""
+        if token in self._admission_tokens:
+            self._admission_tokens[token] = bulk_id
+            return
+        self._admission_tokens[token] = bulk_id
+        self._admission_token_ring.append(token)
+        while len(self._admission_token_ring) > _journal.TOKEN_RING:
+            old = self._admission_token_ring.popleft()
+            self._admission_tokens.pop(old, None)
+
+    @staticmethod
+    def _bulk_checkpoint_state(bulk: _BulkJob) -> dict:
+        """The admission state needed to resume this bulk after a
         master restart.  Small by construction: the spec blob plus task
         geometry — per-job sink names/custom sinks are re-derived on
-        recovery via prepare_readonly (the same derivation workers run)."""
-        state = {
+        recovery via prepare_readonly (the same derivation workers
+        run).  Written as the checkpoint AND journaled as the `admit`
+        record, so either survives the other's corruption."""
+        return {
             "bulk_id": bulk.bulk_id,
             "spec_blob": bulk.spec_blob,
             "task_timeout": bulk.task_timeout,
@@ -1450,9 +1623,23 @@ class Master:
             "job_ntasks": {j: len(ts) for j, ts in bulk.job_tasks.items()},
             "job_output_rows": dict(bulk.job_output_rows),
             "sticky": bulk.sticky,
+            "token": bulk.admission_token,
         }
-        self.db.backend.write(md.bulk_checkpoint_path(),
-                              cloudpickle.dumps(state))
+
+    def _persist_bulk_checkpoint(self, bulk: _BulkJob) -> None:
+        """Persist admission state (generation-scoped, checksummed) and
+        open a fresh journal for the bulk, with the same state as its
+        first record — a corrupt checkpoint then falls back to journal
+        replay instead of dropping the bulk."""
+        if self._fence.is_set():
+            return
+        state = self._bulk_checkpoint_state(bulk)
+        blob = seal_blob(cloudpickle.dumps(state))
+        self.db.backend.write(md.bulk_checkpoint_path(self.generation),
+                              blob)
+        if self._journal is not None:
+            self._journal.reset()
+            self._journal_append([{"t": "admit", "state": state}])
 
     @staticmethod
     def _encode_task_set(tasks) -> Dict[int, List[int]]:
@@ -1485,7 +1672,13 @@ class Master:
 
     def _persist_bulk_progress(self, bulk: _BulkJob) -> None:
         """Snapshot completion state (under the lock) and write it (storage
-        I/O must not stall heartbeats, so callers invoke this outside)."""
+        I/O must not stall heartbeats, so callers invoke this outside).
+        The journal is cut at the snapshot point: every record the
+        snapshot covers lives in a sealed segment below the cut, so
+        compaction after the write bounds replay to one checkpoint
+        window without ever deleting an uncovered record."""
+        if self._fence.is_set():
+            return
         with self._lock:
             # C-speed snapshot only; the Python-level run-length encode
             # happens outside so heartbeats/NextWork never wait on it
@@ -1493,13 +1686,28 @@ class Master:
             prog = {
                 "bulk_id": bulk.bulk_id,
                 "failures": dict(bulk.failures),
+                "transient_failures": dict(bulk.transient_failures),
                 "blacklisted_jobs": sorted(bulk.blacklisted_jobs),
                 "committed_jobs": sorted(bulk.committed_jobs),
                 "error": bulk.error,
+                "token": bulk.admission_token,
             }
+            # cut INSIDE the state lock: a mutation not yet in this
+            # snapshot can only be journaled after its (post-snapshot)
+            # apply, which lands at or above the cut and survives
+            cut = self._journal.cut() if self._journal is not None \
+                else None
         prog["done_runs"] = self._encode_task_set(done)
-        self.db.backend.write(md.bulk_progress_path(),
-                              cloudpickle.dumps(prog))
+        self.db.backend.write(md.bulk_progress_path(self.generation),
+                              seal_blob(cloudpickle.dumps(prog)))
+        if cut is not None and self._journal is not None:
+            self._journal.compact_below(cut)
+            # re-seed the admit record: compaction may have deleted the
+            # segment carrying it, and the corrupt-checkpoint fallback
+            # needs admission state IN the journal at all times
+            self._journal_append(
+                [{"t": "admit",
+                  "state": self._bulk_checkpoint_state(bulk)}])
 
     def _clear_bulk_checkpoint(self, bulk_id: Optional[int] = None) -> None:
         """Remove the (single, fixed-path) bulk checkpoint — but never a
@@ -1508,6 +1716,8 @@ class Master:
         delayed cleanup.  The admission lock serializes us against the
         admission sequence (which writes the new checkpoint while holding
         it)."""
+        if self._fence.is_set():
+            return  # the successor owns (and clears) control state now
         with self._admit_lock:
             if bulk_id is not None:
                 with self._lock:
@@ -1515,16 +1725,117 @@ class Master:
                     if cur is not None and not cur.finished \
                             and cur.bulk_id != bulk_id:
                         return  # a newer active bulk owns the path
+            # same contract as the legacy deletes below (baselined):
+            # the admission lock exists to serialize storage-mutating
+            # admission + checkpoint cleanup end-to-end
+            self.db.backend.delete(md.bulk_checkpoint_path(self.generation))  # scanner-check: disable=SC202 admission lock serializes checkpoint cleanup by design (see baseline twin)
+            self.db.backend.delete(md.bulk_progress_path(self.generation))  # scanner-check: disable=SC202 admission lock serializes checkpoint cleanup by design (see baseline twin)
+            if self._journal is not None:
+                self._journal.reset()
+            # legacy fixed-path state from pre-fencing masters
             self.db.backend.delete(md.bulk_checkpoint_path())
             self.db.backend.delete(md.bulk_progress_path())
 
+    def _load_sealed(self, path: str, what: str) -> Optional[bytes]:
+        """Read a (possibly legacy-unsealed) control-plane blob —
+        payload, or None (ERROR-logged) on checksum failure so the
+        caller falls back to journal replay instead of silently
+        resurrecting garbage (or, as the pre-seal code did, silently
+        dropping the whole bulk).  One shared policy with tooling
+        (journal.read_control_blob)."""
+        return _journal.read_control_blob(self.db.backend, path,
+                                          what=what)
+
+    def _find_recovery_source(self):
+        """Locate the newest predecessor generation (or the legacy
+        fixed path) holding bulk state.  Returns (source_gen-or-None,
+        admission_state, journal_records, journal_stats) or None."""
+        gens = [g for g in
+                _journal.claimed_generations(self.db.backend)
+                if g < self.generation]
+        for g in sorted(gens, reverse=True) + [None]:
+            records: List[dict] = []
+            jstats: Dict[str, int] = {}
+            if g is not None:
+                records, jstats = _journal.replay(self.db.backend, g)
+            state = None
+            payload = self._load_sealed(
+                md.bulk_checkpoint_path(g), "bulk checkpoint")
+            if payload is not None:
+                try:
+                    state = cloudpickle.loads(payload)
+                except Exception:  # noqa: BLE001
+                    _mlog.error(
+                        "bulk checkpoint at generation %s is "
+                        "undecodable: falling back to journal replay",
+                        g)
+            if state is None:
+                # the journaled `admit` record carries the same
+                # admission state the checkpoint does — a corrupt
+                # checkpoint costs nothing when the journal survives
+                for r in records:
+                    if r.get("t") == "admit" \
+                            and isinstance(r.get("state"), dict):
+                        state = r["state"]
+            if state is not None:
+                return g, state, records, jstats
+        return None
+
+    @staticmethod
+    def _apply_journal_records(bulk: _BulkJob, records) -> int:
+        """Replay journal records over the progress snapshot.
+        Idempotent by construction — done/blacklist/commit records
+        union, strike/transient records carry their cumulative count —
+        so a record that raced the snapshot applies safely twice."""
+        applied = 0
+        for r in records:
+            t = r.get("t")
+            if t == "done":
+                key = (int(r["j"]), int(r["k"]))
+                if key in bulk.task_rows and key not in bulk.done:
+                    bulk.done.add(key)
+                    applied += 1
+            elif t == "strike":
+                key = (int(r["j"]), int(r["k"]))
+                bulk.failures[key] = max(bulk.failures.get(key, 0),
+                                         int(r.get("n", 1)))
+            elif t == "transient":
+                key = (int(r["j"]), int(r["k"]))
+                bulk.transient_failures[key] = max(
+                    bulk.transient_failures.get(key, 0),
+                    int(r.get("n", 1)))
+            elif t == "blacklist":
+                j = int(r["j"])
+                if j not in bulk.blacklisted_jobs:
+                    bulk.blacklisted_jobs.add(j)
+                    applied += 1
+                if not bulk.error and r.get("error"):
+                    bulk.error = str(r["error"])
+            elif t == "commit":
+                bulk.committed_jobs.add(int(r["j"]))
+        return applied
+
+    def _drop_recovery_source(self, g: Optional[int]) -> None:
+        """Delete a predecessor generation's control state once the
+        bulk has been migrated under this master's generation (a crash
+        before this leaves both copies; the next recovery prefers the
+        newer one)."""
+        if g is None:
+            self.db.backend.delete(md.bulk_checkpoint_path())
+            self.db.backend.delete(md.bulk_progress_path())
+        else:
+            self.db.backend.delete_prefix(md.generation_dir(g))
+
     def _recover_bulk(self) -> None:
-        """Resume the bulk job a previous master process left behind."""
+        """Resume the bulk job a previous master process left behind:
+        admission checkpoint (or the journaled admit record when the
+        checkpoint is corrupt) + progress snapshot + write-ahead
+        journal replay — zero acknowledged completions lost."""
+        src = self._find_recovery_source()
+        if src is None:
+            return
+        source_gen, state, records, jstats = src
         try:
-            if not self.db.backend.exists(md.bulk_checkpoint_path()):
-                return
-            state = cloudpickle.loads(
-                self.db.backend.read(md.bulk_checkpoint_path()))
             spec = cloudpickle.loads(state["spec_blob"])
             ex = LocalExecutor(self.db)
             _info, jobs = ex.prepare_readonly(spec["outputs"], spec["perf"])
@@ -1533,6 +1844,7 @@ class Master:
             # is lost (client reruns it), new jobs proceed
             _mlog.exception("bulk recovery failed; dropping checkpoint")
             try:
+                self._drop_recovery_source(source_gen)
                 self._clear_bulk_checkpoint()
             except Exception:  # noqa: BLE001
                 pass
@@ -1543,10 +1855,12 @@ class Master:
             checkpoint_frequency=state["checkpoint_frequency"],
             # pre-sticky checkpoints default off (missing key)
             sticky=bool(state.get("sticky", False)),
+            admission_token=str(state.get("token", "") or ""),
             # pre-crash spans are gone with the old process; post-
             # recovery assignments still assemble under one fresh trace
             trace_id=_tracing.new_trace_id())
         for j, n in state["job_ntasks"].items():
+            j = int(j)
             job = jobs[j]
             bulk.job_tasks[j] = {(j, t) for t in range(n)}
             for t, (s, e) in enumerate(job.tasks[:n]):
@@ -1557,34 +1871,51 @@ class Master:
             bulk.job_output_rows[j] = state["job_output_rows"][j]
             bulk.total_tasks += n
         try:
-            if self.db.backend.exists(md.bulk_progress_path()):
-                prog = cloudpickle.loads(
-                    self.db.backend.read(md.bulk_progress_path()))
-                if prog.get("bulk_id") == bulk.bulk_id:
-                    if "done_runs" in prog:
-                        bulk.done = self._decode_task_set(
-                            prog["done_runs"])
-                    else:  # earlier format stored explicit tuples
-                        bulk.done = {tuple(k)
-                                     for k in prog.get("done", ())}
-                    bulk.failures = {tuple(k): v
-                                     for k, v in prog["failures"].items()}
-                    bulk.blacklisted_jobs = set(prog["blacklisted_jobs"])
-                    bulk.committed_jobs = set(prog["committed_jobs"])
-                    bulk.error = prog.get("error", "")
-                    for j in bulk.blacklisted_jobs:
-                        bulk.blacklisted_task_total += len(
-                            bulk.job_tasks.get(j, ()))
-                        bulk.done_in_blacklisted += sum(
-                            1 for k in bulk.job_tasks.get(j, ())
-                            if k in bulk.done)
+            prog_payload = self._load_sealed(
+                md.bulk_progress_path(source_gen), "bulk progress")
+            prog = cloudpickle.loads(prog_payload) \
+                if prog_payload is not None else None
+            if prog is not None and prog.get("bulk_id") == bulk.bulk_id:
+                if "done_runs" in prog:
+                    bulk.done = self._decode_task_set(
+                        prog["done_runs"])
+                else:  # earlier format stored explicit tuples
+                    bulk.done = {tuple(k)
+                                 for k in prog.get("done", ())}
+                bulk.failures = {tuple(k): v
+                                 for k, v in prog["failures"].items()}
+                bulk.transient_failures = {
+                    tuple(k): v for k, v in
+                    (prog.get("transient_failures") or {}).items()}
+                bulk.blacklisted_jobs = set(prog["blacklisted_jobs"])
+                bulk.committed_jobs = set(prog["committed_jobs"])
+                bulk.error = prog.get("error", "")
         except Exception:  # noqa: BLE001
-            # a corrupt progress file costs completed-task state, not the
-            # bulk: resume from zero done rather than brick the master
+            # a corrupt progress file costs the snapshot, not the bulk:
+            # the journal replay below still restores every record
+            # since the last compaction
             _mlog.exception("bulk progress unreadable; resuming from "
-                            "admission state")
+                            "admission state + journal replay")
             bulk.done = set()
             bulk.failures = {}
+        # write-ahead journal replay: completions/strikes/blacklists
+        # acknowledged after the last checkpoint — the records a plain
+        # checkpoint-window restart would lose and re-execute
+        applied = self._apply_journal_records(bulk, records)
+        if records:
+            _mlog.info(
+                "journal replay: %d records across %d segments "
+                "(%d newly applied over the checkpoint%s)",
+                jstats.get("records", 0), jstats.get("segments", 0),
+                applied,
+                "; torn tail tolerated" if jstats.get("torn") else "")
+        # blacklist aggregates from the FINAL sets (snapshot + replay)
+        for j in bulk.blacklisted_jobs:
+            bulk.blacklisted_task_total += len(
+                bulk.job_tasks.get(j, ()))
+            bulk.done_in_blacklisted += sum(
+                1 for k in bulk.job_tasks.get(j, ())
+                if k in bulk.done)
         # ETA baseline: rate counts only post-recovery completions
         bulk.done_at_start = len(bulk.done) - bulk.done_in_blacklisted
         for j, _t in bulk.done:
@@ -1605,6 +1936,12 @@ class Master:
             self._history[bulk.bulk_id] = bulk
             self._next_bulk_id = max(self._next_bulk_id,
                                      bulk.bulk_id + 1)
+            if bulk.admission_token:
+                # client ride-through: a NewJob retried against THIS
+                # master with the original token dedupes to the
+                # recovered bulk instead of double-running it
+                self._record_admission_token_locked(
+                    bulk.admission_token, bulk.bulk_id)
         # tasks finished before the crash may complete whole jobs (or the
         # whole bulk, if the crash hit between last-task and cleanup)
         for j in list(bulk.job_tasks):
@@ -1612,12 +1949,21 @@ class Master:
         self._maybe_finish_bulk(bulk)
         if bulk.finished:
             self._clear_bulk_checkpoint()
+            self._drop_recovery_source(source_gen)
             _mlog.info("recovered bulk %d was already complete", bulk.bulk_id)
         else:
+            # migrate the bulk's durable state under THIS generation
+            # (fresh checkpoint + progress + journal), then drop the
+            # predecessor's — its fenced late writes land in a
+            # directory nothing reads again
+            self._persist_bulk_checkpoint(bulk)
+            self._persist_bulk_progress(bulk)
+            self._drop_recovery_source(source_gen)
             _mlog.info(
-                "recovered bulk %d from checkpoint: %d/%d tasks done, "
-                "%d requeued", bulk.bulk_id, len(bulk.done),
-                bulk.total_tasks, bulk.q_count())
+                "recovered bulk %d from generation %s: %d/%d tasks "
+                "done, %d requeued", bulk.bulk_id,
+                source_gen if source_gen is not None else "legacy",
+                len(bulk.done), bulk.total_tasks, bulk.q_count())
 
     # -- internals ----------------------------------------------------------
 
@@ -1638,7 +1984,8 @@ class Master:
             cls._dec_held(bulk, cur[0])
         return cur
 
-    def _blacklist_job(self, bulk: _BulkJob, j: int, err: str) -> None:
+    def _blacklist_job(self, bulk: _BulkJob, j: int, err: str,
+                       recs: Optional[List[dict]] = None) -> None:
         if j in bulk.blacklisted_jobs:
             # idempotent: two timed-out tasks of one job can both trip the
             # failure threshold in a single scan pass; double-counting the
@@ -1646,6 +1993,8 @@ class Master:
             return
         _mlog.error("job %d blacklisted after repeated failures: %s", j, err)
         _M_JOBS_BLACKLISTED.inc()
+        if recs is not None:
+            recs.append({"t": "blacklist", "j": j, "error": err})
         bulk.blacklisted_jobs.add(j)
         bulk.blacklisted_task_total += len(bulk.job_tasks.get(j, ()))
         bulk.done_in_blacklisted += sum(
@@ -1656,7 +2005,8 @@ class Master:
         if not bulk.error:
             bulk.error = f"job {j} blacklisted after repeated failures: {err}"
 
-    def _maybe_finish_job(self, bulk: _BulkJob, j: int) -> None:
+    def _maybe_finish_job(self, bulk: _BulkJob, j: int,
+                          recs: Optional[List[dict]] = None) -> None:
         if j in bulk.committed_jobs or j in bulk.blacklisted_jobs:
             return
         if bulk.job_tasks[j] <= bulk.done:
@@ -1669,6 +2019,8 @@ class Master:
                 stream.storage.finished(stream,
                                         bulk.job_output_rows.get(j, 0))
             bulk.committed_jobs.add(j)
+            if recs is not None:
+                recs.append({"t": "commit", "j": j})
 
     def _maybe_finish_bulk(self, bulk: _BulkJob) -> None:
         active_total = bulk.total_tasks - bulk.blacklisted_task_total
@@ -1682,10 +2034,18 @@ class Master:
     def _scan_loop(self) -> None:
         """Liveness + timeout scanning (reference start_worker_pinger
         master.cpp:1837 and timeout scan master.cpp:1751-1776)."""
+        fence_tick = 0
         while not self._shutdown.is_set():
             time.sleep(0.5)
             now = time.time()
             finished_bulk_id = None
+            # generation-fence poll (~2 s): a paused-then-resumed stale
+            # master discovers its successor here and stops accepting
+            # mutations (path scoping already protects storage)
+            fence_tick += 1
+            if fence_tick % 4 == 0:
+                self._check_fence()
+            recs: List[dict] = []
             with self._lock:
                 # refresh the point-in-time gauges (0.5s resolution is
                 # plenty for a human-watched dashboard)
@@ -1736,10 +2096,14 @@ class Master:
                                     continue
                                 n = bulk.failures.get(key, 0) + 1
                                 bulk.failures[key] = n
+                                recs.append({"t": "strike",
+                                             "j": key[0], "k": key[1],
+                                             "n": n})
                                 _M_STRIKES.inc()
                                 if n >= MAX_TASK_FAILURES:
                                     self._blacklist_job(
-                                        bulk, key[0], "task timeout")
+                                        bulk, key[0], "task timeout",
+                                        recs=recs)
                                 else:
                                     bulk.q_push(key, front=True)
                                     _M_TASK_RETRIES.inc()
@@ -1759,6 +2123,7 @@ class Master:
                 if self.enable_watchdog and \
                         now - self._last_poke > 30.0:
                     self._shutdown.set()
+            self._journal_append(recs)
             if finished_bulk_id is not None \
                     and finished_bulk_id != self._cleared_bulk_id:
                 self._clear_bulk_checkpoint(finished_bulk_id)
@@ -1875,6 +2240,11 @@ class Worker:
         # constructed wins when several share a test process)
         _memstats.set_tracer(self.tracer)
         self._shutdown = threading.Event()
+        # master-generation latch (engine/journal.py): replies stamped
+        # with an older generation than the highest seen are a stale
+        # (superseded) master's — its assignments and revocations are
+        # NACKed instead of acted on
+        self._gen = _journal.GenerationLatch()
         # SIGTERM drain mode (start_worker wires the signal): stop
         # pulling, finish in-flight tasks, deregister, then shut down
         self._draining = threading.Event()
@@ -1931,17 +2301,29 @@ class Worker:
         # first dialed against a not-yet-listening address can wedge in
         # connection-refused on some network stacks (see
         # rpc.wait_for_server), and this channel lives for the worker's
-        # whole life
+        # whole life — except across a master restart, where the
+        # heartbeat loop recreates it (see _heartbeat_loop: the same
+        # wedge can strike a channel whose peer died and came back)
+        self._master_address = master_address
         self.master = rpc.RpcClient(master_address, MASTER_SERVICE,
                                     timeout=10.0)
+        self._hb_misses = 0
         # the address other processes can dial THIS worker at (the
         # master's GetMetrics aggregation uses it).  localhost is right
         # for single-host clusters and tests; multi-host deployments
         # pass the pod/host DNS name (deploy.py wires the pod name)
         self.advertise_address = \
             f"{advertise_host or 'localhost'}:{self.port}"
-        self.worker_id = self.master.call(
-            "RegisterWorker", address=self.advertise_address)["worker_id"]
+        reg = self.master.call("RegisterWorker",
+                               address=self.advertise_address)
+        if reg.get("worker_id") is None:
+            # a FENCED (superseded) master answers an error reply:
+            # fail startup loudly instead of KeyError-ing — this
+            # worker is pointed at the wrong master instance
+            raise ScannerException(
+                "master refused worker registration: "
+                f"{reg.get('error', reg)}")
+        self.worker_id = reg["worker_id"]
         self.tracer.node = f"worker{self.worker_id}"
         self.executor.tracer = self.tracer
         _wlog.info("worker %d registered with master %s (port %d)",
@@ -1998,6 +2380,31 @@ class Worker:
                                       timeout=PING_TIMEOUT,
                                       preempting=self._preempting,
                                       firing=firing)
+            if hb is None:
+                # ride a master restart out for real: a channel whose
+                # peer died mid-dial can wedge past the peer's return
+                # (the wait_for_server fresh-channel note) — after 5
+                # consecutive missed beats, redial on a FRESH channel
+                # so failover to a successor master actually completes
+                self._hb_misses += 1
+                if self._hb_misses % 5 == 0 \
+                        and not self._shutdown.is_set():
+                    _wlog.warning(
+                        "worker %d: %d consecutive heartbeat misses — "
+                        "recreating the master channel (%s)",
+                        self.worker_id, self._hb_misses,
+                        self._master_address)
+                    old, self.master = self.master, rpc.RpcClient(
+                        self._master_address, MASTER_SERVICE,
+                        timeout=10.0)
+                    old.close()
+            else:
+                self._hb_misses = 0
+            if hb is not None and not self._gen.observe(hb):
+                # a stale master's view of the cluster: ignore it (its
+                # reregister/active_bulk verdicts are not authoritative)
+                time.sleep(PING_INTERVAL)
+                continue
             if hb is not None:
                 if hb.get("reregister"):
                     # don't rejoin a cluster we are leaving
@@ -2006,7 +2413,10 @@ class Worker:
                             "RegisterWorker",
                             address=self.advertise_address,
                             timeout=PING_TIMEOUT)
-                        if reg:
+                        # a FENCED master answers an error reply with
+                        # no worker_id: stay on the old id and keep
+                        # beating until a live master answers
+                        if reg and reg.get("worker_id") is not None:
                             self.worker_id = reg["worker_id"]
                 else:
                     self._hb_reply = hb
@@ -2068,6 +2478,7 @@ class Worker:
             "role": "worker",
             "worker_id": getattr(self, "worker_id", None),
             "master": master.address if master else None,
+            "master_generation": self._gen.highest(),
             "draining": self._draining.is_set(),
             "preempting": self._preempting,
             "bulk_id": getattr(self, "_bulk_id", None),
@@ -2220,7 +2631,13 @@ class Worker:
                   + self.executor.num_load_workers)
         reply = self.master.try_call("NextWork", worker_id=self.worker_id,
                                      bulk_id=bulk_id, window=window)
-        if reply is None or reply["status"] in ("none", "done"):
+        if reply is not None and not self._gen.observe(reply):
+            # stale-generation assignment: NACK — never run work a
+            # superseded master handed out (the live master owns the
+            # task queue; a double-assignment would race its attempt)
+            return "wait"
+        if reply is None or reply.get("status") is None \
+                or reply["status"] in ("none", "done"):
             return None
         if reply["status"] == "wait":
             return "wait"
@@ -2274,6 +2691,11 @@ class Worker:
                 "StartedWork", bulk_id=bulk_id, worker_id=self.worker_id,
                 job_idx=w.job.job_idx, task_idx=w.task_idx,
                 attempt=w.attempt)
+            if reply is not None and not self._gen.observe(reply):
+                # a stale master's revocation verdict is not
+                # authoritative: NACK it and keep the attempt running
+                # (the live master still holds the assignment)
+                return True
             return reply is None or bool(reply.get("ok"))
 
         def on_eval_done(w) -> None:
@@ -2368,16 +2790,21 @@ class ClusterClient:
                  enable_watchdog: bool = False, poll_interval: float = 0.25,
                  master_down_timeout: float = 120.0, **_kw):
         self.db = db
+        self._master_address = master_address
         self.master = rpc.RpcClient(master_address, MASTER_SERVICE)
         self.poll_interval = poll_interval
+        self._last_refresh = time.time()
         # how long GetJobStatus may fail continuously before the client
         # gives up — long enough to ride out a master restart (it recovers
         # the bulk from its checkpoint), short enough that a dead master
         # raises instead of hanging the caller forever
         self.master_down_timeout = master_down_timeout
         # bulk id of the most recent run() (Client.trace maps its job id
-        # to the master-side bulk through this)
+        # to the master-side bulk through this), and the admission
+        # token it was admitted under (NewJob dedupe across retries
+        # and master restarts)
         self.last_bulk_id: Optional[int] = None
+        self.last_admission_token: Optional[str] = None
         self._watchdog_stop = threading.Event()
         if enable_watchdog:
             t = threading.Thread(target=self._poke_loop, daemon=True)
@@ -2388,18 +2815,59 @@ class ClusterClient:
             self.master.try_call("PokeWatchdog")
             time.sleep(5.0)
 
+    def _refresh_channel(self) -> None:
+        """Replace the master channel with a freshly dialed one (other
+        threads pick the new client up on their next call; in-flight
+        calls on the closed channel surface as transport failures
+        try_call already tolerates)."""
+        self._last_refresh = time.time()
+        old, self.master = self.master, rpc.RpcClient(
+            self._master_address, MASTER_SERVICE)
+        old.close()
+
     def run(self, outputs, perf: PerfParams, cache_mode: CacheMode,
             show_progress: bool) -> List[Profiler]:
+        import uuid
+
+        from ..util.retry import retry_until_deadline
         spec = cloudpickle.dumps({
             "outputs": list(outputs), "perf": perf,
             "cache_mode": cache_mode.value})
+        # client-minted admission token: the master dedupes on it, so
+        # NewJob becomes safe to repeat end-to-end — a retry after an
+        # ambiguous timeout, or against the SUCCESSOR of a restarted
+        # master (tokens ride the checkpoint/journal), returns the
+        # already-admitted bulk id instead of double-running the bulk
+        token = uuid.uuid4().hex
+        self.last_admission_token = token
         # load shedding (admission_pause remediation playbook): a
         # paused master answers retryable instead of admitting onto a
         # backpressured cluster — back off and retry until it resumes,
-        # bounded by the same deadline a dead master gets
+        # bounded by the same deadline a dead master gets.  Transport
+        # failures (a master mid-restart) ride the same deadline: the
+        # token makes the repeat safe.
         admit_deadline = time.time() + self.master_down_timeout
+        admit_fails = [0]
+
+        def _admit() -> dict:
+            try:
+                return self.master.call("NewJob", spec=spec,
+                                        token=token, timeout=120.0)
+            except rpc.RpcError:
+                # the wedged-channel pathology (see _refresh_channel):
+                # a channel whose peer died mid-dial can stay stuck
+                # past the successor's return — redial fresh every few
+                # failed admission attempts, like the status poll does
+                admit_fails[0] += 1
+                if admit_fails[0] % 8 == 0:
+                    self._refresh_channel()
+                raise
+
         while True:
-            reply = self.master.call("NewJob", spec=spec, timeout=120.0)
+            reply = retry_until_deadline(
+                _admit,
+                is_transient=lambda e: isinstance(e, rpc.RpcError),
+                deadline=admit_deadline, label="rpc:NewJob:admission")
             if reply.get("admission_paused") \
                     and time.time() < admit_deadline:
                 time.sleep(float(reply.get("retry_after") or 1.0))
@@ -2410,6 +2878,7 @@ class ClusterClient:
         bulk_id = reply["bulk_id"]
         self.last_bulk_id = bulk_id
         last_ok = time.time()
+        retoken_tried = False
         while True:
             # try_call: a master restarting mid-bulk (it recovers the job
             # from its checkpoint) must look like slow progress, not a
@@ -2417,17 +2886,40 @@ class ClusterClient:
             # master_down_timeout raises instead of hanging forever
             st = self.master.try_call("GetJobStatus", bulk_id=bulk_id)
             if st is None:
-                if time.time() - last_ok > self.master_down_timeout:
+                now = time.time()
+                if now - last_ok > self.master_down_timeout:
                     raise JobException(
                         f"master unreachable for "
                         f"{self.master_down_timeout:.0f}s while waiting "
                         f"on bulk {bulk_id}")
+                if now - last_ok > 10.0 \
+                        and now - self._last_refresh > 10.0:
+                    # a channel whose peer died mid-dial can wedge past
+                    # the restart (see rpc.wait_for_server): redial the
+                    # restarted/successor master on a FRESH channel
+                    self._refresh_channel()
                 time.sleep(self.poll_interval)
                 continue
             last_ok = time.time()
             if "tasks_done" not in st:
-                # the master came back without this bulk (recovery failed
-                # or checkpoint missing): surface, don't KeyError
+                # the master came back without this bulk under the id
+                # we knew: re-present the admission token ONCE — a
+                # successor that recovered the bulk (or renumbered it)
+                # hands its id back via the dedupe path, and polling
+                # resumes; only a truly lost bulk surfaces as an error
+                if not retoken_tried:
+                    retoken_tried = True
+                    # resolve=True: a lookup-only probe — an unknown
+                    # token answers unknown_token instead of admitting
+                    # a fresh bulk this client would then abandon
+                    reply = self.master.try_call(
+                        "NewJob", spec=spec, token=token, resolve=True,
+                        timeout=120.0)
+                    if reply and reply.get("dedup") \
+                            and reply.get("bulk_id") is not None:
+                        bulk_id = reply["bulk_id"]
+                        self.last_bulk_id = bulk_id
+                        continue
                 raise JobException(st.get("error", "bulk job lost"))
             if show_progress:
                 # same numbers as /statusz (GetJobStatus is the single

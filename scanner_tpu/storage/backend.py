@@ -30,7 +30,14 @@ class StorageBackend:
     def read_range(self, path: str, offset: int, size: int) -> bytes:
         raise NotImplementedError
 
-    def write(self, path: str, data: bytes) -> None:
+    def write(self, path: str, data: bytes, sync: bool = True) -> None:
+        """Atomically replace `path` with `data`.  sync=False skips the
+        durability barrier (fsync) where the backend has one: the blob
+        still survives a PROCESS kill (the page cache outlives it) but
+        not a machine crash — the right trade for the master's
+        write-ahead journal segments, whose format tolerates a torn
+        tail and which would otherwise pay one fsync per acknowledged
+        task completion."""
         raise NotImplementedError
 
     def write_exclusive(self, path: str, data: bytes) -> bool:
@@ -98,7 +105,7 @@ class PosixStorage(StorageBackend):
             data = _faults.inject("storage.read", data, detail=path)
         return data
 
-    def write(self, path: str, data: bytes) -> None:
+    def write(self, path: str, data: bytes, sync: bool = True) -> None:
         if _faults.ACTIVE:
             _faults.inject("storage.write", detail=path)
         p = self._abs(path)
@@ -107,7 +114,8 @@ class PosixStorage(StorageBackend):
         with open(tmp, "wb") as f:
             f.write(data)
             f.flush()
-            os.fsync(f.fileno())
+            if sync:
+                os.fsync(f.fileno())
         os.replace(tmp, p)
 
     def write_exclusive(self, path: str, data: bytes) -> bool:
@@ -204,7 +212,7 @@ class MemoryStorage(StorageBackend):
     def read_range(self, path: str, offset: int, size: int) -> bytes:
         return self.read(path)[offset:offset + size]
 
-    def write(self, path: str, data: bytes) -> None:
+    def write(self, path: str, data: bytes, sync: bool = True) -> None:
         if _faults.ACTIVE:
             _faults.inject("storage.write", detail=path)
         with self._lock:

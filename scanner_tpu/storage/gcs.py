@@ -162,10 +162,11 @@ class GcsStorage(StorageBackend):
 
     # -- writes ---------------------------------------------------------
 
-    def write(self, path: str, data: bytes) -> None:
+    def write(self, path: str, data: bytes, sync: bool = True) -> None:
         # resumable chunked upload above _CHUNK_SIZE; object visibility
         # is atomic either way.  Retry-safe: re-uploading the same bytes
-        # is idempotent.
+        # is idempotent.  `sync` is meaningless here (GCS objects are
+        # durable at acknowledgment); accepted for interface parity.
         self._with_retry(
             lambda: self._blob(path, chunked=len(data) > _CHUNK_SIZE)
             .upload_from_string(bytes(data),

@@ -182,6 +182,74 @@ def _verify(buf: bytes, hdr: int, version: int, nrows: int, crc: int,
             f"item checksum mismatch ({len(buf)} bytes): {path}")
 
 
+# ---------------------------------------------------------------------------
+# sealed control-plane blobs (bulk checkpoint / journal payloads)
+# ---------------------------------------------------------------------------
+
+# distinct magic so a sealed blob can never be confused with an item
+# file or a legacy (unsealed) checkpoint
+BLOB_MAGIC = 0x53434B50  # "SCKP"
+_BLOB_HDR = struct.Struct("<III")  # magic, checksum-algo version, crc
+
+
+def checksum_blob(payload: bytes) -> Tuple[int, int]:
+    """(algorithm version, crc) of one payload with the strongest
+    available algorithm — the same crc32c/zlib selection item files use
+    (the algorithm travels with the data, so mixed installs never
+    misread valid bytes as corrupt)."""
+    return _WRITE_VERSION, _checksum_parts(_WRITE_VERSION, [payload])
+
+
+def verify_blob_checksum(version: int, crc: int, payload: bytes,
+                         path: str = "") -> None:
+    """Raise ItemCorruptionError when `payload` fails its recorded
+    checksum.  A crc32c-stamped blob on a node without google_crc32c is
+    skipped (same contract as item verification: never guess with the
+    wrong polynomial)."""
+    global _warned_unverifiable
+    if version == VERSION_CRC32C and not _HAVE_CRC32C:
+        if not _warned_unverifiable:
+            _warned_unverifiable = True
+            from ..util.log import get_logger
+            get_logger("storage").warning(
+                "google_crc32c unavailable: crc32c item checksums "
+                "(version 2) cannot be verified on this node")
+        return
+    if version not in (VERSION_CRC32C, VERSION_CRC32):
+        raise StorageException(
+            f"unsupported blob checksum version {version} in {path}")
+    if _checksum_parts(version, [payload]) != crc:
+        raise ItemCorruptionError(
+            f"sealed blob checksum mismatch ({len(payload)} bytes): "
+            f"{path}")
+
+
+def seal_blob(payload: bytes) -> bytes:
+    """Wrap a control-plane payload (bulk checkpoint, progress
+    snapshot) with a checksummed header, so rot in the master's
+    recovery state is *detected* at restart instead of silently
+    resurrecting a half-garbage bulk (engine/service.py
+    `_recover_bulk` falls back to journal replay on a corrupt
+    checkpoint)."""
+    version, crc = checksum_blob(payload)
+    return _BLOB_HDR.pack(BLOB_MAGIC, version, crc) + payload
+
+
+def open_blob(data: bytes, path: str = "") -> bytes:
+    """Verify + unwrap a sealed blob.  Raises StorageException when the
+    data is not a sealed blob at all (callers may fall back to treating
+    it as a legacy unsealed payload) and ItemCorruptionError when the
+    checksum fails."""
+    if len(data) < _BLOB_HDR.size:
+        raise StorageException(f"not a sealed blob (too short): {path}")
+    magic, version, crc = _BLOB_HDR.unpack_from(data, 0)
+    if magic != BLOB_MAGIC:
+        raise StorageException(f"not a sealed blob: {path}")
+    payload = data[_BLOB_HDR.size:]
+    verify_blob_checksum(version, crc, payload, path)
+    return payload
+
+
 def read_item(backend: StorageBackend, path: str) -> List[Optional[bytes]]:
     """Read every row of an item. Null rows come back as None."""
     buf = backend.read(path)

@@ -59,17 +59,54 @@ def job_profile_path(job_id: int, node: str) -> str:
     return f"jobs/{job_id}/profile_{node}.trace"
 
 
-def bulk_checkpoint_path() -> str:
+def generation_prefix() -> str:
+    """Directory of master-generation claim markers (one small blob per
+    claimed generation; `write_exclusive` CAS makes each claim atomic —
+    engine/journal.py claim_generation)."""
+    return "jobs/generations"
+
+
+def generation_path(gen: int) -> str:
+    return f"jobs/generations/{gen:08d}.bin"
+
+
+def generation_dir(gen: int) -> str:
+    """Per-generation control-plane state root: checkpoint, progress and
+    journal of the master that claimed `gen` live under it, so a fenced
+    (superseded) master's late writes can never clobber its successor's
+    state — they land in a directory the successor never reads from
+    again once recovery migrated the bulk."""
+    return f"jobs/g{gen:08d}"
+
+
+def bulk_checkpoint_path(gen: Optional[int] = None) -> str:
     """Active bulk job's admission state (spec blob + task geometry) —
     lets a restarted master resume the job (reference
-    recover_and_init_database, master.cpp:1311)."""
-    return "jobs/active_bulk.bin"
+    recover_and_init_database, master.cpp:1311).  Generation-scoped
+    when `gen` is given; the legacy fixed path (pre-fencing masters)
+    remains readable for recovery."""
+    if gen is None:
+        return "jobs/active_bulk.bin"
+    return f"{generation_dir(gen)}/active_bulk.bin"
 
 
-def bulk_progress_path() -> str:
+def bulk_progress_path(gen: Optional[int] = None) -> str:
     """Active bulk job's progress (done-set, blacklist, commits), written
-    with each periodic checkpoint."""
-    return "jobs/active_bulk_progress.bin"
+    with each periodic checkpoint.  Generation-scoped when `gen` is
+    given (see bulk_checkpoint_path)."""
+    if gen is None:
+        return "jobs/active_bulk_progress.bin"
+    return f"{generation_dir(gen)}/active_bulk_progress.bin"
+
+
+def journal_dir(gen: int) -> str:
+    """Write-ahead bulk-journal segments of one master generation
+    (engine/journal.py)."""
+    return f"{generation_dir(gen)}/journal"
+
+
+def journal_segment_path(gen: int, seg: int) -> str:
+    return f"{journal_dir(gen)}/seg_{seg:08d}.bin"
 
 
 # ---------------------------------------------------------------------------

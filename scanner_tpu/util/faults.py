@@ -35,10 +35,13 @@ Plan syntax — clauses joined by ";", fields joined by ":"::
     <site>:<mode>[:key=value]...
 
 modes:
-    raise    raise an exception (key ``exc`` picks the type, see _EXC)
-    delay    sleep ``seconds`` (a hang, from the caller's view)
-    corrupt  flip bytes in the data passing through the site
-    crash    os._exit(CRASH_EXIT_CODE) — worker/master death mid-call
+    raise      raise an exception (key ``exc`` picks the type, see _EXC)
+    delay      sleep ``seconds`` (a hang, from the caller's view)
+    corrupt    flip bytes in the data passing through the site
+    crash      os._exit(CRASH_EXIT_CODE) — worker/master death mid-call
+    duplicate  deliver the call TWICE (rpc.client.call only): the
+               at-least-once model — request arrived, reply lost,
+               caller repeats ("partitioned ≠ dead")
 
 trigger keys (default: fire on every matching call):
     n=K       fire on exactly the Kth matching call (1-based)
@@ -49,6 +52,10 @@ trigger keys (default: fire on every matching call):
     times=K   stop after K fires (0 = unlimited)
     match=S   only calls whose detail string contains S (e.g. an RPC
               method name or a storage path)
+    method=S  rpc.client.call detail is "<method>@<peer>": select one
+              RPC method regardless of peer
+    peer=S    select one remote address — asymmetric-partition plans
+              ("calls to THIS peer fail, others succeed")
 
 other keys: ``exc`` (raise mode), ``msg``, ``seconds`` (delay mode),
 ``seed`` (p mode).
@@ -95,13 +102,26 @@ SITES = (
     "memory.pressure",    # engine/batch.py to_device staging, per h2d
 )
 
-MODES = ("raise", "delay", "corrupt", "crash")
+MODES = ("raise", "delay", "corrupt", "crash", "duplicate")
 
 # sites whose hook passes payload bytes through inject() — the only
 # sites corrupt-mode can act on; install() rejects it elsewhere so a
 # plan like "storage.write:corrupt" fails loudly instead of counting
 # phantom fires that injected nothing
 DATA_SITES = ("storage.read",)
+
+# sites whose hook supports duplicate-delivery mode (the call is made
+# TWICE against the peer, modeling at-least-once delivery after an
+# ambiguous timeout — "partitioned ≠ dead"); the site's call path must
+# ask take_duplicate() explicitly, so install() rejects the mode
+# anywhere else
+DUPLICATE_SITES = ("rpc.client.call",)
+
+# sites whose detail string is "<method>@<peer>" — the only sites the
+# structured method=/peer= selectors can meaningfully match; install()
+# rejects them elsewhere (a peer= clause on storage.read would parse
+# and then silently never fire)
+SELECTOR_SITES = ("rpc.client.call",)
 
 # distinctive exit status for crash-mode so tests can tell an injected
 # death from a real one
@@ -180,6 +200,13 @@ class FaultRule:
     seed: int = 0
     times: int = 0
     match: str = ""
+    # structured selectors over the "<method>@<peer>" detail the RPC
+    # client site passes (match= stays a raw substring): method=
+    # selects one RPC method, peer= one remote address — together they
+    # model ASYMMETRIC partitions ("calls to THIS peer fail, others
+    # succeed") that a plain substring cannot express safely
+    method: str = ""
+    peer: str = ""
     # runtime state (not part of the spec)
     calls: int = field(default=0, compare=False)
     fired: int = field(default=0, compare=False)
@@ -204,6 +231,17 @@ class FaultRule:
                 f"corrupt mode needs a data-carrying site "
                 f"({', '.join(DATA_SITES)}); {self.site} passes no "
                 f"bytes through inject()")
+        if self.mode == "duplicate" and self.site not in DUPLICATE_SITES:
+            raise FaultPlanError(
+                f"duplicate mode needs a duplicating call site "
+                f"({', '.join(DUPLICATE_SITES)}); {self.site} never "
+                f"asks take_duplicate()")
+        if (self.method or self.peer) \
+                and self.site not in SELECTOR_SITES:
+            raise FaultPlanError(
+                f"method=/peer= selectors need a '<method>@<peer>' "
+                f"detail site ({', '.join(SELECTOR_SITES)}); "
+                f"{self.site} details carry no peer — use match=")
         if self.p:
             self._rng = random.Random(self.seed)
 
@@ -213,6 +251,12 @@ class FaultRule:
         — the draw sequence is deterministic per rule per process."""
         if self.match and self.match not in detail:
             return False
+        if self.method or self.peer:
+            m, _sep, p = detail.partition("@")
+            if self.method and self.method not in m:
+                return False
+            if self.peer and self.peer not in p:
+                return False
         self.calls += 1
         if self.times and self.fired >= self.times:
             return False
@@ -251,10 +295,29 @@ class _Registry:
         with self._lock:
             return [r for rs in self._rules.values() for r in rs]
 
-    def fire(self, site: str, data, detail: str):
+    def take_duplicate(self, site: str, detail: str) -> bool:
+        """Trigger decision for the duplicate-delivery rules of a site
+        — asked by the call site AFTER a successful call, because only
+        the site itself can re-issue the request (inject() cannot)."""
         with self._lock:
             hits = [r for r in self._rules.get(site, ())
-                    if r.should_fire(detail)]
+                    if r.mode == "duplicate" and r.should_fire(detail)]
+        for r in hits:
+            _M_FAULTS.labels(site=site, mode="duplicate").inc()
+            from . import tracing as _tracing
+            _tracing.add_event("fault.injected", site=site,
+                               mode="duplicate", detail=detail)
+            _log.warning("injecting duplicate delivery at %s "
+                         "(detail=%r, fire %d)", site, detail, r.fired)
+        return bool(hits)
+
+    def fire(self, site: str, data, detail: str):
+        with self._lock:
+            # duplicate-mode rules are actioned by take_duplicate()
+            # at the call site, never here — inject() must not tick
+            # their trigger counters
+            hits = [r for r in self._rules.get(site, ())
+                    if r.mode != "duplicate" and r.should_fire(detail)]
         for i, r in enumerate(hits):
             try:
                 _M_FAULTS.labels(site=site, mode=r.mode).inc()
@@ -334,7 +397,7 @@ def parse_plan(spec: str) -> List[FaultRule]:
                 kw[k] = int(v)
             elif k in ("p", "seconds"):
                 kw[k] = float(v)
-            elif k in ("exc", "msg", "match"):
+            elif k in ("exc", "msg", "match", "method", "peer"):
                 kw[k] = v
             else:
                 raise FaultPlanError(
@@ -375,6 +438,16 @@ def inject(site: str, data=None, detail: str = ""):
     if not ACTIVE:
         return data
     return _registry.fire(site, data, detail)
+
+
+def take_duplicate(site: str, detail: str = "") -> bool:
+    """Should this call be delivered a second time?  Asked by sites in
+    DUPLICATE_SITES after a successful call — the fault model for
+    at-least-once delivery ("partitioned ≠ dead": the first request
+    arrived, its reply was lost, the caller repeats)."""
+    if not ACTIVE:
+        return False
+    return _registry.take_duplicate(site, detail)
 
 
 def fired(site: Optional[str] = None) -> int:
@@ -420,6 +493,17 @@ NAMED_PLANS = {
     # re-absorb the work (chaos_run arms ONE of N workers, so N=3 is
     # the headline "preempt ~30% of workers mid-bulk" plan)
     "worker-preempt": "worker.preempt:raise:n=2:times=1",
+    # the headline control-plane drill (docs/robustness.md §Durable
+    # control plane): the master is killed handling a FinishedWork
+    # mid-bulk AND the client's NewJob is delivered twice (ambiguous-
+    # timeout retry).  The successor must recover via checkpoint +
+    # journal replay with zero acknowledged completions lost, the
+    # duplicate admission must dedupe on the token, and chaos_run
+    # additionally spawns a forced-stale master and asserts it is
+    # fenced with zero accepted mutations.
+    "master-failover":
+        "rpc.server.handle:crash:match=FinishedWork:n=4;"
+        "rpc.client.call:duplicate:method=NewJob:n=1:times=1",
 }
 
 

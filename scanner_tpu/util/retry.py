@@ -102,6 +102,54 @@ def backoff_delays(retries: int, base: float = 0.05, cap: float = 2.0,
         yield r.uniform(0.0, min(cap, base * (2.0 ** i)))
 
 
+def retry_until_deadline(fn: Callable[[], T], *,
+                         is_transient: Callable[[Exception], bool],
+                         deadline: float, base: float = 0.25,
+                         cap: float = 2.0,
+                         sleep: Callable[[float], None] = time.sleep,
+                         rng: Optional[random.Random] = None,
+                         label: str = "",
+                         budget: Optional[RetryBudget] = None) -> T:
+    """Like call_with_backoff, but bounded by a wall-clock `deadline`
+    instead of an attempt count — for calls that must ride out a peer
+    restart of UNKNOWN duration and are safe to repeat end-to-end
+    (idempotent by design, e.g. the token-deduped NewJob admission:
+    the server returns the already-admitted bulk on a repeat).  The
+    shared process retry budget still applies, so a fleet-wide outage
+    converges to fail-fast instead of a deadline-long storm."""
+    r = rng or random
+    budget = _BUDGET if budget is None else budget
+    attempt = 0
+    while True:
+        try:
+            result = fn()
+            budget.on_success()
+            return result
+        except Exception as e:  # noqa: BLE001
+            if not is_transient(e) or time.time() >= deadline:
+                raise
+            if not budget.take():
+                _M_BUDGET_DENIED.labels(site=label or "other").inc()
+                _log.warning(
+                    "retry budget exhausted%s: failing fast after %d "
+                    "deadline-bounded retries: %s: %s",
+                    f" [{label}]" if label else "", attempt,
+                    type(e).__name__, e)
+                raise e from None
+            attempt += 1
+            _M_RETRIES.labels(site=label or "other").inc()
+            from . import tracing as _tracing
+            _tracing.add_event("retry", site=label or "other",
+                               attempt=attempt,
+                               error=f"{type(e).__name__}: "
+                                     f"{str(e)[:120]}")
+            # full jitter, capped — and never sleeping past the
+            # deadline itself
+            delay = r.uniform(0.0, min(cap, base * (2.0 ** min(
+                attempt, 8))))
+            sleep(min(delay, max(0.0, deadline - time.time())))
+
+
 def call_with_backoff(fn: Callable[[], T], *,
                       is_transient: Callable[[Exception], bool],
                       retries: int = 4, base: float = 0.05,

@@ -690,10 +690,13 @@ def test_chaos_master_crash_recovery(tmp_path):
 
     def respawner():
         state["rc1"] = m1.wait(timeout=120)
-        with open(os.path.join(db_path, smd.bulk_progress_path()),
-                  "rb") as f:
-            state["done_at_crash"] = Master._decode_task_set(
-                cloudpickle.loads(f.read())["done_runs"])
+        # the progress snapshot now lives at the generation-scoped
+        # sealed path (engine/journal.py); the helper resolves it
+        from scanner_tpu.engine import journal as _journal
+        from scanner_tpu.storage.backend import PosixStorage
+        prog = _journal.load_bulk_progress(PosixStorage(db_path))
+        state["done_at_crash"] = Master._decode_task_set(
+            prog["done_runs"]) if prog else set()
         state["rows_at_crash"] = open(log).read().splitlines()
         time.sleep(0.5)
         state["m2"] = spawn_master()

@@ -633,8 +633,17 @@ def test_master_restart_recovers_bulk(tmp_path):
             [sys.executable, spawn, db_path, str(port)],
             env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
 
-    from scanner_tpu.storage import metadata as smd
-    prog_path = os.path.join(db_path, smd.bulk_progress_path())
+    from scanner_tpu.engine import journal as _journal
+    from scanner_tpu.storage.backend import PosixStorage
+    prog_backend = PosixStorage(db_path)
+
+    def _persisted_done():
+        # the progress snapshot lives at the generation-scoped sealed
+        # path now (engine/journal.py); the helper resolves + verifies
+        prog = _journal.load_bulk_progress(prog_backend)
+        if not prog or "done_runs" not in prog:
+            return set()
+        return Master._decode_task_set(prog["done_runs"])
 
     m1 = spawn_master()
     worker = None
@@ -646,18 +655,14 @@ def test_master_restart_recovers_bulk(tmp_path):
         deadline = time.time() + 60
         while time.time() < deadline:
             try:
-                with open(prog_path, "rb") as f:
-                    prog = cloudpickle.loads(f.read())
-                if len(Master._decode_task_set(prog["done_runs"])) >= 3:
+                if len(_persisted_done()) >= 3:
                     break
             except Exception:
                 pass
             time.sleep(0.05)
         m1.kill()
         m1.wait()
-        with open(prog_path, "rb") as f:
-            state["done_at_kill"] = Master._decode_task_set(
-                cloudpickle.loads(f.read())["done_runs"])
+        state["done_at_kill"] = _persisted_done()
         state["rows_at_kill"] = open(log).read().splitlines()
         time.sleep(1.0)
         state["m2"] = spawn_master()

@@ -1,0 +1,632 @@
+"""Durable control plane: write-ahead bulk journal, master generation
+fencing, idempotent admission (docs/robustness.md §Durable control
+plane; scanner_tpu/engine/journal.py).
+
+Layers:
+  * journal units — record framing, torn-tail tolerance, mid-stream
+    corruption, rotation, cut/compaction;
+  * generation units — CAS claim races (exactly one winner), the
+    worker-side latch NACKing stale replies;
+  * in-process master units — NewJob token dedupe, journal-only
+    recovery (checkpoint_frequency=0), corrupt-checkpoint fallback to
+    journal replay, a superseded master fencing itself;
+  * the spawned failover drill (slow) — SIGKILL the master mid-bulk
+    with a duplicate-delivered NewJob and a forced-stale master alive:
+    zero journaled completions re-executed, dedupe to the same bulk,
+    stale master fenced, output bit-exact, zero strikes.
+"""
+
+import os
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import cloudpickle
+import pytest
+
+from scanner_tpu import (CacheMode, Client, Kernel, NamedStream,
+                         PerfParams, register_op)
+from scanner_tpu.engine import journal
+from scanner_tpu.engine.service import (MASTER_SERVICE, Master, Worker)
+from scanner_tpu.storage import metadata as smd
+from scanner_tpu.storage.backend import MemoryStorage, PosixStorage
+from scanner_tpu.storage.items import (ItemCorruptionError, open_blob,
+                                       seal_blob)
+from scanner_tpu.util import faults
+from scanner_tpu.util import metrics as _mx
+
+# test kernels travel to worker subprocesses inside the job spec
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+pytestmark = pytest.mark.chaos
+
+N_ROWS = 24
+
+
+def _pk(v: int) -> bytes:
+    return struct.pack("<q", v)
+
+
+@register_op(name="FailoverDouble")
+class FailoverDouble(Kernel):
+    def execute(self, x: bytes) -> bytes:
+        return _pk(2 * struct.unpack("<q", x)[0])
+
+
+@register_op(name="FailoverRowLog")
+class FailoverRowLog(Kernel):
+    """Doubles the packed int AND appends it to a shared log file, so
+    the drill can assert exactly which rows were (re)executed."""
+
+    def __init__(self, config, log_path: str = ""):
+        super().__init__(config)
+        self._log = log_path
+
+    def execute(self, x: bytes) -> bytes:
+        v = struct.unpack("<q", x)[0]
+        time.sleep(0.1)
+        with open(self._log, "a") as fh:
+            fh.write(f"{v}\n")
+        return _pk(2 * v)
+
+
+EXPECT = [_pk(2 * (100 + i)) for i in range(N_ROWS)]
+
+
+def _counter(name: str, **labels) -> float:
+    entry = _mx.registry().snapshot().get(name, {})
+    for s in entry.get("samples", []):
+        if s["labels"] == labels:
+            return s["value"]
+    return 0.0
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# journal units
+# ---------------------------------------------------------------------------
+
+def test_sealed_blob_roundtrip_and_corruption():
+    payload = b"control-plane state" * 10
+    blob = seal_blob(payload)
+    assert open_blob(blob, "x") == payload
+    # a flipped payload byte is DETECTED, not silently accepted
+    rotten = bytearray(blob)
+    rotten[len(rotten) // 2] ^= 0xFF
+    with pytest.raises(ItemCorruptionError):
+        open_blob(bytes(rotten), "x")
+    # non-sealed data is distinguishable (legacy fallback path)
+    from scanner_tpu.common import StorageException
+    with pytest.raises(StorageException):
+        open_blob(b"just a pickle blob, no magic", "x")
+
+
+def test_journal_roundtrip_rotation_and_compaction():
+    s = MemoryStorage()
+    j = journal.BulkJournal(s, generation=3, rotate=4)
+    for i in range(10):
+        j.append({"t": "done", "j": 0, "k": i})
+    # 10 records at rotate=4 -> segments 0,1 sealed + open segment 2
+    segs = s.list_prefix(smd.journal_dir(3))
+    assert len(segs) == 3, segs
+    recs, stats = journal.replay(s, 3)
+    assert [r["k"] for r in recs] == list(range(10))
+    assert stats["records"] == 10 and stats["corrupt"] == 0
+
+    # cut seals the open segment; compaction below the cut drops
+    # everything a snapshot at the cut point covers
+    cut = j.cut()
+    j.append({"t": "done", "j": 0, "k": 99})
+    j.compact_below(cut)
+    recs, _stats = journal.replay(s, 3)
+    assert [r["k"] for r in recs] == [99]
+    # reset drops the whole generation's journal
+    j.reset()
+    assert s.list_prefix(smd.journal_dir(3)) == []
+
+
+def test_journal_torn_tail_tolerated():
+    s = MemoryStorage()
+    j = journal.BulkJournal(s, generation=1, rotate=100)
+    for i in range(5):
+        j.append({"t": "done", "j": 0, "k": i})
+    path = smd.journal_segment_path(1, 0)
+    blob = s.read(path)
+    # truncate mid-way through the final record: the torn-tail a crash
+    # mid-append leaves on a non-atomic backend
+    s.write(path, blob[:-7])
+    recs, stats = journal.replay(s, 1)
+    assert [r["k"] for r in recs] == [0, 1, 2, 3]
+    assert stats["torn"] == 1 and stats["corrupt"] == 0
+
+
+def test_journal_corrupt_mid_stream_stops_at_error(caplog):
+    import logging
+
+    s = MemoryStorage()
+    j = journal.BulkJournal(s, generation=1, rotate=3)
+    for i in range(6):  # two sealed segments
+        j.append({"t": "done", "j": 0, "k": i})
+    path = smd.journal_segment_path(1, 0)
+    blob = bytearray(s.read(path))
+    # rot INSIDE the first record's payload (frame header is 12 bytes):
+    # a checksum mismatch on a non-final record, not a torn tail
+    blob[14] ^= 0xFF
+    s.write(path, bytes(blob))
+    with caplog.at_level(logging.ERROR, logger="scanner_tpu.journal"):
+        recs, stats = journal.replay(s, 1)
+    assert stats["corrupt"] == 1
+    # replay stopped at the corruption: segment 1's records not applied
+    assert all(r["k"] < 3 for r in recs)
+    assert "corrupt record" in caplog.text.lower()
+
+
+def test_generation_cas_exactly_one_winner():
+    s = MemoryStorage()
+    wins = []
+    barrier = threading.Barrier(8)
+
+    def racer():
+        barrier.wait()
+        if journal.try_claim(s, 5, note="racer"):
+            wins.append(1)
+
+    threads = [threading.Thread(target=racer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 1
+    assert journal.highest_claimed(s) == 5
+    # claim_generation is monotonic past existing claims
+    assert journal.claim_generation(s) == 6
+    assert journal.claim_generation(s) == 7
+
+
+def test_claim_generation_forced_attach(monkeypatch):
+    s = MemoryStorage()
+    assert journal.claim_generation(s) == 1
+    monkeypatch.setenv("SCANNER_TPU_MASTER_GENERATION", "1")
+    # forced attach: no new claim is minted
+    assert journal.claim_generation(s) == 1
+    assert journal.highest_claimed(s) == 1
+
+
+def test_generation_latch_nacks_stale():
+    latch = journal.GenerationLatch()
+    base = _counter("scanner_tpu_stale_master_rejections_total",
+                    side="worker")
+    assert latch.observe({"generation": 2})       # latches
+    assert latch.observe({"generation": 2})       # same gen ok
+    assert latch.observe({"no_generation": True})  # legacy passes
+    assert latch.observe(None)
+    assert not latch.observe({"generation": 1})   # stale -> NACK
+    assert latch.highest() == 2
+    assert _counter("scanner_tpu_stale_master_rejections_total",
+                    side="worker") == base + 1
+
+
+# ---------------------------------------------------------------------------
+# in-process master units
+# ---------------------------------------------------------------------------
+
+def _seed_db(tmp_path, table="fo_src"):
+    db_path = str(tmp_path / "db")
+    sc = Client(db_path=db_path)
+    sc.new_table(table, ["output"],
+                 [[_pk(100 + i)] for i in range(N_ROWS)])
+    return sc, db_path
+
+
+def _spec_blob(sc, out_name, **perf_kw):
+    col = sc.io.Input([NamedStream(sc, "fo_src")])
+    col = sc.ops.FailoverDouble(x=col)
+    out = NamedStream(sc, out_name)
+    node = sc.io.Output(col, [out])
+    return cloudpickle.dumps({
+        "outputs": [node],
+        "perf": PerfParams.manual(2, 2, **perf_kw),
+        "cache_mode": CacheMode.Overwrite.value})
+
+
+def _finish_tasks(master, bulk_id, wid, n):
+    """Drive n assign->finish cycles through the real handlers."""
+    done = []
+    for _ in range(n):
+        r = master._rpc_next_work({"worker_id": wid, "bulk_id": bulk_id})
+        assert r["status"] == "task", r
+        ok = master._rpc_finished_work({
+            "worker_id": wid, "bulk_id": bulk_id,
+            "job_idx": r["job_idx"], "task_idx": r["task_idx"],
+            "attempt": r["attempt"]})
+        assert ok["ok"]
+        done.append((r["job_idx"], r["task_idx"]))
+    return done
+
+
+def test_newjob_token_dedupe(tmp_path):
+    sc, db_path = _seed_db(tmp_path)
+    master = Master(db_path=db_path, no_workers_timeout=60.0)
+    try:
+        base = _counter("scanner_tpu_admission_dedup_total")
+        spec = _spec_blob(sc, "fo_dedupe")
+        r1 = master._rpc_new_job({"spec": spec, "token": "tok-A"})
+        assert "bulk_id" in r1 and not r1.get("dedup")
+        # the ambiguous-timeout retry: same token -> same bulk, no
+        # "already active" error, no second admission
+        r2 = master._rpc_new_job({"spec": spec, "token": "tok-A"})
+        assert r2 == {"bulk_id": r1["bulk_id"], "dedup": True}
+        assert _counter("scanner_tpu_admission_dedup_total") == base + 1
+        # a DIFFERENT token while the bulk is active is a real second
+        # job: rejected as before
+        r3 = master._rpc_new_job({"spec": spec, "token": "tok-B"})
+        assert "error" in r3 and not r3.get("dedup")
+    finally:
+        master.stop()
+        sc.stop()
+
+
+def test_recovery_via_journal_only(tmp_path):
+    """checkpoint_frequency=0: the progress snapshot is never written —
+    with the journal, a successor still restores every acknowledged
+    completion (the pre-journal code lost ALL of them here)."""
+    sc, db_path = _seed_db(tmp_path)
+    m1 = Master(db_path=db_path, no_workers_timeout=60.0)
+    spec = _spec_blob(sc, "fo_jr")
+    bid = m1._rpc_new_job({"spec": spec, "token": "tok-R"})["bulk_id"]
+    wid = m1._rpc_register_worker({"address": ""})["worker_id"]
+    done = _finish_tasks(m1, bid, wid, 3)
+    m1.stop()  # no checkpoint clear: the bulk is still active
+
+    replayed0 = _counter("scanner_tpu_journal_replayed_records_total")
+    m2 = Master(db_path=db_path, no_workers_timeout=60.0)
+    try:
+        assert m2.generation > m1.generation
+        with m2._lock:
+            bulk = m2._bulk
+            assert bulk is not None and bulk.bulk_id == bid
+            assert set(done) <= bulk.done, \
+                "journaled completions lost on recovery"
+            assert len(bulk.done) == len(done)
+        assert _counter("scanner_tpu_journal_replayed_records_total") \
+            > replayed0
+        # the admission token rode the journal/checkpoint: a retried
+        # NewJob against the SUCCESSOR dedupes to the recovered bulk
+        base = _counter("scanner_tpu_admission_dedup_total")
+        r = m2._rpc_new_job({"spec": spec, "token": "tok-R"})
+        assert r == {"bulk_id": bid, "dedup": True}
+        assert _counter("scanner_tpu_admission_dedup_total") == base + 1
+        # the predecessor's generation directory was dropped after the
+        # state migrated under m2's generation
+        assert not m2.db.backend.exists(
+            smd.bulk_checkpoint_path(m1.generation))
+        assert m2.db.backend.exists(
+            smd.bulk_checkpoint_path(m2.generation))
+    finally:
+        m2.stop()
+        sc.stop()
+
+
+def test_corrupt_checkpoint_falls_back_to_journal(tmp_path, caplog):
+    """Satellite: an unreadable checkpoint no longer silently drops the
+    bulk — admission state comes from the journaled admit record, at
+    ERROR."""
+    import logging
+
+    sc, db_path = _seed_db(tmp_path)
+    m1 = Master(db_path=db_path, no_workers_timeout=60.0)
+    spec = _spec_blob(sc, "fo_ck")
+    bid = m1._rpc_new_job({"spec": spec, "token": "tok-C"})["bulk_id"]
+    wid = m1._rpc_register_worker({"address": ""})["worker_id"]
+    done = _finish_tasks(m1, bid, wid, 2)
+    m1.stop()
+    # rot the sealed checkpoint payload in place
+    ck = smd.bulk_checkpoint_path(m1.generation)
+    backend = PosixStorage(db_path)
+    blob = bytearray(backend.read(ck))
+    blob[-3] ^= 0xFF
+    backend.write(ck, bytes(blob))
+
+    with caplog.at_level(logging.ERROR):
+        m2 = Master(db_path=db_path, no_workers_timeout=60.0)
+    try:
+        assert "falling back to journal replay" in caplog.text
+        with m2._lock:
+            bulk = m2._bulk
+            assert bulk is not None and bulk.bulk_id == bid, \
+                "corrupt checkpoint dropped the bulk"
+            assert set(done) <= bulk.done
+    finally:
+        m2.stop()
+        sc.stop()
+
+
+def test_superseded_master_fences_itself(tmp_path):
+    _sc, db_path = _seed_db(tmp_path)
+    _sc.stop()
+    m1 = Master(db_path=db_path, no_workers_timeout=60.0)
+    m2 = Master(db_path=db_path, no_workers_timeout=60.0)
+    try:
+        assert m2.generation == m1.generation + 1
+        assert not m2._fence.is_set()
+        # m1 discovers the newer claim on its next fence poll
+        assert m1._check_fence() is True
+        base = _counter("scanner_tpu_stale_master_rejections_total",
+                        side="master")
+        wrapped = m1._fenced(m1._rpc_new_job)
+        reply = wrapped({"spec": b"ignored", "token": "t"})
+        assert reply.get("fenced") and "error" in reply
+        assert reply["generation"] == m1.generation
+        assert _counter("scanner_tpu_stale_master_rejections_total",
+                        side="master") == base + 1
+        # the live master's fenced wrapper stamps its generation on
+        # ordinary replies (what workers latch)
+        live = m2._fenced(lambda req: {"ok": True})
+        assert live({})["generation"] == m2.generation
+    finally:
+        m1.stop()
+        m2.stop()
+
+
+def test_worker_nacks_stale_assignment(tmp_path):
+    """A worker that has latched generation G refuses assignments (and
+    ignores revocation verdicts) stamped with anything older."""
+    _sc, db_path = _seed_db(tmp_path)
+    _sc.stop()
+    master = Master(db_path=db_path, no_workers_timeout=60.0)
+    addr = f"localhost:{master.port}"
+    worker = Worker(addr, db_path=db_path)
+    try:
+        gen = master.generation
+        orig = worker.master.try_call
+
+        def fake(method, timeout=None, retries=None, **kw):
+            if method == "Heartbeat":
+                # the successor's view: a NEWER generation
+                return {"reregister": False, "active_bulk": 7,
+                        "generation": gen + 1}
+            if method == "NextWork":
+                # ...but the stale master still answers assignments
+                return {"status": "task", "job_idx": 0, "task_idx": 0,
+                        "attempt": 0, "generation": gen}
+            if method == "StartedWork":
+                # a stale master's revocation verdict
+                return {"ok": False, "revoked": True,
+                        "generation": gen}
+            return orig(method, timeout=timeout, retries=retries, **kw)
+
+        worker.master.try_call = fake
+        # let the heartbeat latch the newer generation
+        deadline = time.time() + 10
+        while time.time() < deadline \
+                and worker._gen.highest() <= gen:
+            time.sleep(0.05)
+        assert worker._gen.highest() == gen + 1
+        base = _counter("scanner_tpu_stale_master_rejections_total",
+                        side="worker")
+        worker._hb_reply = {"active_bulk": 7, "generation": gen + 1}
+        assert worker._pull_next(7) == "wait", \
+            "stale-generation assignment was accepted"
+        assert _counter("scanner_tpu_stale_master_rejections_total",
+                        side="worker") > base
+    finally:
+        worker.master.try_call = orig
+        worker.stop()
+        master.stop()
+
+
+def test_duplicate_delivery_fault_mode():
+    """The rpc.client.call duplicate mode delivers the request twice;
+    method=/peer= selectors scope it."""
+    from scanner_tpu.engine.rpc import RpcClient, RpcServer
+
+    calls = []
+    srv = RpcServer("FoTest", {"Echo": lambda req: (
+        calls.append(req.get("v")) or {"v": req["v"]})})
+    srv.start()
+    client = RpcClient(f"localhost:{srv.port}", "FoTest", timeout=5.0)
+    try:
+        faults.install(
+            "rpc.client.call:duplicate:method=Echo:n=1:times=1")
+        assert client.call("Echo", v=7)["v"] == 7
+        assert calls == [7, 7], "duplicate delivery did not happen"
+        assert faults.fired("rpc.client.call") == 1
+        assert _counter("scanner_tpu_faults_injected_total",
+                        site="rpc.client.call", mode="duplicate") >= 1
+        faults.clear()
+        # peer selector: a non-matching peer never fires
+        faults.install("rpc.client.call:duplicate:method=Echo:"
+                       "peer=nonexistent-host:n=1")
+        calls.clear()
+        assert client.call("Echo", v=9)["v"] == 9
+        assert calls == [9]
+        assert faults.fired("rpc.client.call") == 0
+    finally:
+        client.close()
+        srv.stop()
+
+
+def test_failover_plan_parses():
+    rules = faults.parse_plan(faults.NAMED_PLANS["master-failover"])
+    assert {r.mode for r in rules} == {"crash", "duplicate"}
+    # duplicate mode is rejected on sites that never ask for it
+    with pytest.raises(faults.FaultPlanError):
+        faults.parse_plan("storage.write:duplicate")
+    # method=/peer= selectors are rejected on sites whose detail
+    # carries no "<method>@<peer>" (they would parse and never fire)
+    with pytest.raises(faults.FaultPlanError):
+        faults.parse_plan("storage.read:raise:peer=otherhost")
+    with pytest.raises(faults.FaultPlanError):
+        faults.parse_plan("pipeline.eval:raise:method=NewJob")
+
+
+# ---------------------------------------------------------------------------
+# the spawned failover drill (slow)
+# ---------------------------------------------------------------------------
+
+def _spawn_env(extra=None):
+    from scanner_tpu.util.jaxenv import cpu_only_env
+    env = cpu_only_env()
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("SCANNER_TPU_FAULTS", None)
+    env.pop("SCANNER_TPU_MASTER_GENERATION", None)
+    env.update(extra or {})
+    return env
+
+
+@pytest.mark.slow
+def test_failover_drill_spawned(tmp_path):
+    """The headline drill: SIGKILL-grade master death mid-bulk under
+    load (injected crash in FinishedWork, checkpoint_frequency=0 so the
+    journal is the ONLY durability), the client's NewJob delivered
+    twice, and — after the successor recovers — a forced-stale master
+    still alive.  Zero journaled completions re-execute, the duplicate
+    admission dedupes, the stale master accepts nothing, the output is
+    bit-exact, zero blacklist strikes."""
+    import socket
+
+    db_path = str(tmp_path / "db")
+    log = str(tmp_path / "rows.log")
+    seed = Client(db_path=db_path)
+    seed.new_table("fo_src", ["output"],
+                   [[_pk(100 + i)] for i in range(N_ROWS)])
+    seed.stop()
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    addr = f"localhost:{port}"
+    spawn = os.path.join(os.path.dirname(__file__), "spawn_master.py")
+
+    def spawn_master(extra=None):
+        return subprocess.Popen(
+            [sys.executable, spawn, db_path, str(port)],
+            env=_spawn_env(extra),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    # master dies handling the 4th FinishedWork: 3 completions are
+    # acknowledged (and therefore journaled), the 4th crashed
+    # mid-handler and legitimately re-runs
+    m1 = spawn_master(
+        extra={"SCANNER_TPU_FAULTS":
+               "rpc.server.handle:crash:match=FinishedWork:n=4"})
+    state = {}
+    backend = PosixStorage(db_path)
+
+    def respawner():
+        state["rc1"] = m1.wait(timeout=120)
+        # the journal on disk at the moment of death = exactly the
+        # acknowledged completions (checkpoint_frequency=0: there is
+        # NO progress snapshot to lean on)
+        recs, _stats = journal.replay(backend, 1)
+        state["journaled_done"] = {
+            (r["j"], r["k"]) for r in recs if r.get("t") == "done"}
+        state["rows_at_crash"] = open(log).read().splitlines()
+        time.sleep(0.5)
+        state["m2"] = spawn_master()
+
+    worker = None
+    sc = None
+    stale = None
+    try:
+        sc = Client(db_path=db_path, master=addr)
+        worker = Worker(addr, db_path=db_path)
+        rt = threading.Thread(target=respawner)
+        rt.start()
+        # the client's FIRST NewJob is delivered twice (reply of the
+        # first delivery dropped): the admission token must dedupe
+        faults.install(
+            "rpc.client.call:duplicate:method=NewJob:n=1:times=1")
+        col = sc.io.Input([NamedStream(sc, "fo_src")])
+        col = sc.ops.FailoverRowLog(x=col, log_path=log)
+        out = NamedStream(sc, "fo_drill_out")
+        sc.run(sc.io.Output(col, [out]),
+               PerfParams.manual(2, 2, checkpoint_frequency=0),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+        dup_fired = faults.fired("rpc.client.call")
+        faults.clear()
+        rt.join(timeout=60)
+        assert not rt.is_alive(), "master never crashed/respawned"
+        assert state["rc1"] == faults.CRASH_EXIT_CODE
+        assert dup_fired == 1, "duplicate NewJob never fired"
+        assert state["journaled_done"], \
+            "no completions journaled before the crash"
+
+        # output bit-exact despite the kill + duplicate admission
+        assert [bytes(r) for r in out.load()] == EXPECT
+        assert out.committed()
+
+        # ZERO journaled completions re-executed: rows of tasks whose
+        # done record reached the journal ran exactly once
+        counts = {}
+        for line in open(log).read().splitlines():
+            counts[int(line)] = counts.get(int(line), 0) + 1
+        for (_j, t) in state["journaled_done"]:
+            for row in (100 + 2 * t, 100 + 2 * t + 1):
+                assert counts.get(row, 0) == 1, \
+                    f"row {row} of journaled task {t} ran " \
+                    f"{counts.get(row, 0)} times"
+        assert all(counts.get(100 + i, 0) >= 1 for i in range(N_ROWS))
+
+        # the successor replayed the journal, and zero strikes were
+        # counted anywhere in the cluster
+        snap = sc.metrics()
+
+        def _tot(name):
+            return sum(s.get("value", 0) for s in
+                       snap.get(name, {}).get("samples", []))
+
+        assert _tot("scanner_tpu_journal_replayed_records_total") > 0
+        assert _tot("scanner_tpu_blacklist_strikes_total") == 0
+
+        # a retried NewJob with the original token dedupes on the
+        # SUCCESSOR (tokens rode the journal/checkpoint across death)
+        token = sc._cluster.last_admission_token
+        r = sc._cluster.master.call("NewJob", spec=b"", token=token)
+        assert r.get("dedup") and r.get("bulk_id") is not None
+
+        # the stale-master leg: a forced-generation-1 master comes up
+        # while the gen-2 successor serves.  It must fence at startup
+        # and accept zero mutations.
+        with socket.socket() as s2:
+            s2.bind(("localhost", 0))
+            port2 = s2.getsockname()[1]
+        stale = subprocess.Popen(
+            [sys.executable, spawn, db_path, str(port2)],
+            env=_spawn_env({"SCANNER_TPU_MASTER_GENERATION": "1"}),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        from scanner_tpu.engine.rpc import RpcClient, wait_for_server
+        wait_for_server(f"localhost:{port2}", MASTER_SERVICE,
+                        timeout=60.0)
+        probe = RpcClient(f"localhost:{port2}", MASTER_SERVICE,
+                          timeout=10.0)
+        try:
+            for method, payload in (
+                    ("NewJob", {"spec": b"", "token": "t"}),
+                    ("FinishedWork", {"worker_id": 0, "bulk_id": 0,
+                                      "job_idx": 0, "task_idx": 0,
+                                      "attempt": 0}),
+                    ("NextWork", {"worker_id": 0, "bulk_id": 0})):
+                reply = probe.call(method, **payload)
+                assert reply.get("fenced"), \
+                    f"stale master accepted {method}: {reply}"
+        finally:
+            probe.close()
+    finally:
+        faults.clear()
+        if worker is not None:
+            worker.stop()
+        if sc is not None:
+            sc.stop()
+        for p in (m1, state.get("m2"), stale):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait()

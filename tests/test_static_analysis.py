@@ -780,6 +780,108 @@ def test_remediation_config_schema_both_directions(tmp_path):
     assert not any("enabled" in m for m in msgs)
 
 
+def _fence_repo(tmp_path, wrap_mut=True, wrap_read=False,
+                schema_keys=("journal_enabled",
+                             "journal_rotate_records"),
+                cfg_keys=("journal_enabled", "journal_rotate_records")):
+    """Synthetic mini-repo for the SC312 generation-fence lints."""
+    _write(tmp_path, "setup.py", "# root marker\n")
+    mut = "self._fenced(self._rpc_mut)" if wrap_mut else "self._rpc_mut"
+    read = "self._fenced(self._rpc_read)" if wrap_read \
+        else "self._rpc_read"
+    _write(tmp_path, "pkg/svc.py", f"""
+        MASTER_SERVICE = "svc.Master"
+        WORKER_SERVICE = "svc.Worker"
+
+        RPC_CONTRACTS = {{
+            "Mut": {{"timeout_s": 1.0, "idempotent": False}},
+            "Read": {{"timeout_s": 1.0, "idempotent": True}},
+        }}
+
+        class RpcServer:
+            def __init__(self, name, methods, port=0):
+                pass
+
+        class Master:
+            def __init__(self):
+                self._server = RpcServer(MASTER_SERVICE, {{
+                    "Mut": {mut},
+                    "Read": {read},
+                }})
+
+            def _fenced(self, fn):
+                return fn
+
+            def _rpc_mut(self, req):
+                return {{}}
+
+            def _rpc_read(self, req):
+                return {{}}
+
+        class Worker:
+            def __init__(self):
+                # worker-service registrations are outside SC312's
+                # scope even when unwrapped
+                self._server = RpcServer(WORKER_SERVICE, {{
+                    "Read": lambda req: {{}},
+                }})
+
+        def client(c):
+            c.call("Mut")
+            c.call("Read")
+    """)
+    schema = ", ".join(f'"{k}"' for k in schema_keys)
+    _write(tmp_path, "pkg/engine/journal.py",
+           f"CONFIG_KEYS = ({schema},)\n")
+    cfg = ", ".join(f'"{k}": 1' for k in cfg_keys)
+    _write(tmp_path, "pkg/config.py", f"""
+        def default_config():
+            return {{"robustness": {{{cfg}}}}}
+    """)
+    _write(tmp_path, "docs/guide.md", """
+        The keys `journal_enabled`, `journal_rotate_records`,
+        `journal_extra` and `journal_ghost` are documented so SC304
+        stays quiet in this fixture.
+    """)
+    return tmp_path
+
+
+def test_fence_clean_fixture_is_quiet(tmp_path):
+    _fence_repo(tmp_path)
+    _, findings = _analyze(tmp_path, "pkg")
+    assert [f for f in findings if f.code == "SC312"] == []
+
+
+def test_fence_unwrapped_mutating_handler_flagged(tmp_path):
+    _fence_repo(tmp_path, wrap_mut=False)
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC312"]
+    assert any("`Mut`" in m and "without the generation-fence" in m
+               for m in msgs)
+    assert not any("`Read`" in m for m in msgs)
+
+
+def test_fence_wrapped_idempotent_handler_flagged(tmp_path):
+    _fence_repo(tmp_path, wrap_read=True)
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC312"]
+    assert any("`Read`" in m and "idempotent=False" in m for m in msgs)
+    assert not any("`Mut`" in m for m in msgs)
+
+
+def test_fence_journal_config_keys_both_directions(tmp_path):
+    _fence_repo(tmp_path,
+                schema_keys=("journal_enabled", "journal_ghost"),
+                cfg_keys=("journal_enabled", "journal_extra"))
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC312"]
+    assert any("journal_extra" in m and "does not accept" in m
+               for m in msgs)
+    assert any("journal_ghost" in m and "declares no" in m
+               for m in msgs)
+    assert not any("journal_enabled" in m for m in msgs)
+
+
 def test_contract_rpc_contracts_table_both_directions(tmp_path):
     _write(tmp_path, "setup.py", "# root\n")
     _write(tmp_path, "pkg/rpcmod.py", """

@@ -96,7 +96,7 @@ def detail_digest(bench_dir):
         return {}
     out = {"fps_by_config": {}, "task_latency": {}, "health": {},
            "op_efficiency": {}, "frame_cache": {}, "remediation": {},
-           "baseline_metrics": {}}
+           "failover": {}, "baseline_metrics": {}}
     for d in detail:
         if not isinstance(d, dict):
             continue
@@ -117,6 +117,9 @@ def detail_digest(bench_dir):
         elif d.get("config") == "remediation":
             out["remediation"] = {k: v for k, v in d.items()
                                   if k != "config"}
+        elif d.get("config") == "failover":
+            out["failover"] = {k: v for k, v in d.items()
+                               if k != "config"}
         elif d.get("config") == "baseline_metrics":
             out["baseline_metrics"] = d.get("metrics") or {}
     return out
@@ -289,6 +292,14 @@ def main(argv=None) -> int:
                   f"{int(rem.get('preemptions') or 0)} preemption(s), "
                   f"strikes {int(rem.get('strike_delta') or 0)}, "
                   f"{int(n_applied)} action(s) applied")
+        fo = detail.get("failover") or {}
+        if fo.get("rows_ok"):
+            print(f"  failover: recovery "
+                  f"{fo.get('failover_recovery_s')}s, "
+                  f"{int(fo.get('tasks_lost_on_recovery') or 0)} "
+                  f"task(s) lost, "
+                  f"{int(fo.get('journal_replayed') or 0)} journal "
+                  f"record(s) replayed")
         if base_metrics:
             print("  baselines: " + "  ".join(
                 f"{k}={v.get('value')}" for k, v in

@@ -129,10 +129,11 @@ def main() -> int:
     if mem_plan:
         env["SCANNER_TPU_KERNEL_DEVICES"] = "all"
 
-    def spawn(script, argv, plan=None):
+    def spawn(script, argv, plan=None, env_extra=None):
         e = dict(env)
         if plan:
             e["SCANNER_TPU_FAULTS"] = plan
+        e.update(env_extra or {})
         return subprocess.Popen([sys.executable,
                                  os.path.join(REPO, "tests", script),
                                  *argv], env=e)
@@ -191,6 +192,11 @@ def main() -> int:
                cache_mode=CacheMode.Overwrite, show_progress=True)
         return [bytes(r) for r in out.load()]
 
+    # the master-failover drill leans on the write-ahead journal as
+    # the ONLY durability (checkpoint_frequency=0) and adds a
+    # stale-master fencing probe after the runs
+    failover = args.plan == "master-failover"
+
     rc = 1
     try:
         # faulted run FIRST: worker/master-side plans armed via env are
@@ -202,7 +208,7 @@ def main() -> int:
             faults.install(spec)
         print("== faulted run ==")
         got = run("chaos_faulted", task_timeout=args.task_timeout,
-                  checkpoint_frequency=1)
+                  checkpoint_frequency=0 if failover else 1)
         # read the rule counters BEFORE clear() empties the registry —
         # client-side fires exist nowhere else (sc.metrics() aggregates
         # master+workers, not this process)
@@ -236,7 +242,45 @@ def main() -> int:
         fired = bool(local_fired or cluster_fired or crashed
                      or preempt_notices
                      or respawned.get("rc") == faults.CRASH_EXIT_CODE)
-        rc = 0 if (exact and fired) else 1
+        extra_ok = True
+        if failover:
+            # failover-specific evidence: the successor replayed the
+            # journal, zero blacklist strikes anywhere, and a
+            # forced-stale (generation-1) master is fenced with zero
+            # accepted mutations
+            def _tot(name):
+                return sum(s.get("value", 0) for s in
+                           snap.get(name, {}).get("samples", []))
+
+            replayed = _tot("scanner_tpu_journal_replayed_records_total")
+            strikes = _tot("scanner_tpu_blacklist_strikes_total")
+            with socket.socket() as s2:
+                s2.bind(("localhost", 0))
+                port2 = s2.getsockname()[1]
+            stale = spawn("spawn_master.py", [db_path, str(port2)],
+                          env_extra={"SCANNER_TPU_MASTER_GENERATION":
+                                     "1"})
+            procs.append(stale)
+            from scanner_tpu.engine.rpc import RpcClient
+            wait_for_server(f"localhost:{port2}", MASTER_SERVICE,
+                            timeout=60.0)
+            probe = RpcClient(f"localhost:{port2}", MASTER_SERVICE,
+                              timeout=10.0)
+            try:
+                fenced = all(
+                    probe.call(m, **p).get("fenced")
+                    for m, p in (
+                        ("NewJob", {"spec": b"", "token": "t"}),
+                        ("NextWork", {"worker_id": 0, "bulk_id": 0}),
+                        ("FinishedWork", {"worker_id": 0, "bulk_id": 0,
+                                          "job_idx": 0, "task_idx": 0,
+                                          "attempt": 0})))
+            finally:
+                probe.close()
+            print(f"failover: journal-replayed={int(replayed)} "
+                  f"strikes={int(strikes)} stale-master-fenced={fenced}")
+            extra_ok = bool(replayed > 0 and strikes == 0 and fenced)
+        rc = 0 if (exact and fired and extra_ok) else 1
         if not fired:
             print("WARNING: no evidence the fault fired — plan matched "
                   "nothing?")
