@@ -727,6 +727,141 @@ def main():
             _fo_d = {"config": "failover",
                      "error": f"{type(e).__name__}: {e}"}
         detail.append(_fo_d)
+
+        # gang digest (engine/gang.py): a bounded live gang drill —
+        # in-process 2-worker cluster running a gang_hosts=2 bulk, one
+        # worker killed abruptly after the first gang formed — banking
+        # formation seconds (submit -> first gang formed), reform
+        # seconds after the injected host loss (kill -> next
+        # formation, which includes the stale-scan detection window),
+        # and epochs minted per bulk, so tools/bench_history.py gates
+        # the gang-scheduling trajectory (`gang_reform_s`,
+        # better=lower) like any other metric
+        def _gang_digest() -> dict:
+            import struct as _struct
+            import threading as _threading
+
+            from scanner_tpu import Kernel, register_op
+            from scanner_tpu.engine import gang as _egang
+            from scanner_tpu.engine.service import Master, Worker
+
+            def _pk(v: int) -> bytes:
+                return _struct.pack("<q", v)
+
+            def _tot(name: str) -> float:
+                s = registry().snapshot().get(name, {})
+                return sum(x["value"] for x in s.get("samples", []))
+
+            @register_op(name="BenchGangSleep")
+            class BenchGangSleep(Kernel):
+                def execute(self, x: bytes) -> bytes:
+                    time.sleep(0.05)
+                    return _pk(2 * _struct.unpack("<q", x)[0])
+
+            gdb = os.path.join(root, "gang_db")
+            n_rows = 16
+            seedg = Client(db_path=gdb)
+            seedg.new_table("gang_src", ["output"],
+                            [[_pk(100 + i)] for i in range(n_rows)])
+            m = Master(db_path=gdb, no_workers_timeout=60.0)
+            addr = f"localhost:{m.port}"
+            old_form = _egang.form_timeout_s()
+            _egang.set_form_timeout_s(4.0)
+            workers = [Worker(addr, db_path=gdb) for _ in range(2)]
+            gc2 = Client(db_path=gdb, master=addr)
+            result: dict = {}
+            formed0 = _tot("scanner_tpu_gang_formed_total")
+            aborted0 = _tot("scanner_tpu_gang_aborted_total")
+
+            def _job() -> None:
+                try:
+                    col = gc2.io.Input([NamedStream(gc2, "gang_src")])
+                    col = gc2.ops.BenchGangSleep(x=col)
+                    out = NamedStream(gc2, "gang_out")
+                    gc2.run(gc2.io.Output(col, [out]),
+                            PerfParams.manual(4, 4, gang_hosts=2),
+                            cache_mode=CacheMode.Overwrite,
+                            show_progress=False)
+                    result["rows"] = len(list(out.load()))
+                except Exception as e:  # noqa: BLE001
+                    result["error"] = f"{type(e).__name__}: {e}"
+
+            try:
+                submit = time.time()
+                jt = _threading.Thread(target=_job, daemon=True)
+                jt.start()
+                formation_s = None
+                victim = workers[1].worker_id
+                deadline = time.time() + 90
+                # wait until the victim is a member of a LIVE gang —
+                # killing between a gang's completion and the next
+                # formation would produce no abort and a null metric
+                while time.time() < deadline:
+                    if formation_s is None and _tot(
+                            "scanner_tpu_gang_formed_total") > formed0:
+                        formation_s = round(time.time() - submit, 3)
+                    with m._lock:
+                        b = m._bulk
+                        live = b is not None and any(
+                            victim in g.members
+                            for g in b.gangs.values())
+                    if formation_s is not None and live:
+                        break
+                    time.sleep(0.02)
+                # injected host loss mid-gang: the victim stops AND the
+                # master applies the loss immediately (the same path
+                # the stale scan takes after its 6 s detection window —
+                # excluded here so gang_reform_s measures the engine's
+                # abort -> re-form work, not the detection constant)
+                kill_at = time.time()
+                workers[1].stop()
+                _recs: list = []
+                with m._lock:
+                    w = m._workers.get(victim)
+                    if w is not None:
+                        w.active = False
+                    m._requeue_worker_tasks(victim, recs=_recs)
+                m._journal_append(_recs)
+                reform_s = None
+                formed_at_kill = _tot("scanner_tpu_gang_formed_total")
+                deadline = time.time() + 90
+                while time.time() < deadline:
+                    if _tot("scanner_tpu_gang_aborted_total") \
+                            > aborted0 \
+                            and _tot("scanner_tpu_gang_formed_total") \
+                            > formed_at_kill:
+                        reform_s = round(time.time() - kill_at, 3)
+                        break
+                    time.sleep(0.02)
+                jt.join(timeout=180)
+                return {
+                    "config": "gang",
+                    "rows_ok": result.get("rows") == n_rows,
+                    "error": result.get("error"),
+                    "gang_formation_s": formation_s,
+                    "gang_reform_s": reform_s,
+                    "gangs_formed": _tot(
+                        "scanner_tpu_gang_formed_total") - formed0,
+                    "gangs_aborted": _tot(
+                        "scanner_tpu_gang_aborted_total") - aborted0,
+                    "epochs": _tot("scanner_tpu_gang_epoch"),
+                    "stale_nacks": _tot(
+                        "scanner_tpu_gang_stale_nacks_total"),
+                }
+            finally:
+                _egang.set_form_timeout_s(old_form)
+                gc2.stop()
+                for w in workers:
+                    w.stop()
+                m.stop()
+
+        try:
+            _gang_d = _gang_digest()
+        except Exception as e:  # noqa: BLE001 — bench must not die on
+            # the gang drill
+            _gang_d = {"config": "gang",
+                       "error": f"{type(e).__name__}: {e}"}
+        detail.append(_gang_d)
         # stable per-direction baseline keys (ROADMAP "bank per-item
         # baselines for the new directions"): one flat entry with a
         # declared better= direction per metric, so
@@ -771,6 +906,9 @@ def main():
                     "better": "lower"},
                 "tasks_lost_on_recovery": {
                     "value": _fo_d.get("tasks_lost_on_recovery"),
+                    "better": "lower"},
+                "gang_reform_s": {
+                    "value": _gang_d.get("gang_reform_s"),
                     "better": "lower"},
             },
         })

@@ -60,6 +60,17 @@ passes make each one checkable:
          `[robustness]` journal_* config keys config.default_config()
          declares must be exactly journal.CONFIG_KEYS (both
          directions)
+  SC313  gang contract drift (engine/service.py + engine/gang.py,
+         extending SC312): every `Gang*` RPC_CONTRACTS entry must be
+         classified `idempotent=False` AND register its master handler
+         through the generation fence, in both directions — gang RPCs
+         mutate scheduling state and additionally carry the
+         (gang_id, epoch) fence, so an unfenced or misclassified gang
+         handler would let a stale master (or a blind retry)
+         double-apply completion/abort traffic; and the `[gang]`
+         config keys config.default_config() declares, the
+         gang.CONFIG_KEYS tuple, and the `[gang] <key>` rows in
+         docs/guide.md may not drift (all pairings, both directions)
 """
 
 from __future__ import annotations
@@ -344,6 +355,10 @@ class ContractPass(AnalysisPass):
         "SC312": "generation-fence routing drift (idempotent=False "
                  "RPC_CONTRACTS entries vs _fenced-wrapped master "
                  "handlers vs [robustness] journal config keys)",
+        "SC313": "gang contract drift (Gang* RPC_CONTRACTS entries "
+                 "must be non-idempotent + fence-wrapped; [gang] "
+                 "config keys vs gang.CONFIG_KEYS vs docs/guide.md "
+                 "rows)",
     }
 
     def run(self, project: Project) -> List[Finding]:
@@ -358,6 +373,7 @@ class ContractPass(AnalysisPass):
         out.extend(self._frame_cache(project))
         out.extend(self._remediation(project))
         out.extend(self._fence_routing(project))
+        out.extend(self._gang_contract(project))
         return out
 
     # -- SC301 / SC302 ---------------------------------------------------
@@ -1118,6 +1134,110 @@ class ContractPass(AnalysisPass):
                         f"journal.CONFIG_KEYS accepts `{k}` but "
                         "config.default_config() declares no "
                         f"`[robustness] {k}`", jmod.tree))
+        return out
+
+    # -- SC313 -----------------------------------------------------------
+
+    _GANG_DOC_KEY_RE = re.compile(r"`\[gang\]\s+([a-z0-9_]+)`")
+
+    def _gang_contract(self, project: Project) -> List[Finding]:
+        """Gang contract lints: the Gang* RPC surface's fencing shape
+        (specializing SC312 — a gang RPC must be BOTH classified
+        non-idempotent and fence-wrapped, whichever side drifted), and
+        the three-way [gang] config pairing (default_config ↔
+        gang.CONFIG_KEYS ↔ docs/guide.md rows)."""
+        out: List[Finding] = []
+        cmod: Optional[ModuleInfo] = None
+        contracts: Optional[Dict[str, object]] = None
+        for mod in project.modules:
+            got = self._contract_idempotency(mod)
+            if got is not None:
+                cmod, contracts = mod, got
+                break
+        if cmod is not None and contracts is not None:
+            registered = self._master_registrations(cmod)
+            gang_entries = sorted(n for n in contracts
+                                  if n.startswith("Gang"))
+            for name in gang_entries:
+                if contracts.get(name) is not False:
+                    out.append(cmod.finding(
+                        "SC313",
+                        f"gang RPC `{name}` is not classified "
+                        "idempotent=False in RPC_CONTRACTS — gang "
+                        "RPCs mutate scheduling state behind the "
+                        "(gang_id, epoch) fence and must never ride "
+                        "the blind-retry path", cmod.tree))
+                if registered and name not in registered:
+                    out.append(cmod.finding(
+                        "SC313",
+                        f"gang RPC `{name}` has an RPC_CONTRACTS "
+                        "entry but no MASTER_SERVICE handler "
+                        "registration", cmod.tree))
+                elif registered and not registered[name][0]:
+                    out.append(cmod.finding(
+                        "SC313",
+                        f"gang RPC `{name}`'s master handler is "
+                        "registered without the generation-fence "
+                        "wrapper (`self._fenced(...)`) — a superseded "
+                        "master could keep accepting gang mutations",
+                        registered[name][1]))
+            if registered:
+                for name, (_wrapped, node) in sorted(
+                        registered.items()):
+                    if name.startswith("Gang") \
+                            and name not in contracts:
+                        out.append(cmod.finding(
+                            "SC313",
+                            f"master registers gang handler `{name}` "
+                            "with no RPC_CONTRACTS entry — the gang "
+                            "surface must be classified", node))
+        # [gang] config keys <-> gang.CONFIG_KEYS <-> docs/guide.md
+        # rows, all pairings both directions (the SC312 journal
+        # pattern plus the doc leg)
+        gmod = project.module("engine/gang.py")
+        schema = _module_tuple(gmod, "CONFIG_KEYS") \
+            if gmod is not None else None
+        cfg_mod = None
+        for m in project.modules:
+            if m.relpath.endswith("config.py") \
+                    and _default_config_keys(m):
+                cfg_mod = m
+                break
+        if gmod is not None and schema is not None \
+                and cfg_mod is not None:
+            declared = {k for sec, k in _default_config_keys(cfg_mod)
+                        if sec == "gang"}
+            if declared or schema:
+                for k in sorted(declared - set(schema)):
+                    out.append(cfg_mod.finding(
+                        "SC313",
+                        f"config key `[gang] {k}` is declared but "
+                        "gang.CONFIG_KEYS does not accept it",
+                        cfg_mod.tree))
+                for k in sorted(set(schema) - declared):
+                    out.append(gmod.finding(
+                        "SC313",
+                        f"gang.CONFIG_KEYS accepts `{k}` but "
+                        "config.default_config() declares no "
+                        f"`[gang] {k}`", gmod.tree))
+                doc = _read_doc(project, "guide.md")
+                if doc:
+                    doc_keys = set(self._GANG_DOC_KEY_RE.findall(doc))
+                    for k in sorted(set(schema) - doc_keys):
+                        out.append(gmod.finding(
+                            "SC313",
+                            f"gang.CONFIG_KEYS accepts `{k}` but "
+                            "docs/guide.md has no `[gang] "
+                            f"{k}` row", gmod.tree))
+                    for k in sorted(doc_keys - set(schema)):
+                        out.append(Finding(
+                            code="SC313",
+                            message=f"docs/guide.md documents "
+                                    f"`[gang] {k}` but "
+                                    "gang.CONFIG_KEYS accepts no such "
+                                    "key",
+                            path="docs/guide.md", line=1, scope="",
+                            snippet=k))
         return out
 
     # -- SC306 / SC307 ---------------------------------------------------

@@ -128,6 +128,24 @@ class PerfParams:
     # cache + feeder threads, evaluate_worker.h:207-218).
     # SCANNER_TPU_STREAM_PACKETS=0 is the global kill switch.
     stream_work_packets: bool = True
+    # Gang-scheduled multi-host execution (engine/gang.py,
+    # docs/robustness.md §Gang scheduling): >0 asks the master to
+    # co-schedule each task onto a GANG of up to this many live
+    # workers instead of handing it to one puller — the members
+    # rendezvous into one jax.distributed runtime (member 0 is the
+    # coordinator), each evaluates the task REPLICATED (deterministic
+    # redundancy, not a sharded speedup — this knob buys failure
+    # semantics, N× the compute), stages its per-host shard of the
+    # result digest via host_local_array and agrees through one
+    # cross-host collective reduction, and commits through member 0
+    # alone (exactly-once sink).  Every gang RPC is fenced by
+    # (gang_id, gang_epoch): any member loss aborts the gang, bumps
+    # the epoch and re-forms on the remaining capacity, strike-free.
+    # Row-sharded gang evaluation over the global mesh is the planned
+    # follow-up on this substrate.  0 (default) = ordinary
+    # independent task pulls; local (in-process) runs treat any value
+    # as a single-host gang and execute normally.
+    gang_hosts: int = 0
 
     # reference-compat kwargs that are meaningless on TPU and accepted but
     # ignored (XLA owns device/host memory pooling; there is no CUDA pool

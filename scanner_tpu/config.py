@@ -123,6 +123,24 @@ def default_config() -> Dict[str, Any]:
             # overrides per process.
             "journal_rotate_records": 256,
         },
+        "gang": {
+            # gang-scheduled multi-host execution (engine/gang.py,
+            # docs/robustness.md §Gang scheduling): a bulk with
+            # PerfParams.gang_hosts > 0 co-schedules each task onto a
+            # gang of live workers that rendezvous into one
+            # jax.distributed runtime.  On by default (inert unless a
+            # bulk asks); SCANNER_TPU_GANG=0 overrides per process.
+            "enabled": True,
+            # bound on the jax.distributed rendezvous at gang start —
+            # a lost member must not pin the survivors in initialize
+            # forever; SCANNER_TPU_GANG_INIT_TIMEOUT overrides.
+            "init_timeout_s": 60,
+            # how long the master waits for a full gang_hosts pool
+            # before forming on whatever capacity HAS pooled (the
+            # loss-tolerant re-form path);
+            # SCANNER_TPU_GANG_FORM_TIMEOUT overrides.
+            "form_timeout_s": 5,
+        },
         "faults": {
             # deterministic fault-injection plan (docs/robustness.md for
             # the clause syntax; util/faults.py implements it).  "" (the
@@ -276,6 +294,27 @@ class Config:
         (SCANNER_TPU_JOURNAL_ROTATE overrides per process)."""
         return int(self.config.get("robustness", {}).get(
             "journal_rotate_records", 256))
+
+    @property
+    def gang_enabled(self) -> bool:
+        """Gang-scheduled multi-host execution (the deployment
+        default; SCANNER_TPU_GANG overrides per process)."""
+        return bool(self.config.get("gang", {}).get("enabled", True))
+
+    @property
+    def gang_init_timeout_s(self) -> float:
+        """Rendezvous bound for gang members
+        (SCANNER_TPU_GANG_INIT_TIMEOUT overrides per process)."""
+        return float(self.config.get("gang", {}).get("init_timeout_s",
+                                                     60))
+
+    @property
+    def gang_form_timeout_s(self) -> float:
+        """How long the master holds out for a full gang before
+        forming on the pooled capacity
+        (SCANNER_TPU_GANG_FORM_TIMEOUT overrides per process)."""
+        return float(self.config.get("gang", {}).get("form_timeout_s",
+                                                     5))
 
     @property
     def faults_plan(self) -> Optional[str]:

@@ -180,6 +180,16 @@ class ClusterConfig:
     remediation_dry_run: bool = False
     autoscale_min: int = 1
     autoscale_max: int = 8
+    # gang-scheduled multi-host execution (engine/gang.py, docs/
+    # robustness.md §Gang scheduling).  Workers advertise a gang
+    # coordinator port from their pod DNS name automatically (any pod
+    # port is reachable inside the cluster network — no containerPort
+    # row needed); these knobs wire the [gang] ConfigMap section +
+    # each worker's rendezvous bound.  Disable for fleets that never
+    # run gang bulks to skip the per-worker port reservation.
+    gang: bool = True
+    gang_init_timeout_s: int = 60
+    gang_form_timeout_s: int = 5
 
     def price_per_hour(self) -> float:
         return (self.master_cpus * CPU_PRICE_PER_CORE
@@ -309,6 +319,11 @@ def config_manifest(cfg: ClusterConfig) -> Dict:
         "dry_run": cfg.remediation_dry_run,
         "autoscale_min": cfg.autoscale_min,
         "autoscale_max": cfg.autoscale_max,
+    }
+    sections["gang"] = {
+        "enabled": cfg.gang,
+        "init_timeout_s": cfg.gang_init_timeout_s,
+        "form_timeout_s": cfg.gang_form_timeout_s,
     }
     toml = dump_toml(sections)
     return {
@@ -462,6 +477,14 @@ def _worker_statefulset(cfg: ClusterConfig, name: str, replicas: int,
                             *([{"name": "SCANNER_TPU_COMPILATION_CACHE",
                                 "value": cfg.compilation_cache_dir}]
                               if cfg.compilation_cache_dir else []),
+                            # gang member runners rendezvous with this
+                            # bound (engine/gang.py); 0 also strips the
+                            # gang port reservation from the worker
+                            *([{"name": "SCANNER_TPU_GANG_INIT_TIMEOUT",
+                                "value": str(cfg.gang_init_timeout_s)}]
+                              if cfg.gang else
+                              [{"name": "SCANNER_TPU_GANG",
+                                "value": "0"}]),
                         ],
                         "resources": {
                             "requests": {"cpu": str(cfg.worker.cpus)},

@@ -132,6 +132,16 @@ class Client:
             if not os.environ.get("SCANNER_TPU_JOURNAL_ROTATE"):
                 _journal_cfg.set_rotate_records(
                     cfg.journal_rotate_records)
+            # [gang] section: gang-scheduled multi-host execution
+            # defaults; the SCANNER_TPU_GANG* env vars (read at
+            # import) win per process
+            from . import gang as _gang_cfg
+            if not os.environ.get("SCANNER_TPU_GANG"):
+                _gang_cfg.set_enabled(cfg.gang_enabled)
+            if not os.environ.get("SCANNER_TPU_GANG_INIT_TIMEOUT"):
+                _gang_cfg.set_init_timeout_s(cfg.gang_init_timeout_s)
+            if not os.environ.get("SCANNER_TPU_GANG_FORM_TIMEOUT"):
+                _gang_cfg.set_form_timeout_s(cfg.gang_form_timeout_s)
             # [remediation] section: the alert->action controller's
             # deployment defaults; SCANNER_TPU_REMEDIATION (read at
             # import) is the per-process kill switch and wins
@@ -461,6 +471,16 @@ class Client:
                 "trace_id": root.trace_id if root else None,
                 "bulk_id": self._cluster.last_bulk_id}
             return job_id
+        # gang mode needs a cluster to co-schedule across: a local
+        # (in-process) run IS a single host, so gang_hosts degrades to
+        # ordinary execution — the degenerate 1-host gang — instead of
+        # erroring (the same graph runs either way)
+        if int(getattr(perf, "gang_hosts", 0) or 0):
+            import logging
+            logging.getLogger("scanner_tpu.engine").info(
+                "gang_hosts=%d requested on a local run: executing as "
+                "a single-host job (gang scheduling needs "
+                "Client(master=...))", perf.gang_hosts)
         # instance-count resolution: explicit kwarg > PerfParams >
         # explicit Client(pipeline_instances=) — any of which wins as
         # given, including 1 — and only a fully-unset count resolves to

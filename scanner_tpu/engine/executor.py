@@ -1102,6 +1102,70 @@ class LocalExecutor:
 
     # ------------------------------------------------------------------
 
+    def run_single_task(self, info: A.GraphInfo, w: TaskItem,
+                        save: bool = True,
+                        span_attrs: Optional[Dict[str, Any]] = None
+                        ) -> TaskItem:
+        """Run ONE task stage-inline on this thread: load → evaluate
+        (→ save).  The gang-member path (engine/gang.py): a gang task
+        executes inside a dedicated member process, synchronized with
+        its peers by collectives rather than by the streaming pipeline,
+        and only member 0 saves — so the member defers `save` until the
+        cross-host agreement check passes (`save_results` finishes the
+        job).  `span_attrs` land on the task span (gang id / epoch /
+        member rank, so per-host stragglers stay attributable under the
+        gang root span).  Returns `w` with `.results` populated."""
+        import types
+        tls = types.SimpleNamespace()
+        fb_tls = types.SimpleNamespace()
+        if w.trace_span is None and w.trace_ctx is not None:
+            w.trace_span = _tr.open_span(
+                self.tracer, "task", parent=w.trace_ctx,
+                job=w.job.job_idx, task=w.task_idx, attempt=w.attempt,
+                **(span_attrs or {}))
+        te = None
+        try:
+            with self._task_scope(w):
+                self.load_task(info, w, tls)
+            te = TaskEvaluator(info, self.profiler)
+            w.device = te.device
+            with self._task_scope(w), \
+                    self.profiler.span("evaluate", level=0,
+                                       task=w.task_idx,
+                                       job=w.job.job_idx):
+                w.results = self._evaluate_with_fallback(
+                    info, te, w, fb_tls)
+            w.elements = None
+            self._release_cache(w)
+            if save:
+                self.save_results(info, w)
+            return w
+        except Exception as e:  # noqa: BLE001
+            if w.trace_span is not None:
+                w.trace_span.add_event("error", type=type(e).__name__,
+                                       message=str(e)[:200])
+            self._task_trace_end(w, status="error")
+            w.elements = None
+            w.results = None
+            self._release_cache(w)
+            raise
+        finally:
+            for auto in getattr(tls, "automata", {}).values():
+                auto.close()
+            if te is not None:
+                te.close()
+
+    def save_results(self, info: A.GraphInfo, w: TaskItem) -> None:
+        """Persist a task's evaluated results and close its span — the
+        deferred half of `run_single_task(save=False)`, run by a gang's
+        single writer (member 0) only after the collective agreement
+        check passed."""
+        with self._task_scope(w):
+            with self.profiler.span("save", level=0, task=w.task_idx,
+                                    job=w.job.job_idx):
+                self._save_task(info, w)
+        self._task_trace_end(w)
+
     # ------------------------------------------------------------------
     # Work-packet streaming (PerfParams.stream_work_packets)
     # ------------------------------------------------------------------

@@ -1,0 +1,498 @@
+"""Gang-scheduled multi-host execution: the member side.
+
+The master (engine/service.py) forms a **gang** for each task of a
+`gang_hosts=N` bulk: N live, non-preempting workers are co-scheduled,
+minted a `(gang_id, gang_epoch)` fence, and handed rendezvous roles —
+member 0's advertised gang address is the jax.distributed coordinator,
+everyone gets `(process_id, num_processes)`.  This module is what a
+worker does with its role:
+
+  * **one process per gang epoch** — the member runs in a dedicated
+    child process (`python -m scanner_tpu.engine.gang`), so joining the
+    multi-process JAX runtime never collides with the worker's own
+    (already-initialized) backend, a hung collective is bounded by the
+    parent's member timeout instead of wedging the worker, and a
+    re-formed gang at a new coordinator starts from a clean runtime
+    (parallel/distributed.shutdown() covers the in-process case);
+  * the child rendezvouses with a **bounded** `initialization_timeout`
+    (`[gang] init_timeout_s`), runs the task stage-inline
+    (executor.run_single_task), stages its per-host digest shard via
+    `parallel/distributed.host_local_array`, and runs one jitted
+    cross-host reduction over the global mesh — the collective both
+    synchronizes the gang (a lost host bites HERE) and checks
+    cross-host agreement;
+  * **single-writer commit**: only member 0 saves sink output, and only
+    after the agreement check passed — members 1..N-1 ack through the
+    `GangMemberDone` RPC, so sink writes are exactly-once per epoch;
+  * the child dies with its parent (PR_SET_PDEATHSIG): killing a worker
+    kills its gang runner mid-collective — the survivors' collectives
+    fail or hang, their parents time the members out, and the master
+    aborts + re-forms the gang at `epoch+1` on the remaining capacity.
+
+Failure classification: rendezvous/collective/timeout failures are
+TRANSIENT (`GangFailed(transient=True)`) — the gang re-forms with zero
+blacklist strikes on the survivors; an evaluate error inside the child
+is classified like any worker task failure.
+
+Kill switch: ``SCANNER_TPU_GANG=0`` / ``[gang] enabled`` makes workers
+ignore gang mode (the master still forms gangs only for bulks that ask).
+See docs/robustness.md §Gang scheduling.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+from ..util import faults as _faults
+from ..util import metrics as _mx
+from ..util.log import get_logger
+
+_log = get_logger("gang")
+
+# the [gang] config keys this module accepts (scanner-check SC313 keeps
+# config.default_config(), this tuple and the docs/guide.md rows in
+# sync, all directions)
+CONFIG_KEYS = ("enabled", "init_timeout_s", "form_timeout_s")
+
+
+def _flag(v: Optional[str], default: bool) -> bool:
+    if v is None or v == "":
+        return default
+    return v.strip().lower() not in ("0", "false", "no", "off")
+
+
+def _env_float(name: str, default: float, floor: float) -> float:
+    """Env override with the same clamp the setter applies; a
+    malformed value falls back to the default (WARNING) instead of
+    taking the importing process down."""
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return max(floor, float(raw))
+    except ValueError:
+        _log.warning("ignoring malformed %s=%r (want seconds); using "
+                     "%s", name, raw, default)
+        return default
+
+
+_enabled = _flag(os.environ.get("SCANNER_TPU_GANG"), True)
+_init_timeout_s = _env_float("SCANNER_TPU_GANG_INIT_TIMEOUT", 60.0,
+                             floor=1.0)
+_form_timeout_s = _env_float("SCANNER_TPU_GANG_FORM_TIMEOUT", 5.0,
+                             floor=0.05)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: bool) -> None:
+    """Deployment default ([gang] enabled); the SCANNER_TPU_GANG env
+    var is read at import and wins."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def init_timeout_s() -> float:
+    return _init_timeout_s
+
+
+def set_init_timeout_s(s: float) -> None:
+    global _init_timeout_s
+    _init_timeout_s = max(1.0, float(s))
+
+
+def form_timeout_s() -> float:
+    return _form_timeout_s
+
+
+def set_form_timeout_s(s: float) -> None:
+    global _form_timeout_s
+    _form_timeout_s = max(0.05, float(s))
+
+
+# gang lifecycle telemetry (docs/observability.md §Metric catalog):
+# bumped by the master's formation/abort paths (engine/service.py
+# imports these hooks), so the whole fleet's gang story reads off one
+# scrape of the master
+_M_FORMED = _mx.registry().counter(
+    "scanner_tpu_gang_formed_total",
+    "Gangs the master formed (a co-scheduled member set minted a fresh "
+    "(gang_id, epoch) and handed rendezvous roles).")
+_M_ABORTED = _mx.registry().counter(
+    "scanner_tpu_gang_aborted_total",
+    "Gangs aborted before completing, by reason (member_lost = "
+    "stale/unregistered member, member_failed = a member reported "
+    "rendezvous/collective/evaluate failure, preempted = a member "
+    "advertised spot reclaim, timeout = the task timeout revoked the "
+    "gang).  Each abort bumps the epoch and requeues the task for a "
+    "fresh gang on the remaining capacity, strike-free.",
+    labels=["reason"])
+_M_REFORMS = _mx.registry().counter(
+    "scanner_tpu_gang_reforms_total",
+    "Gang formations for a task whose previous gang aborted — the "
+    "loss-tolerant re-forming path (always at a higher epoch).")
+_M_EPOCH = _mx.registry().gauge(
+    "scanner_tpu_gang_epoch",
+    "Highest gang epoch minted by this master for the active bulk; "
+    "every gang RPC carries (gang_id, epoch) and stale-epoch replies "
+    "are NACKed.")
+_M_STALE_NACKS = _mx.registry().counter(
+    "scanner_tpu_gang_stale_nacks_total",
+    "Gang RPCs NACKed on (gang_id, epoch) fence grounds, by method — "
+    "a completion/failure/ack from an aborted (or pre-failover) gang "
+    "epoch that was refused instead of double-applied.",
+    labels=["rpc"])
+
+
+def count_formed(reform: bool) -> None:
+    _M_FORMED.inc()
+    if reform:
+        _M_REFORMS.inc()
+
+
+def count_aborted(reason: str) -> None:
+    _M_ABORTED.labels(reason=reason).inc()
+
+
+def set_epoch(epoch: int) -> None:
+    _M_EPOCH.set(epoch)
+
+
+def count_stale_nack(rpc: str) -> None:
+    _M_STALE_NACKS.labels(rpc=rpc).inc()
+
+
+# ---------------------------------------------------------------------------
+# parent side: one member child per (gang, epoch)
+# ---------------------------------------------------------------------------
+
+def member_timeout_s(task_timeout: float) -> Optional[float]:
+    """Wall-clock bound on one member child: rendezvous budget + work
+    budget.  `task_timeout=0` means "no timeout" (PerfParams parity):
+    the member gets NO deadline either — a runner blocked in a DEAD
+    collective is still reaped promptly by the heartbeat gang-liveness
+    callback (spawn_member `alive`), which is the mechanism that
+    actually detects peer loss; a hard cap here would kill legitimate
+    long tasks every attempt until the bulk blacklisted."""
+    if not task_timeout or task_timeout <= 0:
+        return None
+    return init_timeout_s() + max(float(task_timeout), 30.0)
+
+
+def spawn_member(request: Dict[str, Any],
+                 timeout: Optional[float] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 alive=None) -> Dict[str, Any]:
+    """Run one gang member to completion in a child process and return
+    its result dict ({"ok": True, "digest": ...} or {"ok": False,
+    "stage", "transient", "error"}).  Never raises: every failure shape
+    — rendezvous, collective hang (timeout), child crash — comes back
+    as a transient result the caller reports via GangFailed.
+
+    `alive` (optional callback) is polled while waiting: returning
+    False means the gang was aborted underneath this member (the
+    master's heartbeat gang-liveness list) — the runner is reaped
+    immediately instead of burning the member timeout blocked in a
+    collective whose peer is gone.
+
+    Chaos hooks fire HERE, in the worker process, so crash-mode plans
+    model host death: the child carries PR_SET_PDEATHSIG and dies with
+    us, mid-collective from its peers' point of view.  The child's env
+    has SCANNER_TPU_FAULTS stripped — a fresh process per epoch would
+    otherwise re-arm counted plans from zero every re-form and never
+    converge."""
+    detail = (f"gang={request.get('gang_id')}:"
+              f"e{request.get('epoch')}:m{request.get('process_id')}")
+    try:
+        if _faults.ACTIVE:
+            # rendezvous-time fault: raise = the member cannot join
+            # (reported transient), crash = the host dies before its
+            # runner even starts
+            _faults.inject("gang.rendezvous", detail=detail)
+    except Exception as e:  # noqa: BLE001 — injected rendezvous loss
+        return {"ok": False, "stage": "rendezvous", "transient": True,
+                "error": f"{type(e).__name__}: {e}"}
+    import cloudpickle
+
+    if timeout is None:
+        timeout = member_timeout_s(request.get("task_timeout", 0))
+    workdir = tempfile.mkdtemp(prefix="gang_member_")
+    req_path = os.path.join(workdir, "request.bin")
+    res_path = os.path.join(workdir, "result.bin")
+    joined = res_path + ".joined"
+    request = dict(request, joined_marker=joined)
+    with open(req_path, "wb") as fh:
+        fh.write(cloudpickle.dumps(request))
+    child_env = dict(env if env is not None else os.environ)
+    child_env.pop("SCANNER_TPU_FAULTS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "scanner_tpu.engine.gang",
+         req_path, res_path],
+        env=child_env)
+    deadline = time.time() + timeout if timeout else None
+    injected_collective = False
+    try:
+        while proc.poll() is None:
+            if not injected_collective and os.path.exists(joined):
+                injected_collective = True
+                try:
+                    if _faults.ACTIVE:
+                        # the child has rendezvoused and is entering
+                        # the collective: a crash here kills worker AND
+                        # runner (pdeathsig) — host loss mid-collective
+                        _faults.inject("gang.collective", detail=detail)
+                except Exception as e:  # noqa: BLE001
+                    proc.kill()
+                    proc.wait()
+                    return {"ok": False, "stage": "collective",
+                            "transient": True,
+                            "error": f"{type(e).__name__}: {e}"}
+            if alive is not None and not alive():
+                _log.warning("gang member %s: gang aborted underneath "
+                             "this member — reaping the runner",
+                             detail)
+                proc.kill()
+                proc.wait()
+                return {"ok": False, "stage": "aborted",
+                        "transient": True,
+                        "error": "gang aborted while member ran"}
+            if deadline is not None and time.time() > deadline:
+                _log.warning("gang member %s timed out after %.1fs: "
+                             "killing the runner", detail, timeout)
+                proc.kill()
+                proc.wait()
+                return {"ok": False, "stage": "timeout",
+                        "transient": True,
+                        "error": f"member timed out after {timeout:.1f}s "
+                                 "(peer lost mid-collective?)"}
+            time.sleep(0.05)
+        if os.path.exists(res_path):
+            with open(res_path, "rb") as fh:
+                return cloudpickle.loads(fh.read())
+        # no result file: the runner died hard (injected host loss, OOM
+        # kill, a crashed peer's coordination-service shutdown) — the
+        # same transient member-loss shape as a timeout
+        return {"ok": False, "stage": "crash", "transient": True,
+                "error": f"gang member runner exited "
+                         f"{proc.returncode} with no result"}
+    finally:
+        for p in (req_path, res_path, joined):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        try:
+            os.rmdir(workdir)
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# child side: the member body
+# ---------------------------------------------------------------------------
+
+def _die_with_parent() -> None:
+    """PR_SET_PDEATHSIG(SIGKILL): the runner must not outlive its
+    worker — an orphaned member completing (or committing) after its
+    host 'died' would violate the gang's loss semantics.  Linux only;
+    elsewhere the parent's kill-on-timeout is the backstop."""
+    try:
+        import ctypes
+        import signal
+        libc = ctypes.CDLL(None, use_errno=True)
+        PR_SET_PDEATHSIG = 1
+        libc.prctl(PR_SET_PDEATHSIG, signal.SIGKILL)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _digest_rows(rows) -> int:
+    """Deterministic uint32 digest of one shard's result rows: bytes
+    rows hash directly, array-likes via their buffer — the cross-host
+    agreement currency.  Unhashable row types contribute their length
+    only (agreement then still covers row counts)."""
+    import zlib
+
+    import numpy as np
+    acc = 0
+    for r in rows:
+        if isinstance(r, (bytes, bytearray, memoryview)):
+            acc = (acc + zlib.crc32(bytes(r))) & 0xFFFFFFFF
+        else:
+            try:
+                arr = np.asarray(r)
+                acc = (acc + zlib.crc32(np.ascontiguousarray(arr)
+                                        .tobytes())) & 0xFFFFFFFF
+            except Exception:  # noqa: BLE001
+                acc = (acc + 1) & 0xFFFFFFFF
+    return acc
+
+
+def shard_range(n_rows: int, process_id: int,
+                num_processes: int) -> tuple:
+    """Contiguous per-host row shard [lo, hi) of a task's output rows —
+    the split host_local_array staging keys off."""
+    base = n_rows // num_processes
+    extra = n_rows % num_processes
+    lo = process_id * base + min(process_id, extra)
+    hi = lo + base + (1 if process_id < extra else 0)
+    return lo, hi
+
+
+def _collective_digest_sum(num_processes: int, process_id: int,
+                           local_digest: int) -> int:
+    """One jitted cross-host reduction over the global mesh: every
+    member stages its shard digest as this host's block of a global
+    array (parallel/distributed.host_local_array) and the sum comes
+    back replicated — the gang's synchronization point AND its
+    agreement signal.  Wraps mod 2**32 deterministically."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..parallel.distributed import host_local_array
+
+    devices = np.array(jax.devices())
+    per_host = devices.size // num_processes
+    mesh = jax.sharding.Mesh(
+        devices.reshape(num_processes, per_host), ("hosts", "local"))
+    arr = host_local_array(
+        mesh, ("hosts",),
+        np.array([local_digest], dtype=np.uint32))
+    total = jax.jit(lambda a: jnp.sum(a, dtype=jnp.uint32))(arr)
+    return int(np.asarray(jax.device_get(total))) & 0xFFFFFFFF
+
+
+def run_member(req: Dict[str, Any]) -> Dict[str, Any]:
+    """The member body (runs inside the child process): rendezvous →
+    evaluate → collective agreement → (member 0) save.  Returns a
+    result dict; never raises."""
+    from ..parallel.distributed import (CoordinatorConfig,
+                                        RendezvousError, initialize,
+                                        shutdown)
+    pid = int(req["process_id"])
+    num = int(req["num_processes"])
+    try:
+        initialize(
+            CoordinatorConfig(address=req["coordinator"],
+                              num_processes=num, process_id=pid),
+            init_timeout=float(req.get("init_timeout")
+                               or init_timeout_s()))
+    except RendezvousError as e:
+        return {"ok": False, "stage": "rendezvous", "transient": True,
+                "error": str(e)}
+    except Exception as e:  # noqa: BLE001
+        return {"ok": False, "stage": "rendezvous", "transient": True,
+                "error": f"{type(e).__name__}: {e}"}
+    marker = req.get("joined_marker")
+    if marker:
+        try:
+            with open(marker, "w") as fh:
+                fh.write("joined")
+        except OSError:
+            pass
+    try:
+        return _member_body(req, pid, num)
+    except Exception as e:  # noqa: BLE001 — collective/commit errors
+        # surface as a transient member failure, not a child crash
+        return {"ok": False, "stage": "collective", "transient": True,
+                "error": f"{type(e).__name__}: {e}"}
+    finally:
+        shutdown()
+
+
+def _member_body(req: Dict[str, Any], pid: int,
+                 num: int) -> Dict[str, Any]:
+    import cloudpickle
+
+    from ..storage import Database, make_storage
+    from ..util import tracing as _tr
+    from .executor import LocalExecutor, TaskItem
+
+    db = Database(make_storage(req.get("storage_type") or "posix",
+                               db_path=req["db_path"]))
+    db.refresh_meta()
+    tracer = _tr.Tracer(
+        node=req.get("node") or f"gang-m{pid}", export=True)
+    ex = LocalExecutor(db)
+    ex.tracer = tracer
+    ex._stream_opt = False  # whole-task evaluation inside the member
+    spec = cloudpickle.loads(req["spec"])
+    info, jobs = ex.prepare_readonly(spec["outputs"], spec["perf"])
+    job = jobs[int(req["job_idx"])]
+    task_idx = int(req["task_idx"])
+    w = TaskItem(job, task_idx, tuple(job.tasks[task_idx]),
+                 attempt=int(req.get("attempt") or 0))
+    w.trace_ctx = _tr.parse_traceparent(req.get("traceparent"))
+    try:
+        ex.run_single_task(info, w, save=False,
+                           span_attrs={"gang": req.get("gang_id"),
+                                       "epoch": req.get("epoch"),
+                                       "member": pid})
+    except Exception as e:  # noqa: BLE001
+        from .service import _is_transient_failure
+        return {"ok": False, "stage": "evaluate",
+                "transient": _is_transient_failure(e),
+                "error": f"{type(e).__name__}: {e}",
+                "spans": tracer.drain_export()}
+    # per-host digest shards: member p digests only rows [lo, hi) of
+    # every sink's output, the collective assembles the full-task sum
+    # across hosts, and member 0 — which evaluated the whole task —
+    # cross-checks the assembled sum against its own local shard sums:
+    # one diverging member fails the gang instead of committing
+    start, end = w.output_range
+    n_rows = end - start
+    lo, hi = shard_range(n_rows, pid, num)
+    sink_rows: List[Any] = []
+    for sink in info.sinks:
+        if sink.id in w.results:
+            sink_rows.append(ex._sink_rows(w.results[sink.id],
+                                           start, end))
+    local = sum(_digest_rows(rows[lo:hi])
+                for rows in sink_rows) & 0xFFFFFFFF
+    total = _collective_digest_sum(num, pid, local)
+    if pid == 0:
+        expect = 0
+        for p in range(num):
+            plo, phi = shard_range(n_rows, p, num)
+            expect = (expect + sum(_digest_rows(rows[plo:phi])
+                                   for rows in sink_rows)) & 0xFFFFFFFF
+        if total != expect:
+            return {"ok": False, "stage": "agree", "transient": True,
+                    "error": f"cross-host digest mismatch: collective "
+                             f"sum {total} != member-0 expectation "
+                             f"{expect}",
+                    "spans": tracer.drain_export()}
+        # agreement holds: the single writer commits, exactly once
+        ex.save_results(info, w)
+    else:
+        ex._task_trace_end(w)
+    return {"ok": True, "digest": total, "rows": n_rows,
+            "spans": tracer.drain_export()}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """Child entry: python -m scanner_tpu.engine.gang <req> <res>."""
+    _die_with_parent()
+    argv = argv if argv is not None else sys.argv[1:]
+    req_path, res_path = argv[0], argv[1]
+    import cloudpickle
+    with open(req_path, "rb") as fh:
+        req = cloudpickle.loads(fh.read())
+    res = run_member(req)
+    tmp = res_path + ".tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(cloudpickle.dumps(res))
+    os.replace(tmp, res_path)
+    return 0 if res.get("ok") else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,6 +44,7 @@ from ..util.metrics import MetricsServer, merge_snapshots
 from ..util.profiler import Profiler
 from . import controller as _controller
 from . import framecache as _framecache
+from . import gang as _gang
 from . import journal as _journal
 from . import rpc
 from .evaluate import TaskEvaluator
@@ -106,6 +107,13 @@ RPC_CONTRACTS = {
     "ShipMemoryReport": {"timeout_s": 30.0, "idempotent": False},
     "GetMemoryReport":  {"timeout_s": 30.0, "idempotent": True},
     "GetCompileLedger": {"timeout_s": 30.0, "idempotent": True},
+    # gang control plane (engine/gang.py): both mutate scheduling
+    # state (ack bookkeeping / abort+requeue), so both are fenced —
+    # and additionally fenced by (gang_id, epoch): a stale-epoch
+    # report answers {"gang_stale": True} instead of being applied.
+    # scanner-check SC313 pins every Gang* entry to this shape.
+    "GangMemberDone":   {"timeout_s": 30.0, "idempotent": False},
+    "GangFailed":       {"timeout_s": 30.0, "idempotent": False},
     "Shutdown":         {"timeout_s": PING_TIMEOUT, "idempotent": True},
 }
 
@@ -199,13 +207,18 @@ def _is_transient_failure(exc: BaseException) -> bool:
     import grpc
 
     from ..common import StorageException
+    from ..parallel.distributed import RendezvousError
     if _memstats.is_oom(exc):
         # device memory exhaustion: the pressure came from co-scheduled
         # work, not this task — requeue strike-free (the failed attempt
         # freed its staged buffers on the way out)
         return True
+    # a failed jax.distributed rendezvous means the PEER SET changed
+    # (a member died, a coordinator moved) — the task is fine; the
+    # gang re-forms on the remaining capacity strike-free
     return isinstance(exc, (StorageException, rpc.RpcError, grpc.RpcError,
-                            ConnectionError, TimeoutError))
+                            ConnectionError, TimeoutError,
+                            RendezvousError))
 
 
 # ---------------------------------------------------------------------------
@@ -218,6 +231,10 @@ class _WorkerInfo:
     address: str
     last_seen: float
     active: bool = True
+    # host:port this worker's gang member runner would serve the
+    # jax.distributed coordinator at if elected member 0 (advertised at
+    # registration; empty = the worker cannot coordinate a gang)
+    gang_address: str = ""
     # spot/preemptible reclaim notice seen on a heartbeat: assignment
     # to this worker is FENCED (NextWork answers wait) while its drain
     # completes — requeues of whatever it cannot finish stay strike-free
@@ -227,6 +244,27 @@ class _WorkerInfo:
     # controller (stage_backpressure lives in worker processes; the
     # master's local health engine cannot see it)
     firing: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class _Gang:
+    """One co-scheduled task group (docs/robustness.md §Gang
+    scheduling): the member set, its rendezvous wiring, and the
+    (gang_id, epoch) fence every gang RPC must present.  Lives in
+    `_BulkJob.gangs` from formation until member 0's FinishedWork is
+    accepted or the gang aborts — after either, every late report with
+    this (gang_id, epoch) is NACKed (`gang_stale`)."""
+
+    gang_id: int
+    epoch: int
+    key: Tuple[int, int]                 # the (job, task) the gang runs
+    attempt: int
+    members: List[int]                   # worker ids; members[0] is the
+    coordinator: str                     # jax coordinator (its address)
+    formed_at: float
+    roles_handed: Set[int] = field(default_factory=set)
+    acks: Set[int] = field(default_factory=set)   # non-0 members done
+    trace_parent: str = ""               # gang root span traceparent
 
 
 @dataclass
@@ -357,6 +395,37 @@ class _BulkJob:
     # checkpoint-restored completions by seconds-since-recovery would
     # report a completion rate off by orders of magnitude.
     done_at_start: int = 0
+    # gang scheduling (PerfParams.gang_hosts > 0): each task is
+    # co-scheduled onto a gang of up to gang_hosts live workers
+    # instead of answering independent pulls.  `gang_epoch` is the
+    # bulk-wide monotonic fence — minted fresh per formation, bumped
+    # again on every abort, restored >= its journaled high-water mark
+    # across a master failover — so a completion from a superseded
+    # gang can never double-commit.  `gang_forming` is the pool of
+    # workers waiting for the next formation (joined-order), and
+    # `gang_aborted_keys` marks tasks whose re-formation counts as a
+    # reform in the metrics.
+    gang_hosts: int = 0
+    next_gang_id: int = 0
+    gang_epoch: int = 0
+    gangs: Dict[int, _Gang] = field(default_factory=dict)
+    gang_by_task: Dict[Tuple[int, int], int] = field(default_factory=dict)
+    gang_forming: Dict[int, float] = field(default_factory=dict)
+    gang_forming_since: float = 0.0
+    gang_aborted_keys: Set[Tuple[int, int]] = field(default_factory=set)
+    # scan-loop watchdog clock: since when the fleet has had live
+    # workers but ZERO gang-capable ones (no gang_address — e.g. the
+    # whole fleet runs SCANNER_TPU_GANG=0) while this gang bulk still
+    # has work; 0 = capable capacity exists.  Past no_workers_timeout
+    # the bulk fails loudly instead of waiting forever on formations
+    # that can never happen.
+    gang_incapable_since: float = 0.0
+    # gangs retired by an accepted member-0 completion (gang_id ->
+    # epoch, insertion-bounded): a surviving member's ack that lands
+    # AFTER the single writer committed is acknowledged quietly
+    # instead of counting as a stale-epoch NACK — it is the normal
+    # tail of a healthy gang, not fence traffic
+    gang_retired: Dict[int, int] = field(default_factory=dict)
     # retention: when this bulk ages out of the last-N history ring its
     # heavy scheduling state (done set, task_rows, per-task maps, the
     # span store) is dropped and status queries serve from this frozen
@@ -396,6 +465,11 @@ class _BulkJob:
         self.stage_seen = {"load": set(), "evaluate": set()}
         self.sticky_worker = {}
         self.sticky_cur = {}
+        self.gangs = {}
+        self.gang_by_task = {}
+        self.gang_forming = {}
+        self.gang_retired = {}
+        self.gang_aborted_keys = set()
         # profiles are deliberately KEPT: GetProfiles / Client.trace
         # device lanes retained them for all history before compaction
         # existed, and they are per-worker (bounded per bulk), not
@@ -518,6 +592,8 @@ class Master:
             "GetTrace": self._rpc_get_trace,
             "ShipMemoryReport": self._fenced(
                 self._rpc_ship_memory_report),
+            "GangMemberDone": self._fenced(self._rpc_gang_member_done),
+            "GangFailed": self._fenced(self._rpc_gang_failed),
             "GetMemoryReport": self._rpc_get_memory_report,
             "GetCompileLedger": self._rpc_get_compile_ledger,
             "Shutdown": self._rpc_shutdown,
@@ -634,7 +710,8 @@ class Master:
             wid = self._next_worker_id
             self._next_worker_id += 1
             self._workers[wid] = _WorkerInfo(
-                wid, req.get("address", ""), time.time())
+                wid, req.get("address", ""), time.time(),
+                gang_address=str(req.get("gang_address", "") or ""))
         _mlog.info("worker %d registered (%s)", wid, req.get("address", ""))
         return {"worker_id": wid}
 
@@ -644,17 +721,20 @@ class Master:
         requeue anything it still held (a drained worker finished its
         in-flight tasks first, so normally nothing)."""
         wid = req.get("worker_id")
+        recs: List[dict] = []
         with self._lock:
             w = self._workers.get(wid)
             if w is not None and w.active:
                 w.active = False
-                self._requeue_worker_tasks(wid)
+                self._requeue_worker_tasks(wid, recs=recs)
                 _M_DRAINS.inc()
                 _mlog.info("worker %d deregistered (drain)", wid)
+        self._journal_append(recs)
         return {"ok": True}
 
     def _rpc_heartbeat(self, req: dict) -> dict:
         wid = req["worker_id"]
+        recs: List[dict] = []
         with self._lock:
             w = self._workers.get(wid)
             if w is None or not w.active:
@@ -663,24 +743,52 @@ class Master:
             w.last_seen = time.time()
             # preemption notice: fence assignment NOW — the worker's
             # drain completes on its own clock, but no new task may be
-            # handed to reclaimed capacity in the meantime
+            # handed to reclaimed capacity in the meantime.  A gang
+            # this worker belongs to cannot survive the reclaim: abort
+            # it immediately so the epoch bumps and the task re-forms
+            # on capacity that is staying.
             if req.get("preempting") and not w.preempting:
                 w.preempting = True
                 _M_PREEMPT_NOTICES.inc()
                 _mlog.warning(
                     "worker %d advertised preemption: assignment "
                     "fenced, drain in progress", wid)
+                cur = self._bulk
+                if cur is not None and not cur.finished:
+                    for g in list(cur.gangs.values()):
+                        if wid in g.members:
+                            self._abort_gang_locked(cur, g, "preempted",
+                                                    recs)
+                    cur.gang_forming.pop(wid, None)
             # firing alert names ride every beat (tiny: a sorted list
             # of rule-name strings) — the scan loop folds them into
             # cluster-level remediation transitions
             w.firing = set(req.get("firing") or ())
-            active = self._bulk.bulk_id \
-                if self._bulk and not self._bulk.finished else None
+            bulk = self._bulk
+            active = bulk.bulk_id \
+                if bulk and not bulk.finished else None
+            # gang liveness rides the beat: the worker compares its
+            # in-flight member runs against this list and reaps a
+            # runner whose gang was aborted underneath it — survivors
+            # blocked in a dead collective tear down in seconds
+            # instead of burning the whole member timeout
+            gang_ids = None
+            if bulk is not None and bulk.gang_hosts \
+                    and not bulk.finished:
+                gang_ids = sorted(
+                    g.gang_id for g in bulk.gangs.values()
+                    if wid in g.members)
+        # a preemption-triggered gang abort is journaled like any other
+        # scheduling mutation (outside the lock, before the ack)
+        self._journal_append(recs)
         # the generation rides every beat so workers latch the newest
         # master even between assignments (Heartbeat itself stays
         # idempotent — no fence guard needed to read liveness)
-        return {"reregister": False, "active_bulk": active,
-                "generation": self.generation}
+        reply = {"reregister": False, "active_bulk": active,
+                 "generation": self.generation}
+        if gang_ids is not None:
+            reply["gangs"] = gang_ids
+        return reply
 
     def _rpc_new_job(self, req: dict) -> dict:
         """Admit a bulk job: resolve perf, create output tables, build the
@@ -736,11 +844,16 @@ class Master:
                 info, jobs = ex.prepare(outputs, perf, cache_mode)
             except Exception as e:  # noqa: BLE001
                 return {"error": f"{type(e).__name__}: {e}"}
+            gang_hosts = max(0, int(getattr(perf, "gang_hosts", 0) or 0))
             sticky = bool(getattr(perf, "stateful_task_affinity", False)
                           and any(n.spec is not None
                                   and getattr(n.spec, "unbounded_state",
                                               False)
                                   for n in info.ops))
+            if gang_hosts:
+                # a gang task is one synchronized program, not a chain
+                # of per-worker state carries: gang mode wins
+                sticky = False
             with self._lock:
                 bulk = _BulkJob(
                     bulk_id=self._next_bulk_id,
@@ -750,7 +863,8 @@ class Master:
                     task_timeout=float(getattr(perf, "task_timeout", 0.0)),
                     checkpoint_frequency=int(
                         getattr(perf, "checkpoint_frequency", 0) or 0),
-                    sticky=sticky, admission_token=token,
+                    sticky=sticky, gang_hosts=gang_hosts,
+                    admission_token=token,
                     trace_id=trace_id, trace_parent=trace_parent)
                 self._next_bulk_id += 1
                 if token:
@@ -808,6 +922,17 @@ class Master:
         wid = req["worker_id"]
         bulk_id = req["bulk_id"]
         window = int(req.get("window") or 0)
+        recs: List[dict] = []
+        try:
+            return self._next_work_impl(wid, bulk_id, window, recs)
+        finally:
+            # a gang formation is a scheduling mutation: its journal
+            # record is durable before the role reply acks it (the
+            # lock is released by the time this runs)
+            self._journal_append(recs)
+
+    def _next_work_impl(self, wid, bulk_id: int, window: int,
+                        recs: List[dict]) -> dict:
         with self._lock:
             self._touch_worker(wid)
             bulk = self._bulk
@@ -822,6 +947,11 @@ class Master:
                 # stops pulls too — this covers the notice->drain race
                 # and externally-observed preemptions)
                 return {"status": "wait"}
+            if bulk.gang_hosts > 0:
+                # gang mode: pulls feed the formation pool instead of
+                # popping independent tasks (docs/robustness.md §Gang
+                # scheduling)
+                return self._gang_next_work_locked(bulk, wid, recs)
             if window:
                 # per-worker in-flight window: don't let one node's
                 # loaders hoard the queue while its siblings idle
@@ -903,6 +1033,313 @@ class Master:
                 return {"status": "wait"}
             return {"status": "done"}
 
+    # -- gang scheduling (engine/gang.py, docs/robustness.md) ---------------
+
+    def _gang_next_work_locked(self, bulk: _BulkJob, wid: int,
+                               recs: List[dict]) -> dict:
+        """One gang-mode pull: hand the caller its role in a formed
+        gang, or pool it toward the next formation.  A gang forms when
+        `gang_hosts` eligible workers have pooled — or, after
+        `[gang] form_timeout_s`, on whatever capacity HAS pooled (the
+        loss-tolerant path: a bulk that lost hosts mid-flight re-forms
+        smaller instead of waiting for capacity that is gone).  Caller
+        holds self._lock."""
+        info_w = self._workers.get(wid)
+        if info_w is None or not info_w.gang_address:
+            # a worker that cannot rendezvous (SCANNER_TPU_GANG=0 /
+            # [gang] enabled=false: it registered with no gang
+            # address) must never become a member — handing it a gang
+            # reply would make it run the task as an ordinary pull and
+            # break the single-writer accounting
+            return {"status": "wait"}
+        for g in bulk.gangs.values():
+            if wid in g.members:
+                if wid not in g.roles_handed:
+                    return self._gang_role_reply_locked(bulk, g, wid)
+                return {"status": "wait"}  # its member run is in flight
+        # prune pool entries whose workers died/preempted since joining
+        for fw in list(bulk.gang_forming):
+            info = self._workers.get(fw)
+            if info is None or not info.active or info.preempting:
+                bulk.gang_forming.pop(fw, None)
+        if not bulk.q_has_work():
+            if bulk.outstanding or bulk.gangs:
+                return {"status": "wait"}
+            return {"status": "done"}
+        now = time.time()
+        if wid not in bulk.gang_forming:
+            if not bulk.gang_forming:
+                bulk.gang_forming_since = now
+            bulk.gang_forming[wid] = now
+        full = len(bulk.gang_forming) >= bulk.gang_hosts
+        if not full and now - bulk.gang_forming_since \
+                < _gang.form_timeout_s():
+            return {"status": "wait"}
+        # elect members in join order; the coordinator (member 0) must
+        # advertise a gang address, and the election ROTATES with the
+        # epoch about to be minted — a member whose advertised port
+        # went bad (reclaimed since the startup probe) costs one
+        # aborted epoch, not an unbounded streak of re-forms electing
+        # the same broken coordinator
+        pool = sorted(bulk.gang_forming,
+                      key=lambda k: bulk.gang_forming[k])
+        members = pool[:bulk.gang_hosts]
+        able = [m for m in members
+                if self._workers.get(m) is not None
+                and self._workers[m].gang_address]
+        if not able:
+            return {"status": "wait"}  # nobody can coordinate yet
+        lead = able[(bulk.gang_epoch + 1) % len(able)]
+        members.remove(lead)
+        members.insert(0, lead)
+        coord = self._workers[lead].gang_address
+        key = self._gang_pop_task_locked(bulk)
+        if key is None:
+            return {"status": "wait"}
+        attempt = bulk.next_attempt
+        bulk.next_attempt += 1
+        bulk.gang_epoch += 1
+        gid = bulk.next_gang_id
+        bulk.next_gang_id += 1
+        g = _Gang(gang_id=gid, epoch=bulk.gang_epoch, key=key,
+                  attempt=attempt, members=members, coordinator=coord,
+                  formed_at=now)
+        bulk.gangs[gid] = g
+        bulk.gang_by_task[key] = gid
+        for m in members:
+            bulk.gang_forming.pop(m, None)
+            bulk.held[m] = bulk.held.get(m, 0) + 1
+        bulk.gang_forming_since = now if bulk.gang_forming else 0.0
+        # the gang's timeout clock starts at formation (started=True:
+        # a formed gang is executing, not queue-parked)
+        bulk.outstanding[key] = (members[0], now, attempt, True, False)
+        reform = key in bulk.gang_aborted_keys
+        _gang.count_formed(reform)
+        _gang.set_epoch(bulk.gang_epoch)
+        # the gang root span: every member's task span parents under it
+        # so per-host stragglers inside one gang stay attributable
+        sp = _tracing.open_span(
+            self.tracer, "gang",
+            parent=_tracing.SpanContext(bulk.trace_id,
+                                        bulk.trace_parent),
+            gang=gid, epoch=g.epoch, job=key[0], task=key[1],
+            members=len(members)) if bulk.trace_id else None
+        if sp is not None:
+            _tracing.close_span(self.tracer, sp)
+            g.trace_parent = sp.context().traceparent()
+        recs.append({"t": "gang", "g": gid, "e": g.epoch,
+                     "j": key[0], "k": key[1],
+                     "members": list(members)})
+        _mlog.info(
+            "gang %d formed at epoch %d for task (%d,%d): members %s, "
+            "coordinator %s%s", gid, g.epoch, key[0], key[1], members,
+            coord, " (re-form)" if reform else "")
+        if wid in g.members:
+            return self._gang_role_reply_locked(bulk, g, wid)
+        # the pool can briefly exceed gang_hosts (a pull that found
+        # only blacklisted-job work left a full pool behind): this
+        # caller's join-order slot fell outside the elected set — it
+        # stays pooled for the NEXT formation instead of crashing the
+        # role lookup
+        return {"status": "wait"}
+
+    @staticmethod
+    def _gang_pop_task_locked(bulk: _BulkJob):
+        """Round-robin task pop for gang formation (no stickiness —
+        gang bulks never chain state across workers)."""
+        for _ in range(len(bulk.job_rr)):
+            j = bulk.job_rr.popleft()
+            dq = bulk.queue.get(j)
+            if not dq or j in bulk.blacklisted_jobs:
+                bulk.queue.pop(j, None)
+                continue
+            got = None
+            while dq and got is None:
+                t = dq.popleft()
+                if (j, t) not in bulk.done:
+                    got = (j, t)
+            if dq:
+                bulk.job_rr.append(j)
+            else:
+                bulk.queue.pop(j, None)
+            if got is not None:
+                return got
+        return None
+
+    def _gang_role_reply_locked(self, bulk: _BulkJob, g: _Gang,
+                                wid: int) -> dict:
+        g.roles_handed.add(wid)
+        return {"status": "gang", "gang_id": g.gang_id,
+                "epoch": g.epoch,
+                "process_id": g.members.index(wid),
+                "num_processes": len(g.members),
+                "coordinator": g.coordinator,
+                "job_idx": g.key[0], "task_idx": g.key[1],
+                "attempt": g.attempt,
+                "task_timeout": bulk.task_timeout,
+                "traceparent": g.trace_parent or None}
+
+    def _abort_gang_locked(self, bulk: _BulkJob, g: _Gang, reason: str,
+                           recs: List[dict], strike: bool = False,
+                           error: str = "") -> None:
+        """Tear one gang down: bump the epoch (the fence — every late
+        report from this gang now NACKs), release member bookkeeping,
+        and requeue the task for a fresh gang on the remaining
+        capacity.  Aborts are revocations, not task failures: they
+        count against the transient cap, never a blacklist strike —
+        unless `strike` (a member reported a DETERMINISTIC task error),
+        which routes through the ordinary failure path.  Idempotent
+        per gang.  Caller holds self._lock."""
+        if bulk.gangs.get(g.gang_id) is not g:
+            return
+        bulk.gangs.pop(g.gang_id, None)
+        bulk.gang_by_task.pop(g.key, None)
+        bulk.gang_aborted_keys.add(g.key)
+        bulk.gang_epoch += 1
+        _gang.set_epoch(bulk.gang_epoch)
+        _gang.count_aborted(reason)
+        recs.append({"t": "gang_abort", "g": g.gang_id, "e": g.epoch})
+        self._unassign(bulk, g.key)
+        for m in g.members[1:]:
+            if m not in g.acks:
+                self._dec_held(bulk, m)
+        _mlog.warning(
+            "gang %d (epoch %d, task (%d,%d)) aborted: %s — epoch "
+            "bumped to %d, task requeued for a fresh gang", g.gang_id,
+            g.epoch, g.key[0], g.key[1], reason, bulk.gang_epoch)
+        if g.key in bulk.done or g.key[0] in bulk.blacklisted_jobs:
+            return
+        if strike:
+            if self._count_strike_locked(bulk, g.key,
+                                         error or reason, recs):
+                # a blacklist can complete the bulk, and this abort
+                # may have arrived on a non-RPC path (heartbeat
+                # preemption, stale scan) that runs no finish check of
+                # its own — without this, a bulk whose LAST task
+                # blacklisted here would hang unfinished forever
+                self._maybe_finish_bulk(bulk)
+            return
+        # strike-free revocation, bounded by the transient cap so a
+        # gang that can never form/agree still terminates the bulk
+        if self._count_transient_locked(bulk, g.key, recs):
+            _M_TRANSIENT.inc()
+            _M_REVOCATIONS.inc()
+            _M_TASK_RETRIES.inc()
+            bulk.q_push(g.key, front=True)
+            return
+        if self._count_strike_locked(
+                bulk, g.key,
+                f"gang aborts exhausted the transient cap ({reason})",
+                recs):
+            self._maybe_finish_bulk(bulk)
+
+    # shared escalation counters (one policy for RPC failures, timeout
+    # revocations, and gang aborts — the journal record shapes and
+    # caps must never drift between those paths)
+
+    @staticmethod
+    def _count_transient_locked(bulk: _BulkJob, key: Tuple[int, int],
+                                recs: List[dict]) -> bool:
+        """Count one environment-caused failure against the transient
+        cap.  True = still under the cap (caller requeues strike-free);
+        False = escalate to a strike.  Caller holds self._lock."""
+        tn = bulk.transient_failures.get(key, 0) + 1
+        bulk.transient_failures[key] = tn
+        recs.append({"t": "transient", "j": key[0], "k": key[1],
+                     "n": tn})
+        return tn <= MAX_TRANSIENT_FAILURES
+
+    def _count_strike_locked(self, bulk: _BulkJob,
+                             key: Tuple[int, int], err: str,
+                             recs: List[dict]) -> bool:
+        """Count one blacklist strike; past MAX_TASK_FAILURES the job
+        blacklists (returns True), otherwise the task requeues at the
+        front.  Caller holds self._lock."""
+        n = bulk.failures.get(key, 0) + 1
+        bulk.failures[key] = n
+        recs.append({"t": "strike", "j": key[0], "k": key[1], "n": n})
+        _M_STRIKES.inc()
+        if n >= MAX_TASK_FAILURES:
+            self._blacklist_job(bulk, key[0], err, recs=recs)
+            return True
+        bulk.q_push(key, front=True)
+        _M_TASK_RETRIES.inc()
+        return False
+
+    def _gang_for_req_locked(self, bulk: _BulkJob, req: dict,
+                             rpc_name: str):
+        """Resolve a gang RPC's (gang_id, epoch) fence: the live gang,
+        or None (counted NACK) when the gang is gone or the epoch is
+        stale.  Caller holds self._lock."""
+        gid = req.get("gang_id")
+        g = bulk.gangs.get(gid) if gid is not None else None
+        if g is None or int(req.get("epoch", -1)) != g.epoch:
+            _gang.count_stale_nack(rpc_name)
+            return None
+        return g
+
+    def _rpc_gang_member_done(self, req: dict) -> dict:
+        """A non-coordinator member finished its (non-writing) part of
+        the gang program: record the ack and release its slot in the
+        worker's held-count.  Member 0 completes via FinishedWork —
+        the gang's single completion report."""
+        with self._lock:
+            self._touch_worker(req.get("worker_id"))
+            bulk = self._bulk
+            if bulk is None or bulk.bulk_id != req.get("bulk_id"):
+                return {"ok": False}
+            gid = req.get("gang_id")
+            if gid in bulk.gang_retired \
+                    and int(req.get("epoch", -1)) \
+                    == bulk.gang_retired[gid]:
+                # the writer already committed this gang's task: the
+                # surviving member's ack is the healthy tail, not
+                # stale fence traffic
+                return {"ok": True}
+            g = self._gang_for_req_locked(bulk, req, "GangMemberDone")
+            if g is None:
+                return {"ok": False, "gang_stale": True}
+            wid = req.get("worker_id")
+            if wid not in g.members or wid == g.members[0]:
+                _gang.count_stale_nack("GangMemberDone")
+                return {"ok": False, "gang_stale": True}
+            if wid not in g.acks:
+                g.acks.add(wid)
+                self._dec_held(bulk, wid)
+            return {"ok": True}
+
+    def _rpc_gang_failed(self, req: dict) -> dict:
+        """A member reported its gang run failed (rendezvous timeout,
+        collective error, runner loss, evaluate error): abort the gang
+        — epoch bump, strike-free requeue unless the member classified
+        the failure deterministic."""
+        recs: List[dict] = []
+        try:
+            with self._lock:
+                self._touch_worker(req.get("worker_id"))
+                bulk = self._bulk
+                if bulk is None or bulk.bulk_id != req.get("bulk_id"):
+                    return {"ok": False}
+                g = self._gang_for_req_locked(bulk, req, "GangFailed")
+                if g is None:
+                    return {"ok": False, "gang_stale": True}
+                stage = str(req.get("stage") or "member")
+                _mlog.warning(
+                    "gang %d epoch %d: member (worker %s) failed at "
+                    "%s: %s", g.gang_id, g.epoch,
+                    req.get("worker_id"), stage, req.get("error", ""))
+                self._abort_gang_locked(
+                    bulk, g, f"member_failed:{stage}", recs,
+                    strike=not req.get("transient", True),
+                    error=str(req.get("error", "")))
+                self._maybe_finish_bulk(bulk)
+                finished_now = bulk.finished
+        finally:
+            self._journal_append(recs)
+        if finished_now:
+            self._clear_bulk_checkpoint(bulk.bulk_id)
+        return {"ok": True}
+
     def _rpc_started_work(self, req: dict) -> dict:
         """Worker signals that evaluation of a prefetched task begins now:
         restart its timeout clock so task_timeout measures execution, not
@@ -960,6 +1397,31 @@ class Master:
             # export buffer (cap 65536) until end-of-bulk and overflow.
             self._drain_master_spans_locked()
             self._absorb_batch_locked(bulk, req.get("spans") or ())
+            if bulk.gang_hosts and req.get("gang_id") is not None:
+                # gang single-writer commit: only member 0 of the LIVE
+                # gang at the CURRENT epoch may complete the task —
+                # a completion from an aborted epoch (the gang
+                # re-formed underneath a slow writer) or from a
+                # non-coordinator member is NACKed, never applied, so
+                # the sink commit is exactly-once per task
+                g = self._gang_for_req_locked(bulk, req, "FinishedWork")
+                if g is None or req.get("worker_id") != g.members[0]:
+                    if g is not None:
+                        _gang.count_stale_nack("FinishedWork")
+                    return {"ok": False, "revoked": True,
+                            "gang_stale": True}
+                # accepted: retire the gang — survivors' late acks are
+                # acknowledged via the retired map, and their held
+                # slots release here
+                bulk.gangs.pop(g.gang_id, None)
+                bulk.gang_by_task.pop(g.key, None)
+                bulk.gang_retired[g.gang_id] = g.epoch
+                while len(bulk.gang_retired) > 64:
+                    bulk.gang_retired.pop(
+                        next(iter(bulk.gang_retired)))
+                for m in g.members[1:]:
+                    if m not in g.acks:
+                        self._dec_held(bulk, m)
             # a completion only counts if this worker still holds the
             # assignment WITH the same attempt id — revoked
             # (timed-out/reassigned) attempts are ignored, the in-process
@@ -1024,42 +1486,32 @@ class Master:
                 return {"ok": True}
             strike_free = False
             if req.get("transient"):
-                tn = bulk.transient_failures.get(key, 0) + 1
-                bulk.transient_failures[key] = tn
-                recs.append({"t": "transient", "j": key[0],
-                             "k": key[1], "n": tn})
-                if tn <= MAX_TRANSIENT_FAILURES:
+                # past the cap, a "transient" failure that never stops
+                # isn't: fall through and strike like any other
+                if self._count_transient_locked(bulk, key, recs):
                     _M_TRANSIENT.inc()
                     _M_TASK_RETRIES.inc()
                     _mlog.warning(
                         "task (%d,%d) transient failure on worker %d "
                         "(%d/%d before strikes begin): %s — requeued "
                         "without a blacklist strike", key[0], key[1],
-                        req.get("worker_id", -1), tn,
+                        req.get("worker_id", -1),
+                        bulk.transient_failures[key],
                         MAX_TRANSIENT_FAILURES, err)
                     bulk.q_push(key, front=True)
                     strike_free = True
-                # past the cap, a "transient" failure that never stops
-                # isn't: fall through and strike like any other
             blacklisted_now = finished_now = False
             if not strike_free:
-                n = bulk.failures.get(key, 0) + 1
-                bulk.failures[key] = n
-                recs.append({"t": "strike", "j": key[0], "k": key[1],
-                             "n": n})
-                _M_STRIKES.inc()
+                # job blacklisting past the strike cap (reference
+                # master.cpp:2161-2191): one poison stream cannot sink
+                # the bulk job
+                blacklisted_now = self._count_strike_locked(
+                    bulk, key, err, recs)
                 _mlog.warning("task (%d,%d) failed on worker %d "
                               "(failure %d/%d): %s", key[0], key[1],
-                              req.get("worker_id", -1), n,
+                              req.get("worker_id", -1),
+                              bulk.failures[key],
                               MAX_TASK_FAILURES, err)
-                if n >= MAX_TASK_FAILURES:
-                    # job blacklisting (reference master.cpp:2161-2191):
-                    # one poison stream cannot sink the bulk job
-                    self._blacklist_job(bulk, key[0], err, recs=recs)
-                    blacklisted_now = True
-                else:
-                    bulk.q_push(key, front=True)
-                    _M_TASK_RETRIES.inc()
                 self._maybe_finish_bulk(bulk)
                 finished_now = bulk.finished
         # write-ahead: durable before the ack (outside the lock)
@@ -1159,8 +1611,23 @@ class Master:
                 if bulk is not None else None
             bulk_id = bulk.bulk_id if bulk is not None else None
             mem_reports = len(self._mem_reports)
+            # the Gang panel (docs/robustness.md §Gang scheduling):
+            # live gangs with their epoch fence + the forming pool
+            gang_panel = None
+            if bulk is not None and bulk.gang_hosts:
+                gang_panel = {
+                    "gang_hosts": bulk.gang_hosts,
+                    "epoch": bulk.gang_epoch,
+                    "forming": sorted(bulk.gang_forming),
+                    "live": [{"gang_id": g.gang_id, "epoch": g.epoch,
+                              "job": g.key[0], "task": g.key[1],
+                              "members": list(g.members),
+                              "coordinator": g.coordinator,
+                              "age_s": round(now - g.formed_at, 3)}
+                             for g in bulk.gangs.values()]}
         return {"role": "master", "workers": workers,
                 "bulk_id": bulk_id, "bulk": status,
+                "gang": gang_panel,
                 # the fencing epoch (docs/robustness.md §Durable
                 # control plane): fenced=True means a successor owns
                 # this db and every mutating RPC here is rejected
@@ -1417,7 +1884,7 @@ class Master:
             return
         dur = max(float(d.get("end") or 0.0)
                   - float(d.get("start") or 0.0), 0.0)
-        if name in ("task", "load", "evaluate", "save") \
+        if name in ("task", "load", "evaluate", "save", "gang") \
                 or name.startswith("evaluate:"):
             st = bulk.span_stats.setdefault(name, [0, 0.0, 0.0])
             st[0] += 1
@@ -1623,6 +2090,7 @@ class Master:
             "job_ntasks": {j: len(ts) for j, ts in bulk.job_tasks.items()},
             "job_output_rows": dict(bulk.job_output_rows),
             "sticky": bulk.sticky,
+            "gang_hosts": bulk.gang_hosts,
             "token": bulk.admission_token,
         }
 
@@ -1691,6 +2159,11 @@ class Master:
                 "committed_jobs": sorted(bulk.committed_jobs),
                 "error": bulk.error,
                 "token": bulk.admission_token,
+                # the gang fence's high-water mark: a successor must
+                # mint strictly higher epochs than any this master
+                # handed out (the journal's gang records cover the
+                # checkpoint window on top of this)
+                "gang_epoch": bulk.gang_epoch,
             }
             # cut INSIDE the state lock: a mutation not yet in this
             # snapshot can only be journaled after its (post-snapshot)
@@ -1813,6 +2286,17 @@ class Master:
                     bulk.error = str(r["error"])
             elif t == "commit":
                 bulk.committed_jobs.add(int(r["j"]))
+            elif t == "gang":
+                bulk.next_gang_id = max(bulk.next_gang_id,
+                                        int(r.get("g", 0)) + 1)
+        # gang-in-flight records restore the epoch fence's high-water
+        # mark (journal.gang_epoch_high_water — one fold shared with
+        # tooling): a successor's first formation mints a strictly
+        # higher epoch, so a pre-failover gang's late completion can
+        # never be confused with a live one's (no double-commit
+        # across the failover)
+        bulk.gang_epoch = max(bulk.gang_epoch,
+                              _journal.gang_epoch_high_water(records))
         return applied
 
     def _drop_recovery_source(self, g: Optional[int]) -> None:
@@ -1855,6 +2339,8 @@ class Master:
             checkpoint_frequency=state["checkpoint_frequency"],
             # pre-sticky checkpoints default off (missing key)
             sticky=bool(state.get("sticky", False)),
+            # pre-gang checkpoints default to independent pulls
+            gang_hosts=int(state.get("gang_hosts", 0) or 0),
             admission_token=str(state.get("token", "") or ""),
             # pre-crash spans are gone with the old process; post-
             # recovery assignments still assemble under one fresh trace
@@ -1890,6 +2376,9 @@ class Master:
                 bulk.blacklisted_jobs = set(prog["blacklisted_jobs"])
                 bulk.committed_jobs = set(prog["committed_jobs"])
                 bulk.error = prog.get("error", "")
+                bulk.gang_epoch = max(
+                    bulk.gang_epoch,
+                    int(prog.get("gang_epoch", 0) or 0))
         except Exception:  # noqa: BLE001
             # a corrupt progress file costs the snapshot, not the bulk:
             # the journal replay below still restores every record
@@ -1902,6 +2391,8 @@ class Master:
         # acknowledged after the last checkpoint — the records a plain
         # checkpoint-window restart would lose and re-execute
         applied = self._apply_journal_records(bulk, records)
+        if bulk.gang_hosts:
+            _gang.set_epoch(bulk.gang_epoch)
         if records:
             _mlog.info(
                 "journal replay: %d records across %d segments "
@@ -2075,7 +2566,8 @@ class Master:
                             "worker %d stale (%.1fs since heartbeat): "
                             "deactivating and requeueing its tasks",
                             w.worker_id, now - w.last_seen)
-                        self._requeue_worker_tasks(w.worker_id)
+                        self._requeue_worker_tasks(w.worker_id,
+                                                   recs=recs)
                 bulk = self._bulk
                 if bulk is not None and not bulk.finished:
                     # per-task timeout
@@ -2083,6 +2575,18 @@ class Master:
                         for key, (wid, t0, _a, started, _ed) in \
                                 list(bulk.outstanding.items()):
                             if now - t0 > bulk.task_timeout:
+                                gid = bulk.gang_by_task.get(key)
+                                if gid is not None:
+                                    # a timed-out gang is a lost/hung
+                                    # member set: abort the whole gang
+                                    # (epoch bump + strike-free requeue
+                                    # for a fresh gang), not a per-
+                                    # worker revocation
+                                    g = bulk.gangs.get(gid)
+                                    if g is not None:
+                                        self._abort_gang_locked(
+                                            bulk, g, "timeout", recs)
+                                    continue
                                 self._unassign(bulk, key)
                                 _M_REVOCATIONS.inc()
                                 _mlog.warning(
@@ -2094,19 +2598,9 @@ class Master:
                                     # artifact, not a task failure
                                     bulk.q_push(key, front=True)
                                     continue
-                                n = bulk.failures.get(key, 0) + 1
-                                bulk.failures[key] = n
-                                recs.append({"t": "strike",
-                                             "j": key[0], "k": key[1],
-                                             "n": n})
-                                _M_STRIKES.inc()
-                                if n >= MAX_TASK_FAILURES:
-                                    self._blacklist_job(
-                                        bulk, key[0], "task timeout",
-                                        recs=recs)
-                                else:
-                                    bulk.q_push(key, front=True)
-                                    _M_TASK_RETRIES.inc()
+                                self._count_strike_locked(
+                                    bulk, key, "task timeout",
+                                    recs=recs)
                         self._maybe_finish_bulk(bulk)
                     # no workers at all
                     if not any(w.active for w in self._workers.values()):
@@ -2118,6 +2612,33 @@ class Master:
                             bulk.mark_finished()
                     else:
                         self._no_worker_since = now
+                        # a gang bulk on a fleet whose live workers are
+                        # ALL gang-incapable (registered with no gang
+                        # address — SCANNER_TPU_GANG=0 / [gang]
+                        # enabled=false) would otherwise wait forever:
+                        # every pull answers "wait" and no formation
+                        # can ever happen.  Fail it loudly on the same
+                        # clock a worker-less bulk gets.
+                        if bulk.gang_hosts and not bulk.finished \
+                                and (bulk.q_has_work()
+                                     or bulk.outstanding):
+                            capable = any(
+                                w.active and w.gang_address
+                                for w in self._workers.values())
+                            if capable:
+                                bulk.gang_incapable_since = 0.0
+                            elif not bulk.gang_incapable_since:
+                                bulk.gang_incapable_since = now
+                            elif now - bulk.gang_incapable_since \
+                                    > self.no_workers_timeout:
+                                bulk.error = (
+                                    f"gang_hosts={bulk.gang_hosts} "
+                                    "but no gang-capable worker "
+                                    "joined within "
+                                    f"{self.no_workers_timeout}s "
+                                    "(fleet running with gang "
+                                    "scheduling disabled?)")
+                                bulk.mark_finished()
                 if bulk is not None and bulk.finished:
                     finished_bulk_id = bulk.bulk_id
                 if self.enable_watchdog and \
@@ -2141,10 +2662,21 @@ class Master:
                     # never kill the liveness scan
                     _mlog.exception("remediation tick failed")
 
-    def _requeue_worker_tasks(self, wid: int) -> None:
+    def _requeue_worker_tasks(self, wid: int,
+                              recs: Optional[List[dict]] = None) -> None:
         bulk = self._bulk
         if bulk is None or bulk.finished:
             return
+        # a dead/departing worker takes its gang memberships with it:
+        # abort those gangs first (epoch bump + strike-free requeue for
+        # a fresh gang on the survivors) — the dead worker may be a
+        # NON-coordinator member, invisible to the outstanding map
+        if recs is None:
+            recs = []
+        for g in list(bulk.gangs.values()):
+            if wid in g.members:
+                self._abort_gang_locked(bulk, g, "member_lost", recs)
+        bulk.gang_forming.pop(wid, None)
         for key, (owner, _t0, _a, _s, _ed) in list(bulk.outstanding.items()):
             if owner == wid:
                 self._unassign(bulk, key)
@@ -2229,6 +2761,10 @@ class Worker:
             # (reference worker-per-node topology, worker.cpp:484)
             from ..parallel.distributed import initialize
             initialize(coordinator)
+        # gang member runners re-derive the job from these
+        # (engine/gang.py: one child process per gang epoch)
+        self._db_path = db_path
+        self._storage_type = storage_type
         self.db = Database(make_storage(storage_type, db_path=db_path))
         self.profiler = Profiler(node="worker")
         # this worker's span sink: stage/op spans land here and ship to
@@ -2314,8 +2850,22 @@ class Worker:
         # pass the pod/host DNS name (deploy.py wires the pod name)
         self.advertise_address = \
             f"{advertise_host or 'localhost'}:{self.port}"
+        # the port this worker's gang runner would serve the
+        # jax.distributed coordinator at if elected member 0: reserved
+        # by a bind-and-release probe (the runner child binds it for
+        # real), advertised at registration so the master can mint
+        # rendezvous roles.  Empty when gang mode is disabled.
+        self._gang_address = ""
+        if _gang.enabled():
+            import socket as _socket
+            with _socket.socket() as _s:
+                _s.bind(("0.0.0.0", 0))
+                gport = _s.getsockname()[1]
+            self._gang_address = \
+                f"{advertise_host or 'localhost'}:{gport}"
         reg = self.master.call("RegisterWorker",
-                               address=self.advertise_address)
+                               address=self.advertise_address,
+                               gang_address=self._gang_address)
         if reg.get("worker_id") is None:
             # a FENCED (superseded) master answers an error reply:
             # fail startup loudly instead of KeyError-ing — this
@@ -2333,14 +2883,23 @@ class Worker:
         self._info = None
         self._jobs = None
         self._queue_size: Optional[int] = None
+        # gang mode (PerfParams.gang_hosts on the active bulk): the
+        # raw spec blob travels to member runner children verbatim
+        self._gang_hosts = 0
+        self._spec_raw: Optional[bytes] = None
+        self._task_timeout = 0.0
         self._default_pipeline_instances = pipeline_instances
         # evaluator instances reused across pipeline entries of one bulk
         self._evaluators: Dict[int, TaskEvaluator] = {}
         self._eval_lock = threading.Lock()
         self._posted_profiles: set = set()
         # heartbeat runs on its own thread so a long task never makes the
-        # master think this worker died (stale-worker scan)
+        # master think this worker died (stale-worker scan).  The
+        # receive timestamp lets gang liveness judgments require a
+        # beat FRESHER than the gang's formation — a stale reply must
+        # read as "unknown", never as "aborted".
         self._hb_reply: dict = {}
+        self._hb_reply_at = 0.0
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, name="worker-hb", daemon=True)
         self._hb_thread.start()
@@ -2412,6 +2971,7 @@ class Worker:
                         reg = self.master.try_call(
                             "RegisterWorker",
                             address=self.advertise_address,
+                            gang_address=self._gang_address,
                             timeout=PING_TIMEOUT)
                         # a FENCED master answers an error reply with
                         # no worker_id: stay on the old id and keep
@@ -2420,6 +2980,7 @@ class Worker:
                             self.worker_id = reg["worker_id"]
                 else:
                     self._hb_reply = hb
+                    self._hb_reply_at = time.time()
             time.sleep(PING_INTERVAL)
 
     def _rpc_shutdown(self, req: dict) -> dict:
@@ -2482,6 +3043,11 @@ class Worker:
             "draining": self._draining.is_set(),
             "preempting": self._preempting,
             "bulk_id": getattr(self, "_bulk_id", None),
+            # gang mode (engine/gang.py): the active bulk's requested
+            # gang size and the coordinator address this worker
+            # advertises for member-0 election
+            "gang_hosts": getattr(self, "_gang_hosts", 0),
+            "gang_address": getattr(self, "_gang_address", ""),
             "pipeline_instances": ex.pipeline_instances if ex else None,
             "num_load_workers": ex.num_load_workers if ex else None,
             "num_save_workers": ex.num_save_workers if ex else None,
@@ -2513,7 +3079,12 @@ class Worker:
                 continue
             try:
                 self._ensure_bulk(bulk_id)
-                self._pull_loop(bulk_id)
+                if self._gang_hosts > 0 and _gang.enabled():
+                    # gang mode: the bulk's tasks are co-scheduled
+                    # member runs, not independent pipeline pulls
+                    self._gang_loop(bulk_id)
+                else:
+                    self._pull_loop(bulk_id)
             except Exception:  # noqa: BLE001
                 # a pipeline-level failure (e.g. evaluator construction)
                 # must not kill this thread while the heartbeat keeps the
@@ -2575,12 +3146,18 @@ class Worker:
     def _ensure_bulk(self, bulk_id: int) -> None:
         if self._bulk_id == bulk_id:
             return
-        spec = cloudpickle.loads(
-            self.master.call("GetJob", bulk_id=bulk_id)["spec"])
+        raw = self.master.call("GetJob", bulk_id=bulk_id)["spec"]
+        spec = cloudpickle.loads(raw)
         # master created tables after our metadata cache was filled
         self.db.refresh_meta()
         outputs = spec["outputs"]
         perf = spec["perf"]
+        # gang mode latch + the verbatim spec blob member runner
+        # children re-derive the job from (engine/gang.py)
+        self._spec_raw = raw
+        self._gang_hosts = int(getattr(perf, "gang_hosts", 0) or 0)
+        self._task_timeout = float(getattr(perf, "task_timeout", 0.0)
+                                   or 0.0)
         # fresh profiler per bulk so PostProfile ships only this job's spans
         self.profiler = Profiler(
             node=f"worker{self.worker_id}",
@@ -2759,6 +3336,133 @@ class Worker:
                 on_eval_done=on_eval_done, on_task_error=on_task_error,
                 evaluator_factory=evaluator_factory, close_evaluators=False,
                 queue_size=self._queue_size)
+
+    # -- gang member path (engine/gang.py) ---------------------------------
+
+    def _next_gang(self, bulk_id: int):
+        """One gang-mode NextWork pull: a role reply dict, "wait", or
+        None (bulk over / draining).  A reply stamped by a stale master
+        generation is NACKed exactly like an ordinary assignment — a
+        superseded master must not be able to convene a gang."""
+        if self._draining.is_set():
+            return None
+        if self._hb_reply.get("active_bulk") != bulk_id:
+            return None
+        reply = self.master.try_call("NextWork",
+                                     worker_id=self.worker_id,
+                                     bulk_id=bulk_id, window=0)
+        if reply is not None and not self._gen.observe(reply):
+            return "wait"
+        if reply is None or reply.get("status") in (None, "none",
+                                                    "done"):
+            return None
+        if reply["status"] == "wait":
+            return "wait"
+        return reply
+
+    def _gang_loop(self, bulk_id: int) -> None:
+        """Drive gang member runs from the master's formation pool:
+        pull a role, run the member to completion in its own child
+        process, report, repeat.  One member at a time per worker —
+        a gang IS this node's unit of work."""
+        while not self._shutdown.is_set():
+            nxt = self._next_gang(bulk_id)
+            if nxt is None:
+                return
+            if nxt == "wait":
+                time.sleep(PING_INTERVAL / 4)
+                continue
+            try:
+                self._run_gang_member(bulk_id, nxt)
+            except Exception:  # noqa: BLE001 — a reporting failure
+                # must not kill the loop while the heartbeat keeps
+                # this worker looking alive
+                _wlog.exception("worker %d: gang member run failed",
+                                self.worker_id)
+                time.sleep(PING_INTERVAL)
+
+    def _run_gang_member(self, bulk_id: int, role: dict) -> None:
+        gid, epoch = role["gang_id"], role["epoch"]
+        pid = int(role["process_id"])
+        task_timeout = float(role.get("task_timeout")
+                             or self._task_timeout or 0.0)
+        request = {
+            "db_path": self._db_path,
+            "storage_type": self._storage_type,
+            "spec": self._spec_raw, "bulk_id": bulk_id,
+            "job_idx": role["job_idx"], "task_idx": role["task_idx"],
+            "attempt": role.get("attempt", 0),
+            "gang_id": gid, "epoch": epoch,
+            "process_id": pid,
+            "num_processes": int(role["num_processes"]),
+            "coordinator": role["coordinator"],
+            "init_timeout": _gang.init_timeout_s(),
+            "task_timeout": task_timeout,
+            "traceparent": role.get("traceparent"),
+            "node": f"worker{self.worker_id}",
+        }
+        _wlog.info(
+            "worker %d: gang %d epoch %d — member %d/%d for task "
+            "(%d,%d), coordinator %s", self.worker_id, gid, epoch, pid,
+            request["num_processes"], role["job_idx"],
+            role["task_idx"], role["coordinator"])
+        t_form = time.time()
+
+        def gang_alive() -> bool:
+            # heartbeat-fed gang liveness: only a beat provably SENT
+            # after the formation may testify — its receive time must
+            # clear t_form by the beat's own deadline (PING_TIMEOUT),
+            # so a reply that was in flight when the gang formed (or a
+            # stale reply held across a master hiccup) reads as
+            # "unknown" and never reaps a healthy runner.  A fresh
+            # beat whose per-worker gang list lacks this gang means it
+            # was aborted underneath us — reap now instead of blocking
+            # in a dead collective until the member timeout.
+            if self._hb_reply_at <= t_form + PING_TIMEOUT:
+                return True
+            hb = self._hb_reply
+            if "gangs" not in hb:
+                return True  # legacy master: no liveness feed
+            return gid in (hb.get("gangs") or ())
+
+        res = _gang.spawn_member(
+            request, timeout=_gang.member_timeout_s(task_timeout),
+            alive=gang_alive)
+        # the member's spans (task under the gang root, stages, ops)
+        # came back in the result file — ship them so the gang's whole
+        # story assembles under one trace on the master
+        spans = list(res.get("spans") or ()) + self.tracer.drain_export()
+        if spans:
+            self.master.try_call("ShipSpans", bulk_id=bulk_id,
+                                 worker_id=self.worker_id, spans=spans)
+        base = dict(bulk_id=bulk_id, worker_id=self.worker_id,
+                    job_idx=role["job_idx"],
+                    task_idx=role["task_idx"],
+                    attempt=role.get("attempt", 0),
+                    gang_id=gid, epoch=epoch)
+        if res.get("ok"):
+            # single-writer completion: member 0 carries the gang's
+            # FinishedWork; everyone else acks
+            if pid == 0:
+                reply = self.master.try_call("FinishedWork", **base)
+            else:
+                reply = self.master.try_call("GangMemberDone", **base)
+            if reply is not None and self._gen.observe(reply) \
+                    and reply.get("gang_stale"):
+                _wlog.warning(
+                    "worker %d: gang %d epoch %d completion NACKed as "
+                    "stale — the gang re-formed underneath this "
+                    "member", self.worker_id, gid, epoch)
+        else:
+            _wlog.warning(
+                "worker %d: gang %d epoch %d member %d failed at %s: "
+                "%s", self.worker_id, gid, epoch, pid,
+                res.get("stage"), res.get("error"))
+            self.master.try_call(
+                "GangFailed", **base,
+                stage=res.get("stage", "member"),
+                transient=bool(res.get("transient", True)),
+                error=str(res.get("error", "")))
 
     def wait_for_shutdown(self) -> None:
         while not self._shutdown.is_set():

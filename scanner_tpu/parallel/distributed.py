@@ -9,10 +9,19 @@ over `jax.devices()` then span hosts, and XLA routes collectives over
 ICI/DCN automatically.  Engine workers opt in via the `coordinator=`
 config (engine/service.py Worker), making a pod slice's hosts one logical
 accelerator for in-program dp/sp/tp sharding while the task engine keeps
-distributing (job, task) work units between programs.
+distributing (job, task) work units between programs.  Gang-scheduled
+tasks (engine/gang.py) rendezvous here too — one short-lived runtime per
+gang epoch, with `shutdown()` tearing the latch down between epochs so a
+surviving member can re-form at a NEW coordinator.
 
 Order matters: `initialize()` must run before the first JAX backend touch
 in the process.
+
+Failure classification: a rendezvous that does not complete raises
+`RendezvousError` — the engine treats it as TRANSIENT (the peer set
+changed under us: a member died, a coordinator moved), so the task
+requeues strike-free instead of striking a healthy job
+(engine/service.py `_is_transient_failure`).
 """
 
 from __future__ import annotations
@@ -22,6 +31,24 @@ from dataclasses import dataclass
 from typing import Dict, Optional, Sequence
 
 from ..common import ScannerException
+
+# default bound on how long initialize() may block in the rendezvous
+# when the caller passes no explicit timeout: an unbounded default
+# would let one lost peer pin every survivor in
+# jax.distributed.initialize forever.  300 s matches jax's own default
+# — long-lived pod-slice workers (Worker(coordinator=...), whose hosts
+# can legitimately come up minutes apart during a node-pool scale-up)
+# keep their full budget; gang members pass the much tighter
+# [gang] init_timeout_s per gang instead (engine/gang.py).
+DEFAULT_INIT_TIMEOUT_S = 300.0
+
+
+class RendezvousError(ScannerException):
+    """Joining (or re-joining) the multi-process runtime failed: the
+    coordinator is unreachable, a peer never arrived, or the bounded
+    initialization timeout elapsed.  Classified transient by the engine
+    — the gang re-forms on the remaining capacity, no blacklist
+    strike."""
 
 
 @dataclass
@@ -47,44 +74,105 @@ _init_config: Optional[CoordinatorConfig] = None
 def initialize(config: CoordinatorConfig,
                init_timeout: Optional[float] = None) -> None:
     """Join the multi-process JAX runtime (idempotent per process for the
-    SAME config; a different config after initialization is an error, not
-    a silent no-op).
+    SAME config; a different config while initialized is an error, not a
+    silent no-op — call `shutdown()` first to re-form at a new
+    coordinator).
 
     Must be called before any jax.devices()/computation in this process;
     afterwards `jax.devices()` is the global device list and
     `jax.local_devices()` this host's slice.  Meshes built by
     `make_mesh()` then span all hosts.
+
+    `init_timeout` bounds the rendezvous; None applies
+    DEFAULT_INIT_TIMEOUT_S — never unbounded, so one lost peer cannot
+    pin the survivors in initialize forever.  A failed or timed-out
+    rendezvous raises `RendezvousError` (transient to the engine).
     """
     global _init_config
     if _init_config is not None:
         if _init_config != config:
             raise ScannerException(
                 f"jax.distributed already initialized with {_init_config}; "
-                f"cannot re-initialize with {config}")
+                f"cannot re-initialize with {config} — call shutdown() "
+                f"first to rendezvous at a new coordinator")
         return
     import jax
+
+    # CPU-backend runs (tests, dryruns, chaos drills) need the gloo
+    # collectives client or every cross-process computation fails with
+    # "Multiprocess computations aren't implemented on the CPU
+    # backend".  Selected only when the process is pinned to CPU
+    # (JAX_PLATFORMS, as force_cpu_platform/cpu_only_env set) — TPU
+    # runtimes keep their native ICI/DCN collectives.
+    plats = (os.environ.get("JAX_PLATFORMS") or "").lower()
+    if "cpu" in [p.strip() for p in plats.split(",")]:
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:  # noqa: BLE001 — older/newer jax without
+            pass           # the flag: keep the default behavior
 
     kwargs = {}
     if config.local_device_ids is not None:
         kwargs["local_device_ids"] = list(config.local_device_ids)
-    if init_timeout is not None:
-        kwargs["initialization_timeout"] = int(init_timeout)
+    if init_timeout is None:
+        init_timeout = DEFAULT_INIT_TIMEOUT_S
+    kwargs["initialization_timeout"] = int(init_timeout)
     try:
         jax.distributed.initialize(
             coordinator_address=config.address,
             num_processes=config.num_processes,
             process_id=config.process_id,
             **kwargs)
-    except RuntimeError as e:
-        raise ScannerException(
+    except Exception as e:  # noqa: BLE001 — jax surfaces rendezvous
+        # failure as RuntimeError and timeouts as XlaRuntimeError
+        # (DEADLINE_EXCEEDED) depending on version; both are the same
+        # transient peer-set failure to the engine
+        raise RendezvousError(
             f"jax.distributed.initialize failed for "
             f"process {config.process_id}/{config.num_processes} at "
             f"{config.address}: {e}") from e
     _init_config = config
 
 
+def shutdown() -> None:
+    """Leave the multi-process runtime and RESET the re-init latch.
+
+    Before this existed, `_init_config` was set once per process and any
+    different config raised forever — a surviving gang member could
+    never rendezvous at a new coordinator after its gang aborted.  Now
+    the distributed client shuts down cleanly, the latch resets, and a
+    follow-up `initialize()` with a NEW config (new coordinator, new
+    num_processes) is legal.  Backend handles built over the old global
+    device set are cleared best-effort; gang members avoid the issue
+    entirely by running one process per epoch (engine/gang.py).
+    Idempotent; never raises."""
+    global _init_config
+    if _init_config is None:
+        return
+    try:
+        import jax
+        jax.distributed.shutdown()
+    except Exception:  # noqa: BLE001 — a dead coordinator must not
+        pass           # wedge the teardown path
+    try:
+        import jax
+        # drop cached backends so a later initialize() rebuilds the
+        # global device view for the NEW process set (deprecated alias
+        # on some versions; best-effort either way)
+        jax.clear_backends()
+    except Exception:  # noqa: BLE001
+        pass
+    _init_config = None
+
+
 def is_initialized() -> bool:
     return _init_config is not None
+
+
+def current_config() -> Optional[CoordinatorConfig]:
+    """The config this process is initialized with, or None."""
+    return _init_config
 
 
 def host_local_array(mesh, spec, local_data):

@@ -100,6 +100,16 @@ SITES = (
                           # a raise models a spot/preemptible reclaim
                           # notice -> Worker.preempt() routine drain
     "memory.pressure",    # engine/batch.py to_device staging, per h2d
+    "gang.rendezvous",    # engine/gang.py spawn_member, before the
+                          # member runner starts: raise models a member
+                          # that cannot join (transient GangFailed),
+                          # crash kills the host pre-rendezvous
+    "gang.collective",    # engine/gang.py spawn_member, fired the
+                          # moment the member's runner has rendezvoused
+                          # and enters the collective: crash = host
+                          # death mid-collective (the runner dies with
+                          # its worker via PR_SET_PDEATHSIG), raise =
+                          # collective failure reported transient
 )
 
 MODES = ("raise", "delay", "corrupt", "crash", "duplicate")
@@ -504,6 +514,14 @@ NAMED_PLANS = {
     "master-failover":
         "rpc.server.handle:crash:match=FinishedWork:n=4;"
         "rpc.client.call:duplicate:method=NewJob:n=1:times=1",
+    # the gang drill (docs/robustness.md §Gang scheduling): the armed
+    # worker dies the moment its first gang member enters the
+    # cross-host collective (the runner dies with it via pdeathsig) ->
+    # the gang aborts on member loss, the epoch bumps, and the task
+    # re-forms on the surviving workers with zero blacklist strikes;
+    # chaos_run.py runs a gang_hosts bulk under this plan and requires
+    # bit-exact output plus a reform at epoch+1
+    "gang-host-loss": "gang.collective:crash:n=1:times=1",
 }
 
 

@@ -552,10 +552,17 @@ def straggler_summary(span_dicts: Iterable[dict],
     slowest = []
     for dur, d in tasks[:top_n]:
         a = d.get("attrs") or {}
-        slowest.append({"job": a.get("job"), "task": a.get("task"),
-                        "seconds": round(dur, 4), "node": d.get("node"),
-                        "trace_id": d["trace_id"],
-                        "span_id": d["span_id"]})
+        row = {"job": a.get("job"), "task": a.get("task"),
+               "seconds": round(dur, 4), "node": d.get("node"),
+               "trace_id": d["trace_id"],
+               "span_id": d["span_id"]}
+        # gang member task spans carry their gang/epoch/member rank
+        # (engine/gang.py): surfacing them keeps a slow HOST inside a
+        # co-scheduled gang attributable, not just a slow task
+        if a.get("gang") is not None:
+            row["gang"] = a.get("gang")
+            row["member"] = a.get("member")
+        slowest.append(row)
     return {"per_stage": out_stages, "slowest_tasks": slowest}
 
 

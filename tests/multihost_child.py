@@ -7,6 +7,11 @@ Usage: python multihost_child.py <coordinator_port> <process_id> [n_procs]
 mode: "train" (default) or "crash" — crash exits(1) right after joining
 the runtime, simulating a host dying mid-job (the surviving ranks must
 fail or be killable, never complete wrongly).
+
+Every mode prints MULTIHOST_JOINED once the runtime rendezvous
+completes, so a launcher can kill a rank deterministically AFTER the
+group formed — the SIGKILL-mid-collective harness the gang scheduler's
+e2e drill reuses (spawn_multihost(sigkill_rank=...)).
 """
 
 import sys
@@ -23,7 +28,8 @@ def free_port() -> int:
 
 
 def spawn_multihost(n_processes: int = 2, devices_per_process: int = 4,
-                    timeout: float = 600.0, crash_rank=None, port=None):
+                    timeout: float = 600.0, crash_rank=None, port=None,
+                    sigkill_rank=None):
     """Launch n child processes running this script against one fresh
     coordinator and collect their stdout.  `timeout` bounds the WHOLE
     launch (shared deadline across children).  Kills the set on any
@@ -35,6 +41,10 @@ def spawn_multihost(n_processes: int = 2, devices_per_process: int = 4,
     that really happened, verifies no surviving rank completes
     successfully, and raises RuntimeError — the deterministic
     rank-death-fails-the-group proof.
+    sigkill_rank: that child runs NORMALLY but is SIGKILLed the moment
+    it prints MULTIHOST_JOINED — host death after the group formed,
+    with the victim's peers inside (or entering) the collective.  Same
+    verification and RuntimeError contract as crash_rank.
     port: explicit coordinator port (reuse across launches to prove a
     fresh group can bind where a failed one died)."""
     import os
@@ -59,8 +69,50 @@ def spawn_multihost(n_processes: int = 2, devices_per_process: int = 4,
     def remaining() -> float:
         return max(0.1, deadline - time.time())
 
+    def _assert_group_failed(victim_rank: int) -> None:
+        """Survivors must never complete successfully; hanging in the
+        collective (until our kill) and erroring out are both
+        acceptable failure shapes."""
+        grace = time.time() + 15
+        for i, p in enumerate(procs):
+            if i == victim_rank:
+                continue
+            try:
+                o, _e = p.communicate(
+                    timeout=max(0.1, grace - time.time()))
+                if p.returncode == 0:
+                    raise AssertionError(
+                        f"rank {i} completed despite peer death:\n{o}")
+            except subprocess.TimeoutExpired:
+                pass  # blocked in the collective: expected
+        raise RuntimeError(
+            "rank death confirmed: group did not complete")
+
     outs = []
     try:
+        if sigkill_rank is not None:
+            import threading
+
+            pk = procs[sigkill_rank]
+            joined_ev = threading.Event()
+
+            def _watch_join() -> None:
+                # a reader thread: the blocking readline must not be
+                # able to defeat the whole-launch timeout when the
+                # victim wedges silently before printing anything
+                for line in pk.stdout:
+                    if "MULTIHOST_JOINED" in line:
+                        joined_ev.set()
+                        return
+
+            wt = threading.Thread(target=_watch_join, daemon=True)
+            wt.start()
+            if not joined_ev.wait(timeout=remaining()):
+                raise AssertionError(
+                    "sigkill victim never joined the runtime")
+            pk.kill()  # SIGKILL: host death after the group formed
+            pk.wait()
+            _assert_group_failed(sigkill_rank)
         if crash_rank is not None:
             pc = procs[crash_rank]
             out, err = pc.communicate(timeout=remaining())
@@ -68,23 +120,7 @@ def spawn_multihost(n_processes: int = 2, devices_per_process: int = 4,
                 raise AssertionError(
                     f"crash child did not die after joining: "
                     f"rc={pc.returncode}\n{out}\n{err}")
-            # survivors must never complete successfully; hanging in the
-            # collective (until our kill) and erroring out are both
-            # acceptable failure shapes
-            grace = time.time() + 15
-            for i, p in enumerate(procs):
-                if i == crash_rank:
-                    continue
-                try:
-                    o, e = p.communicate(
-                        timeout=max(0.1, grace - time.time()))
-                    if p.returncode == 0:
-                        raise AssertionError(
-                            f"rank {i} completed despite peer death:\n{o}")
-                except subprocess.TimeoutExpired:
-                    pass  # blocked in the collective: expected
-            raise RuntimeError(
-                "rank death confirmed: group did not complete")
+            _assert_group_failed(crash_rank)
         for p in procs:
             out, err = p.communicate(timeout=remaining())
             if p.returncode != 0:
@@ -108,9 +144,11 @@ def main() -> None:
 
     import jax
     assert jax.process_count() == n_procs, jax.process_count()
+    # every mode announces the rendezvous: launchers key deterministic
+    # rank kills off this line (spawn_multihost sigkill_rank)
+    print("MULTIHOST_JOINED", flush=True)
     if mode == "crash":
         # simulate this host dying mid-job, after the group is formed
-        print("MULTIHOST_JOINED", flush=True)
         sys.exit(1)
 
     from scanner_tpu.models import make_sharded_train_step

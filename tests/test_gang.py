@@ -1,0 +1,642 @@
+"""Gang-scheduled multi-host execution (docs/robustness.md §Gang
+scheduling; scanner_tpu/engine/gang.py + engine/service.py).
+
+Layers:
+  * pure units — shard math, digest determinism, journal gang-record
+    helpers;
+  * in-process master units — formation + role minting, the
+    synthetic-clock form-timeout path (a smaller gang forms on the
+    pooled survivors), stale-epoch NACKs on BOTH sides (master refuses
+    stale member reports; the worker refuses a stale master's gang
+    assignment), abort-on-{GangFailed, preemption, worker loss,
+    task timeout}, the transient-cap backstop, and journal round-trip +
+    master-failover-mid-gang recovery with no double-commit;
+  * spawned e2e (slow) — a real gang bulk over a spawned cluster with
+    one member SIGKILLed mid-collective (the `gang-host-loss` plan):
+    the gang re-forms at a higher epoch on the survivors, output is
+    bit-exact, zero strikes; plus the jax-level rank-death + re-form
+    harness reusing tests/multihost_child.py.
+"""
+
+import os
+import struct
+import subprocess
+import sys
+import time
+
+import cloudpickle
+import pytest
+
+from scanner_tpu import (CacheMode, Client, Kernel, NamedStream,
+                         PerfParams, register_op)
+from scanner_tpu.engine import gang as egang
+from scanner_tpu.engine import journal
+from scanner_tpu.engine.service import (MASTER_SERVICE,
+                                        MAX_TASK_FAILURES,
+                                        MAX_TRANSIENT_FAILURES, Master,
+                                        Worker)
+from scanner_tpu.util import faults
+from scanner_tpu.util import metrics as _mx
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+pytestmark = pytest.mark.chaos
+
+N_ROWS = 8
+
+
+def _pk(v: int) -> bytes:
+    return struct.pack("<q", v)
+
+
+@register_op(name="GangDouble")
+class GangDouble(Kernel):
+    def execute(self, x: bytes) -> bytes:
+        return _pk(2 * struct.unpack("<q", x)[0])
+
+
+def _counter(name: str, **labels) -> float:
+    entry = _mx.registry().snapshot().get(name, {})
+    if labels:
+        for s in entry.get("samples", []):
+            if s["labels"] == labels:
+                return s["value"]
+        return 0.0
+    return sum(s["value"] for s in entry.get("samples", []))
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# pure units
+# ---------------------------------------------------------------------------
+
+def test_shard_range_partition():
+    """Shards are contiguous, disjoint, and cover [0, n) for any
+    (n, num_processes) — the per-host digest staging keys off this."""
+    for n in (0, 1, 5, 8, 17):
+        for procs in (1, 2, 3, 4, 7):
+            spans = [egang.shard_range(n, p, procs)
+                     for p in range(procs)]
+            assert spans[0][0] == 0
+            assert spans[-1][1] == n
+            for (a_lo, a_hi), (b_lo, b_hi) in zip(spans, spans[1:]):
+                assert a_hi == b_lo and a_lo <= a_hi
+
+
+def test_digest_rows_deterministic():
+    rows = [b"abc", b"def", bytearray(b"ghi")]
+    assert egang._digest_rows(rows) == egang._digest_rows(list(rows))
+    assert egang._digest_rows([b"abc"]) != egang._digest_rows([b"abd"])
+    import numpy as np
+    arr_rows = [np.arange(4, dtype=np.int32), np.ones((2, 2))]
+    assert egang._digest_rows(arr_rows) == egang._digest_rows(arr_rows)
+    # shard sums compose: sum of shard digests == digest accumulated
+    # over all rows (mod 2**32), which is what member 0 cross-checks
+    full = egang._digest_rows(rows)
+    lo, hi = egang.shard_range(len(rows), 0, 2)
+    lo2, hi2 = egang.shard_range(len(rows), 1, 2)
+    assert (egang._digest_rows(rows[lo:hi])
+            + egang._digest_rows(rows[lo2:hi2])) & 0xFFFFFFFF == full
+
+
+def test_journal_gang_epoch_high_water():
+    recs = [{"t": "done", "j": 0, "k": 1},
+            {"t": "gang", "g": 0, "e": 3, "j": 0, "k": 2},
+            {"t": "gang_abort", "g": 0, "e": 3},
+            {"t": "gang", "g": 1, "e": 5, "j": 0, "k": 2}]
+    assert journal.gang_epoch_high_water(recs) == 5
+    assert journal.gang_epoch_high_water([]) == 0
+
+
+# ---------------------------------------------------------------------------
+# in-process master units
+# ---------------------------------------------------------------------------
+
+def _seed_db(tmp_path):
+    db_path = str(tmp_path / "db")
+    sc = Client(db_path=db_path)
+    sc.new_table("gang_src", ["output"],
+                 [[_pk(100 + i)] for i in range(N_ROWS)])
+    return sc, db_path
+
+
+def _spec_blob(sc, out_name, gang_hosts=2, **perf_kw):
+    col = sc.io.Input([NamedStream(sc, "gang_src")])
+    col = sc.ops.GangDouble(x=col)
+    out = NamedStream(sc, out_name)
+    node = sc.io.Output(col, [out])
+    return cloudpickle.dumps({
+        "outputs": [node],
+        "perf": PerfParams.manual(2, 4, gang_hosts=gang_hosts,
+                                  **perf_kw),
+        "cache_mode": CacheMode.Overwrite.value})
+
+
+def _register(master, n, base_port=7100):
+    return [master._rpc_register_worker(
+        {"address": "", "gang_address": f"localhost:{base_port + i}"}
+    )["worker_id"] for i in range(n)]
+
+
+def _form(master, bid, wids):
+    """Pull until a gang forms; returns {wid: role} for every member."""
+    roles = {}
+    deadline = time.time() + 10
+    while time.time() < deadline and len(roles) < len(wids):
+        for wid in wids:
+            r = master._rpc_next_work({"worker_id": wid,
+                                       "bulk_id": bid})
+            if r.get("status") == "gang":
+                roles[wid] = r
+        if not roles:
+            time.sleep(0.02)
+    assert roles, "no gang formed"
+    return roles
+
+
+def test_gang_formation_roles_and_coordinator(tmp_path):
+    sc, db_path = _seed_db(tmp_path)
+    m = Master(db_path=db_path, no_workers_timeout=60.0)
+    try:
+        w0, w1 = _register(m, 2)
+        bid = m._rpc_new_job({"spec": _spec_blob(sc, "g_form"),
+                              "token": "t"})["bulk_id"]
+        # first pull pools; second completes the gang; both get roles
+        r0 = m._rpc_next_work({"worker_id": w0, "bulk_id": bid})
+        assert r0["status"] == "wait"
+        roles = _form(m, bid, [w0, w1])
+        a, b = roles[w0], roles[w1]
+        assert a["gang_id"] == b["gang_id"] and a["epoch"] == b["epoch"]
+        assert {a["process_id"], b["process_id"]} == {0, 1}
+        assert a["num_processes"] == 2
+        # member 0's advertised gang address coordinates
+        m0 = w0 if a["process_id"] == 0 else w1
+        with m._lock:
+            g = m._bulk.gangs[a["gang_id"]]
+            assert g.members[0] == m0
+            assert a["coordinator"] == \
+                m._workers[m0].gang_address
+        # the gang root span context is shared by every member
+        assert a["traceparent"] == b["traceparent"]
+        assert _counter("scanner_tpu_gang_formed_total") >= 1
+    finally:
+        m.stop()
+        sc.stop()
+
+
+def test_form_timeout_forms_smaller_gang(tmp_path):
+    """The loss-tolerant path: gang_hosts=3 but only one worker is
+    pooled — after [gang] form_timeout_s the master forms a singleton
+    gang instead of waiting for capacity that is gone."""
+    sc, db_path = _seed_db(tmp_path)
+    old = egang.form_timeout_s()
+    egang.set_form_timeout_s(0.05)
+    m = Master(db_path=db_path, no_workers_timeout=60.0)
+    try:
+        (w0,) = _register(m, 1)
+        bid = m._rpc_new_job({"spec": _spec_blob(sc, "g_small",
+                                                 gang_hosts=3),
+                              "token": "t"})["bulk_id"]
+        r = m._rpc_next_work({"worker_id": w0, "bulk_id": bid})
+        assert r["status"] == "wait"  # pool opened this instant
+        time.sleep(0.1)
+        r = m._rpc_next_work({"worker_id": w0, "bulk_id": bid})
+        assert r["status"] == "gang", r
+        assert r["num_processes"] == 1 and r["process_id"] == 0
+    finally:
+        egang.set_form_timeout_s(old)
+        m.stop()
+        sc.stop()
+
+
+def test_stale_epoch_nack_master_side(tmp_path):
+    """Every gang RPC is fenced by (gang_id, epoch): stale member
+    reports — completion, ack, failure — answer gang_stale and are
+    never applied."""
+    sc, db_path = _seed_db(tmp_path)
+    m = Master(db_path=db_path, no_workers_timeout=60.0)
+    try:
+        w0, w1 = _register(m, 2)
+        bid = m._rpc_new_job({"spec": _spec_blob(sc, "g_nack"),
+                              "token": "t"})["bulk_id"]
+        roles = _form(m, bid, [w0, w1])
+        r = roles[w0]
+        m0 = w0 if roles[w0]["process_id"] == 0 else w1
+        m1 = w1 if m0 == w0 else w0
+        base = dict(bulk_id=bid, gang_id=r["gang_id"],
+                    job_idx=r["job_idx"], task_idx=r["task_idx"],
+                    attempt=r["attempt"])
+        nacks0 = _counter("scanner_tpu_gang_stale_nacks_total")
+        # stale epoch on every gang RPC -> NACK, state untouched
+        stale = dict(base, epoch=r["epoch"] - 1)
+        assert m._rpc_gang_member_done(
+            dict(stale, worker_id=m1)).get("gang_stale")
+        assert m._rpc_gang_failed(
+            dict(stale, worker_id=m1,
+                 transient=True)).get("gang_stale")
+        assert m._rpc_finished_work(
+            dict(stale, worker_id=m0)).get("gang_stale")
+        # a non-coordinator member may not complete the task, even at
+        # the live epoch (single-writer commit)
+        assert m._rpc_finished_work(
+            dict(base, epoch=r["epoch"],
+                 worker_id=m1)).get("gang_stale")
+        assert _counter("scanner_tpu_gang_stale_nacks_total") \
+            >= nacks0 + 4
+        with m._lock:
+            assert not m._bulk.done
+            assert r["gang_id"] in m._bulk.gangs
+        # the live writer's completion lands
+        ok = m._rpc_finished_work(dict(base, epoch=r["epoch"],
+                                       worker_id=m0))
+        assert ok == {"ok": True}
+        # a survivor's ack AFTER the writer committed is acknowledged
+        # quietly (the healthy tail), not counted as fence traffic
+        tail = m._rpc_gang_member_done(dict(base, epoch=r["epoch"],
+                                            worker_id=m1))
+        assert tail == {"ok": True}
+        with m._lock:
+            assert (r["job_idx"], r["task_idx"]) in m._bulk.done
+            assert not m._bulk.held
+    finally:
+        m.stop()
+        sc.stop()
+
+
+def test_stale_master_gang_assignment_nacked_worker_side(tmp_path):
+    """The worker side of 'both sides': a gang role stamped by a
+    superseded master generation is NACKed by the worker's latch — a
+    stale master cannot convene a gang."""
+    _sc, db_path = _seed_db(tmp_path)
+    _sc.stop()
+    master = Master(db_path=db_path, no_workers_timeout=60.0)
+    worker = Worker(f"localhost:{master.port}", db_path=db_path)
+    try:
+        gen = master.generation
+        orig = worker.master.try_call
+
+        def fake(method, timeout=None, retries=None, **kw):
+            if method == "Heartbeat":
+                return {"reregister": False, "active_bulk": 7,
+                        "generation": gen + 1}
+            if method == "NextWork":
+                # the stale master still hands out gang roles
+                return {"status": "gang", "gang_id": 0, "epoch": 1,
+                        "process_id": 0, "num_processes": 2,
+                        "coordinator": "localhost:1", "job_idx": 0,
+                        "task_idx": 0, "attempt": 0,
+                        "generation": gen}
+            return orig(method, timeout=timeout, retries=retries,
+                        **kw)
+
+        worker.master.try_call = fake
+        deadline = time.time() + 10
+        while time.time() < deadline and worker._gen.highest() <= gen:
+            time.sleep(0.05)
+        base = _counter("scanner_tpu_stale_master_rejections_total",
+                        side="worker")
+        worker._hb_reply = {"active_bulk": 7, "generation": gen + 1}
+        assert worker._next_gang(7) == "wait", \
+            "stale-generation gang role was accepted"
+        assert _counter("scanner_tpu_stale_master_rejections_total",
+                        side="worker") > base
+    finally:
+        worker.stop()
+        master.stop()
+
+
+def test_gang_failed_aborts_and_reforms_at_higher_epoch(tmp_path):
+    sc, db_path = _seed_db(tmp_path)
+    m = Master(db_path=db_path, no_workers_timeout=60.0)
+    try:
+        w0, w1 = _register(m, 2)
+        bid = m._rpc_new_job({"spec": _spec_blob(sc, "g_reform"),
+                              "token": "t"})["bulk_id"]
+        roles = _form(m, bid, [w0, w1])
+        r = roles[w0]
+        strikes0 = _counter("scanner_tpu_blacklist_strikes_total")
+        aborted0 = _counter("scanner_tpu_gang_aborted_total",
+                            reason="member_failed:collective")
+        ok = m._rpc_gang_failed({
+            "worker_id": w1, "bulk_id": bid, "gang_id": r["gang_id"],
+            "epoch": r["epoch"], "stage": "collective",
+            "transient": True, "error": "peer lost"})
+        assert ok == {"ok": True}
+        with m._lock:
+            b = m._bulk
+            assert not b.gangs and not b.outstanding and not b.held
+            assert b.gang_epoch == r["epoch"] + 1
+        assert _counter("scanner_tpu_gang_aborted_total",
+                        reason="member_failed:collective") \
+            == aborted0 + 1
+        # zero strikes on the survivors (strike-free requeue)
+        assert _counter("scanner_tpu_blacklist_strikes_total") \
+            == strikes0
+        # re-formation runs at a strictly higher epoch and counts as a
+        # reform
+        reforms0 = _counter("scanner_tpu_gang_reforms_total")
+        roles2 = _form(m, bid, [w0, w1])
+        r2 = next(iter(roles2.values()))
+        assert r2["epoch"] > r["epoch"]
+        assert _counter("scanner_tpu_gang_reforms_total") \
+            == reforms0 + 1
+    finally:
+        m.stop()
+        sc.stop()
+
+
+def test_preemption_notice_aborts_member_gang(tmp_path):
+    sc, db_path = _seed_db(tmp_path)
+    m = Master(db_path=db_path, no_workers_timeout=60.0)
+    try:
+        w0, w1 = _register(m, 2)
+        bid = m._rpc_new_job({"spec": _spec_blob(sc, "g_preempt"),
+                              "token": "t"})["bulk_id"]
+        roles = _form(m, bid, [w0, w1])
+        r = roles[w0]
+        hb = m._rpc_heartbeat({"worker_id": w1, "preempting": True})
+        # the preempted worker's gang is gone from its liveness list
+        assert hb.get("gangs") == []
+        with m._lock:
+            assert not m._bulk.gangs
+            assert m._bulk.gang_epoch == r["epoch"] + 1
+        assert _counter("scanner_tpu_gang_aborted_total",
+                        reason="preempted") >= 1
+    finally:
+        m.stop()
+        sc.stop()
+
+
+def test_worker_loss_aborts_member_gang(tmp_path):
+    """A dead NON-coordinator member is invisible to the outstanding
+    map (member 0 owns the assignment) — the requeue path must still
+    abort the gang via membership."""
+    sc, db_path = _seed_db(tmp_path)
+    m = Master(db_path=db_path, no_workers_timeout=60.0)
+    try:
+        w0, w1 = _register(m, 2)
+        bid = m._rpc_new_job({"spec": _spec_blob(sc, "g_loss"),
+                              "token": "t"})["bulk_id"]
+        roles = _form(m, bid, [w0, w1])
+        r = roles[w0]
+        m1 = w1 if roles[w1]["process_id"] != 0 else w0  # non-coord
+        with m._lock:
+            m._workers[m1].active = False
+            m._requeue_worker_tasks(m1)
+            b = m._bulk
+            assert not b.gangs
+            assert b.gang_epoch == r["epoch"] + 1
+            assert b.q_has_work() and not b.outstanding and not b.held
+        assert _counter("scanner_tpu_gang_aborted_total",
+                        reason="member_lost") >= 1
+    finally:
+        m.stop()
+        sc.stop()
+
+
+def test_gang_task_timeout_aborts_whole_gang(tmp_path):
+    sc, db_path = _seed_db(tmp_path)
+    m = Master(db_path=db_path, no_workers_timeout=60.0)
+    try:
+        w0, w1 = _register(m, 2)
+        bid = m._rpc_new_job(
+            {"spec": _spec_blob(sc, "g_tmo", task_timeout=0.6),
+             "token": "t"})["bulk_id"]
+        roles = _form(m, bid, [w0, w1])
+        r = roles[w0]
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            with m._lock:
+                if not m._bulk.gangs:
+                    break
+            time.sleep(0.1)
+        with m._lock:
+            assert not m._bulk.gangs, "timeout scan never aborted"
+            assert m._bulk.gang_epoch >= r["epoch"] + 1
+        assert _counter("scanner_tpu_gang_aborted_total",
+                        reason="timeout") >= 1
+    finally:
+        m.stop()
+        sc.stop()
+
+
+def test_gang_abort_cap_terminates_bulk(tmp_path):
+    """A gang that can never complete must not re-form forever: past
+    the transient cap, aborts start striking and the job blacklists —
+    the bulk terminates with an error instead of spinning."""
+    sc, db_path = _seed_db(tmp_path)
+    old = egang.form_timeout_s()
+    egang.set_form_timeout_s(0.01)
+    m = Master(db_path=db_path, no_workers_timeout=60.0)
+    try:
+        (w0,) = _register(m, 1)
+        bid = m._rpc_new_job({"spec": _spec_blob(sc, "g_cap",
+                                                 gang_hosts=1),
+                              "token": "t"})["bulk_id"]
+        for _ in range(MAX_TRANSIENT_FAILURES + MAX_TASK_FAILURES + 2):
+            r = None
+            deadline = time.time() + 5
+            while r is None and time.time() < deadline:
+                got = m._rpc_next_work({"worker_id": w0,
+                                        "bulk_id": bid})
+                if got.get("status") == "gang":
+                    r = got
+                elif got.get("status") in ("none", "done"):
+                    r = "over"
+                else:
+                    time.sleep(0.01)
+            if r == "over" or r is None:
+                break
+            m._rpc_gang_failed({
+                "worker_id": w0, "bulk_id": bid,
+                "gang_id": r["gang_id"], "epoch": r["epoch"],
+                "stage": "rendezvous", "transient": True,
+                "error": "never forms"})
+        with m._lock:
+            b = m._bulk
+            assert b.finished and b.blacklisted_jobs == {0}
+            assert "exhausted" in b.error
+    finally:
+        egang.set_form_timeout_s(old)
+        m.stop()
+        sc.stop()
+
+
+def test_gang_journal_roundtrip_and_failover_no_double_commit(tmp_path):
+    """Master failover mid-gang: the successor restores the done-set
+    AND the gang epoch's high-water mark from the journal; the
+    pre-failover gang's completion NACKs on the successor (no
+    double-commit), the in-flight task re-forms and completes."""
+    sc, db_path = _seed_db(tmp_path)
+    m1 = Master(db_path=db_path, no_workers_timeout=60.0)
+    w0, w1 = _register(m1, 2)
+    bid = m1._rpc_new_job({"spec": _spec_blob(sc, "g_fo"),
+                           "token": "tok-G"})["bulk_id"]
+    # gang A completes its task (the done record + gang record journal)
+    roles = _form(m1, bid, [w0, w1])
+    ra = roles[w0]
+    m0a = w0 if roles[w0]["process_id"] == 0 else w1
+    assert m1._rpc_finished_work({
+        "worker_id": m0a, "bulk_id": bid, "gang_id": ra["gang_id"],
+        "epoch": ra["epoch"], "job_idx": ra["job_idx"],
+        "task_idx": ra["task_idx"],
+        "attempt": ra["attempt"]}) == {"ok": True}
+    # gang B forms and is IN FLIGHT when the master dies
+    roles_b = _form(m1, bid, [w0, w1])
+    rb = roles_b[w0]
+    m0b = w0 if roles_b[w0]["process_id"] == 0 else w1
+    m1.stop()  # abrupt: no checkpoint clear
+
+    m2 = Master(db_path=db_path, no_workers_timeout=60.0)
+    try:
+        with m2._lock:
+            b = m2._bulk
+            assert b is not None and b.bulk_id == bid
+            assert b.gang_hosts == 2
+            # journaled completion restored, in-flight task requeued
+            assert (ra["job_idx"], ra["task_idx"]) in b.done
+            assert (rb["job_idx"], rb["task_idx"]) not in b.done
+            assert b.q_has_work()
+            # epoch fence restored at or above gang B's epoch
+            assert b.gang_epoch >= rb["epoch"]
+            done0 = len(b.done)
+        # the pre-failover writer's late completion NACKs: no gang with
+        # that (gang_id, epoch) exists on the successor
+        late = m2._rpc_finished_work({
+            "worker_id": m0b, "bulk_id": bid,
+            "gang_id": rb["gang_id"], "epoch": rb["epoch"],
+            "job_idx": rb["job_idx"], "task_idx": rb["task_idx"],
+            "attempt": rb["attempt"]})
+        assert late.get("gang_stale"), late
+        with m2._lock:
+            assert len(m2._bulk.done) == done0, "double-commit!"
+        # fresh workers re-form the task at a strictly higher epoch
+        # and complete it exactly once
+        v0, v1 = _register(m2, 2)
+        roles_c = _form(m2, bid, [v0, v1])
+        rc = roles_c[v0]
+        assert rc["epoch"] > rb["epoch"]
+        m0c = v0 if roles_c[v0]["process_id"] == 0 else v1
+        assert m2._rpc_finished_work({
+            "worker_id": m0c, "bulk_id": bid,
+            "gang_id": rc["gang_id"], "epoch": rc["epoch"],
+            "job_idx": rc["job_idx"], "task_idx": rc["task_idx"],
+            "attempt": rc["attempt"]}) == {"ok": True}
+        with m2._lock:
+            assert len(m2._bulk.done) == done0 + 1
+    finally:
+        m2.stop()
+        sc.stop()
+
+
+# ---------------------------------------------------------------------------
+# spawned e2e (slow)
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_gang_e2e_host_loss_reforms_bit_exact(tmp_path):
+    """The headline drill as a test: a spawned master + 2 workers run a
+    gang bulk; worker 0 dies the moment its first member enters the
+    cross-host collective (gang-host-loss plan; the runner dies with it
+    via pdeathsig).  The gang must abort, re-form at a higher epoch on
+    the survivor, and the output must be bit-exact — with zero
+    blacklist strikes."""
+    from scanner_tpu.engine.rpc import wait_for_server
+    from scanner_tpu.util.jaxenv import cpu_only_env
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    db_path = str(tmp_path / "db")
+    seed = Client(db_path=db_path)
+    seed.new_table("gang_src", ["output"],
+                   [[_pk(100 + i)] for i in range(N_ROWS)])
+    env = cpu_only_env()
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("SCANNER_TPU_FAULTS", None)
+    env["SCANNER_TPU_GANG_INIT_TIMEOUT"] = "30"
+    env["SCANNER_TPU_GANG_FORM_TIMEOUT"] = "6"
+    port = _free_port()
+    addr = f"localhost:{port}"
+
+    def spawn(script, argv, plan=None):
+        e = dict(env)
+        if plan:
+            e["SCANNER_TPU_FAULTS"] = plan
+        return subprocess.Popen(
+            [sys.executable, os.path.join(repo, "tests", script),
+             *argv], env=e)
+
+    procs = [spawn("spawn_master.py", [db_path, str(port)])]
+    procs.append(spawn("spawn_worker.py", [addr, db_path],
+                       plan=faults.NAMED_PLANS["gang-host-loss"]))
+    procs.append(spawn("spawn_worker.py", [addr, db_path]))
+    sc = None
+    try:
+        wait_for_server(addr, MASTER_SERVICE, timeout=60.0)
+        sc = Client(db_path=db_path, master=addr)
+        deadline = time.time() + 60
+        while time.time() < deadline \
+                and sc.job_status().get("num_workers", 0) < 2:
+            time.sleep(0.25)
+        col = sc.io.Input([NamedStream(sc, "gang_src")])
+        col = sc.ops.GangDouble(x=col)
+        out = NamedStream(sc, "gang_out")
+        sc.run(sc.io.Output(col, [out]),
+               PerfParams.manual(4, N_ROWS // 2, gang_hosts=2),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+        rows = [bytes(r) for r in out.load()]
+        assert rows == [_pk(2 * (100 + i)) for i in range(N_ROWS)]
+        # the armed worker died with the injected crash code
+        time.sleep(0.5)
+        crashed = [p for p in procs
+                   if p.poll() == faults.CRASH_EXIT_CODE]
+        assert crashed, "gang.collective crash never fired"
+        snap = sc.metrics()
+
+        def tot(name):
+            return sum(s.get("value", 0) for s in
+                       snap.get(name, {}).get("samples", []))
+
+        assert tot("scanner_tpu_gang_aborted_total") >= 1
+        assert tot("scanner_tpu_gang_reforms_total") >= 1
+        assert tot("scanner_tpu_gang_epoch") >= 2
+        assert tot("scanner_tpu_blacklist_strikes_total") == 0
+    finally:
+        if sc is not None:
+            sc.stop()
+        seed.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+
+@pytest.mark.slow
+def test_multihost_sigkill_then_reform_same_port():
+    """The jax-level loss-tolerant re-forming proof, reusing the
+    tests/multihost_child.py harness: SIGKILL one rank mid-collective
+    (after it joined the runtime) — the group must never complete —
+    then a FRESH, smaller group re-forms on the SAME coordinator port
+    and completes (what a re-formed gang epoch does)."""
+    from multihost_child import free_port, spawn_multihost
+
+    port = free_port()
+    with pytest.raises(RuntimeError, match="rank death confirmed"):
+        spawn_multihost(n_processes=2, devices_per_process=2,
+                        timeout=240, sigkill_rank=1, port=port)
+    outs = spawn_multihost(n_processes=1, devices_per_process=2,
+                           timeout=240, port=port)
+    assert any("MULTIHOST_LOSS" in o for o in outs)

@@ -10,6 +10,63 @@ from scanner_tpu.parallel import (auto_axes, make_mesh, make_ring_attention,
                                   shard_batch, temporal_diff)
 
 
+def test_distributed_shutdown_resets_reinit_latch(monkeypatch):
+    """The gang-survivor fix: `_init_config` used to latch once per
+    process and any different config raised forever — a member of an
+    aborted gang could never rendezvous at a NEW coordinator.
+    shutdown() resets the latch (and tears the distributed client
+    down); a follow-up initialize with a different config is legal."""
+    from scanner_tpu.parallel import distributed as dist
+
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    monkeypatch.setattr(jax.distributed, "shutdown",
+                        lambda: calls.append("shutdown"))
+    monkeypatch.setattr(jax, "clear_backends",
+                        lambda: None, raising=False)
+    monkeypatch.setattr(dist, "_init_config", None)
+    a = dist.CoordinatorConfig("localhost:1", 2, 0)
+    b = dist.CoordinatorConfig("localhost:2", 1, 0)
+    dist.initialize(a, init_timeout=7)
+    assert dist.is_initialized() and dist.current_config() == a
+    # the bounded default: every initialize carries a timeout
+    assert calls[-1]["initialization_timeout"] == 7
+    # same config: idempotent no-op; different config: loud error that
+    # names the fix
+    dist.initialize(a)
+    with pytest.raises(Exception, match="shutdown"):
+        dist.initialize(b)
+    dist.shutdown()
+    assert "shutdown" in calls and not dist.is_initialized()
+    dist.initialize(b)  # the NEW coordinator is now legal
+    assert dist.current_config() == b
+    # default init timeout is bounded, never unbounded
+    assert calls[-1]["initialization_timeout"] \
+        == int(dist.DEFAULT_INIT_TIMEOUT_S)
+    dist.shutdown()
+    assert dist.shutdown() is None  # idempotent
+
+
+def test_rendezvous_failure_is_transient(monkeypatch):
+    """A failed rendezvous raises RendezvousError, which the engine
+    classifies TRANSIENT — a lost peer re-forms the gang strike-free
+    instead of striking a healthy job."""
+    from scanner_tpu.engine.service import _is_transient_failure
+    from scanner_tpu.parallel import distributed as dist
+
+    def boom(**kw):
+        raise RuntimeError("barrier timed out")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    monkeypatch.setattr(dist, "_init_config", None)
+    with pytest.raises(dist.RendezvousError) as ei:
+        dist.initialize(dist.CoordinatorConfig("localhost:9", 2, 1),
+                        init_timeout=1)
+    assert not dist.is_initialized()
+    assert _is_transient_failure(ei.value)
+
+
 def test_mesh_factoring():
     assert len(jax.devices()) == 8
     m = make_mesh({"dp": 2, "sp": 2, "tp": 2})

@@ -882,6 +882,116 @@ def test_fence_journal_config_keys_both_directions(tmp_path):
     assert not any("journal_enabled" in m for m in msgs)
 
 
+def _gang_repo(tmp_path, wrap_gang=True, idem_gang=False,
+               schema_keys=("enabled", "init_timeout_s"),
+               cfg_keys=("enabled", "init_timeout_s"),
+               doc_keys=("enabled", "init_timeout_s")):
+    """Synthetic mini-repo for the SC313 gang-contract lints."""
+    _write(tmp_path, "setup.py", "# root marker\n")
+    gm = "self._fenced(self._rpc_gang)" if wrap_gang \
+        else "self._rpc_gang"
+    idem = "True" if idem_gang else "False"
+    _write(tmp_path, "pkg/svc.py", f"""
+        MASTER_SERVICE = "svc.Master"
+
+        RPC_CONTRACTS = {{
+            "GangFailed": {{"timeout_s": 1.0, "idempotent": {idem}}},
+            "Read": {{"timeout_s": 1.0, "idempotent": True}},
+        }}
+
+        class RpcServer:
+            def __init__(self, name, methods, port=0):
+                pass
+
+        class Master:
+            def __init__(self):
+                self._server = RpcServer(MASTER_SERVICE, {{
+                    "GangFailed": {gm},
+                    "Read": self._rpc_read,
+                }})
+
+            def _fenced(self, fn):
+                return fn
+
+            def _rpc_gang(self, req):
+                return {{}}
+
+            def _rpc_read(self, req):
+                return {{}}
+
+        def client(c):
+            c.call("GangFailed")
+            c.call("Read")
+    """)
+    schema = ", ".join(f'"{k}"' for k in schema_keys)
+    _write(tmp_path, "pkg/engine/gang.py",
+           f"CONFIG_KEYS = ({schema},)\n")
+    cfg = ", ".join(f'"{k}": 1' for k in cfg_keys)
+    _write(tmp_path, "pkg/config.py", f"""
+        def default_config():
+            return {{"gang": {{{cfg}}}}}
+    """)
+    rows = "\n".join(f"| `[gang] {k}` | a row |" for k in doc_keys)
+    _write(tmp_path, "docs/guide.md", f"""
+        The keys `enabled`, `init_timeout_s`, `ghost_key` and
+        `extra_key` are mentioned so SC304 stays quiet.
+
+        {rows}
+    """)
+    return tmp_path
+
+
+def test_gang_clean_fixture_is_quiet(tmp_path):
+    _gang_repo(tmp_path)
+    _, findings = _analyze(tmp_path, "pkg")
+    assert [f for f in findings if f.code == "SC313"] == []
+
+
+def test_gang_unfenced_handler_flagged(tmp_path):
+    _gang_repo(tmp_path, wrap_gang=False)
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC313"]
+    assert any("`GangFailed`" in m and "generation-fence" in m
+               for m in msgs)
+
+
+def test_gang_misclassified_idempotent_flagged(tmp_path):
+    """SC312 cannot see a Gang entry misclassified idempotent=True
+    (it only inspects idempotent=False entries) — SC313 pins the gang
+    surface from the other side."""
+    _gang_repo(tmp_path, idem_gang=True)
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC313"]
+    assert any("`GangFailed`" in m and "idempotent=False" in m
+               for m in msgs)
+    assert not any("`Read`" in m for m in msgs)
+
+
+def test_gang_config_keys_all_pairings(tmp_path):
+    _gang_repo(tmp_path,
+               schema_keys=("enabled", "ghost_key"),
+               cfg_keys=("enabled", "extra_key"),
+               doc_keys=("enabled",))
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC313"]
+    # config declares a key the module refuses
+    assert any("extra_key" in m and "does not accept" in m
+               for m in msgs)
+    # module accepts a key config never declares
+    assert any("ghost_key" in m and "declares no" in m for m in msgs)
+    # module accepts a key guide.md has no row for
+    assert any("ghost_key" in m and "guide.md" in m for m in msgs)
+    assert not any("`enabled`" in m for m in msgs)
+
+
+def test_gang_doc_row_without_schema_key_flagged(tmp_path):
+    _gang_repo(tmp_path, doc_keys=("enabled", "init_timeout_s",
+                                   "phantom_row"))
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC313"]
+    assert any("phantom_row" in m and "no such key" in m for m in msgs)
+
+
 def test_contract_rpc_contracts_table_both_directions(tmp_path):
     _write(tmp_path, "setup.py", "# root\n")
     _write(tmp_path, "pkg/rpcmod.py", """

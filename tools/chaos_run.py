@@ -77,8 +77,12 @@ def main() -> int:
     # CPU backend (SCANNER_TPU_KERNEL_DEVICES=all) — same lever the
     # multichip tests use
     mem_plan = any(s.split(".")[0] == "memory" for s in sites)
+    # gang.* sites fire in the worker process (engine/gang.py
+    # spawn_member), and a gang plan needs the bulk itself to run in
+    # gang mode (PerfParams.gang_hosts) so there is a gang to lose
+    gang_plan = any(s.split(".")[0] == "gang" for s in sites)
     worker_side = any(s.split(".")[0] in ("pipeline", "storage", "gcs",
-                                          "worker", "memory")
+                                          "worker", "memory", "gang")
                       for s in sites)
     master_side = "rpc.server.handle" in sites
     client_side = "rpc.client.call" in sites
@@ -128,6 +132,11 @@ def main() -> int:
     env.pop("SCANNER_TPU_FAULTS", None)
     if mem_plan:
         env["SCANNER_TPU_KERNEL_DEVICES"] = "all"
+    if gang_plan:
+        # bounded rendezvous + a short formation hold so the drill's
+        # re-form-on-survivors path resolves in seconds, not minutes
+        env.setdefault("SCANNER_TPU_GANG_INIT_TIMEOUT", "30")
+        env.setdefault("SCANNER_TPU_GANG_FORM_TIMEOUT", "6")
 
     def spawn(script, argv, plan=None, env_extra=None):
         e = dict(env)
@@ -188,7 +197,18 @@ def main() -> int:
             col = sc.io.Input([NamedStream(sc, "chaos_src")])
             col = sc.ops.ChaosRunDouble(x=col)
         out = NamedStream(sc, out_name)
-        sc.run(sc.io.Output(col, [out]), PerfParams.manual(2, 2, **kw),
+        if gang_plan:
+            # gang mode: ~2 big tasks instead of rows/2 small ones —
+            # each task costs a member-runner rendezvous, and two is
+            # enough to prove loss + re-form + completion.  io must be
+            # a work-packet multiple, so round rows/2 down to one
+            # (floored at a single packet) for any --rows value.
+            wp = 4
+            io = max(wp, (args.rows // 2 // wp) * wp)
+            perf = PerfParams.manual(wp, io, gang_hosts=2, **kw)
+        else:
+            perf = PerfParams.manual(2, 2, **kw)
+        sc.run(sc.io.Output(col, [out]), perf,
                cache_mode=CacheMode.Overwrite, show_progress=True)
         return [bytes(r) for r in out.load()]
 
@@ -243,6 +263,24 @@ def main() -> int:
                      or preempt_notices
                      or respawned.get("rc") == faults.CRASH_EXIT_CODE)
         extra_ok = True
+        if gang_plan:
+            # gang-drill evidence (ISSUE acceptance): the gang aborted
+            # on the injected host loss, RE-FORMED at a higher epoch on
+            # the survivors, and no survivor ate a blacklist strike
+            def _tot(name):
+                return sum(s.get("value", 0) for s in
+                           snap.get(name, {}).get("samples", []))
+
+            formed = _tot("scanner_tpu_gang_formed_total")
+            aborted = _tot("scanner_tpu_gang_aborted_total")
+            reforms = _tot("scanner_tpu_gang_reforms_total")
+            epoch = _tot("scanner_tpu_gang_epoch")
+            strikes = _tot("scanner_tpu_blacklist_strikes_total")
+            print(f"gang: formed={int(formed)} aborted={int(aborted)} "
+                  f"reforms={int(reforms)} epoch={int(epoch)} "
+                  f"strikes={int(strikes)}")
+            extra_ok = bool(aborted >= 1 and reforms >= 1
+                            and epoch >= 2 and strikes == 0)
         if failover:
             # failover-specific evidence: the successor replayed the
             # journal, zero blacklist strikes anywhere, and a

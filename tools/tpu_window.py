@@ -478,6 +478,95 @@ print("FRAMECACHE_AB " + json.dumps(out))
 """
 
 
+_GANG_HW = r"""
+import json, os, struct, subprocess, sys, tempfile, time
+
+# libtpu is single-process-exclusive per chip: the cluster (master +
+# 2 workers + their member-runner children) cannot share the TPU with
+# this script, and two concurrent children could not share it with
+# each other.  The gang_hw digest measures what the hardware window
+# adds — formation/reform latency on the real host (its kernel, net
+# stack, and process-spawn costs) — so the member math runs on the CPU
+# backend while the TPU device identity is probed in a throwaway
+# subprocess with the ambient env.
+probe = subprocess.run(
+    [sys.executable, "-c",
+     "import jax; d = jax.devices()[0]; print(d.platform, d)"],
+    capture_output=True, text=True, timeout=300)
+tpu_dev = probe.stdout.strip()
+assert tpu_dev.startswith("tpu"), f"no TPU: {tpu_dev or probe.stderr[-200:]}"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import cloudpickle, jax
+from scanner_tpu import CacheMode, Client, Kernel, NamedStream, PerfParams, \
+    register_op
+from scanner_tpu.engine import gang as egang
+from scanner_tpu.engine.service import Master, Worker
+from scanner_tpu.util.metrics import registry
+
+def pk(v):
+    return struct.pack("<q", v)
+
+@register_op(name="GangHwDouble")
+class GangHwDouble(Kernel):
+    def execute(self, x: bytes) -> bytes:
+        return pk(2 * struct.unpack("<q", x)[0])
+
+cloudpickle.register_pickle_by_value(sys.modules["__main__"])
+
+def tot(name):
+    s = registry().snapshot().get(name, {})
+    return sum(x["value"] for x in s.get("samples", []))
+
+root = tempfile.mkdtemp(prefix="gang_hw_")
+N = 16
+sc = Client(db_path=os.path.join(root, "db"))
+sc.new_table("gang_src", ["output"], [[pk(100 + i)] for i in range(N)])
+m = Master(db_path=os.path.join(root, "db"), no_workers_timeout=120.0)
+addr = f"localhost:{m.port}"
+egang.set_form_timeout_s(4.0)
+workers = [Worker(addr, db_path=os.path.join(root, "db"))
+           for _ in range(2)]
+gc = Client(db_path=os.path.join(root, "db"), master=addr)
+col = gc.io.Input([NamedStream(gc, "gang_src")])
+col = gc.ops.GangHwDouble(x=col)
+out = NamedStream(gc, "gang_out")
+t0 = time.time()
+gc.run(gc.io.Output(col, [out]), PerfParams.manual(4, 4, gang_hosts=2),
+       cache_mode=CacheMode.Overwrite, show_progress=False)
+elapsed = round(time.time() - t0, 2)
+rows = [bytes(r) for r in out.load()]
+res = {
+    "device": tpu_dev,
+    "members_on": "cpu (libtpu is single-process-exclusive)",
+    "rows_ok": rows == [pk(2 * (100 + i)) for i in range(N)],
+    "elapsed_s": elapsed,
+    "gangs_formed": tot("scanner_tpu_gang_formed_total"),
+    "gangs_aborted": tot("scanner_tpu_gang_aborted_total"),
+    "epoch": tot("scanner_tpu_gang_epoch"),
+}
+gc.stop()
+for w in workers:
+    w.stop()
+m.stop()
+# bank the hardware gang digest with the round's bench evidence (same
+# file bench.py writes its digests to) — the ISSUE asks for a gang_hw
+# baseline on the next healthy capture window
+path = os.path.join(os.getcwd(), "BENCH_DETAIL.json")
+try:
+    detail = json.load(open(path))
+    if not isinstance(detail, list):
+        detail = [detail]
+except Exception:
+    detail = []
+detail.append({"config": "gang_hw",
+               "clock": time.strftime("%Y-%m-%dT%H:%M:%S"), **res})
+with open(path, "w") as f:
+    json.dump(detail, f, indent=1)
+print("GANG_HW " + json.dumps(res))
+"""
+
+
 def tunnel_up() -> bool:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from tpu_capture import tunnel_up as probe  # same probe + env override
@@ -537,6 +626,10 @@ def main() -> int:
         "paged frame-cache cross-task reuse A/B (engine/framecache.py "
         "-> BENCH_DETAIL.json frame_cache_hw)", code=_FRAMECACHE_AB,
         timeout=1200, marker="FRAMECACHE_AB ")
+    results["gang"] = run_step(
+        "gang-scheduled multi-host bulk on hardware (engine/gang.py "
+        "-> BENCH_DETAIL.json gang_hw)", code=_GANG_HW,
+        timeout=1200, marker="GANG_HW ")
     results["op_bench"] = run_step(
         "per-op device/host A/B (tools/op_bench.py -> OP_BENCH.json)",
         argv=[sys.executable, "tools/op_bench.py"], timeout=1200)
