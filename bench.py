@@ -862,6 +862,111 @@ def main():
             _gang_d = {"config": "gang",
                        "error": f"{type(e).__name__}: {e}"}
         detail.append(_gang_d)
+
+        # gang skew digest (util/clocksync.py + engine/gang.py phase
+        # spans): a clean 2-worker gang_hosts=2 run — no injected loss
+        # — banking the barrier-skew p99 the master observed from
+        # offset-corrected member arrivals and the worst clock-offset
+        # uncertainty any worker published, so tools/bench_history.py
+        # gates the cross-host observability direction
+        # (`gang_barrier_skew_p99_s` / `clock_offset_uncertainty_s`,
+        # both better=lower).  Quantiles are over the process-global
+        # histogram, which also holds the gang drill's clean epochs —
+        # all uninjected skews, so the aggregate stays an honest
+        # clean-run baseline.
+        def _gang_skew_digest() -> dict:
+            import struct as _struct
+
+            from scanner_tpu import Kernel, register_op
+            from scanner_tpu.engine import gang as _egang
+            from scanner_tpu.engine.service import Master, Worker
+            from scanner_tpu.util.metrics import (
+                snapshot_histogram_quantiles as _shq)
+
+            def _pk(v: int) -> bytes:
+                return _struct.pack("<q", v)
+
+            @register_op(name="BenchGangSkewSleep")
+            class BenchGangSkewSleep(Kernel):
+                def execute(self, x: bytes) -> bytes:
+                    time.sleep(0.05)
+                    return _pk(3 * _struct.unpack("<q", x)[0])
+
+            sdb = os.path.join(root, "gang_skew_db")
+            n_rows = 16
+            seeds = Client(db_path=sdb)
+            seeds.new_table("gskew_src", ["output"],
+                            [[_pk(200 + i)] for i in range(n_rows)])
+            m = Master(db_path=sdb, no_workers_timeout=60.0)
+            addr = f"localhost:{m.port}"
+            old_form = _egang.form_timeout_s()
+            _egang.set_form_timeout_s(4.0)
+            workers = [Worker(addr, db_path=sdb) for _ in range(2)]
+            gc3 = Client(db_path=sdb, master=addr)
+            result: dict = {}
+            try:
+                col = gc3.io.Input([NamedStream(gc3, "gskew_src")])
+                col = gc3.ops.BenchGangSkewSleep(x=col)
+                out = NamedStream(gc3, "gskew_out")
+                try:
+                    gc3.run(gc3.io.Output(col, [out]),
+                            PerfParams.manual(4, 4, gang_hosts=2),
+                            cache_mode=CacheMode.Overwrite,
+                            show_progress=False)
+                    result["rows"] = len(list(out.load()))
+                except Exception as e:  # noqa: BLE001
+                    result["error"] = f"{type(e).__name__}: {e}"
+                # straggler attribution rows the master folded for this
+                # bulk (gang/epoch/slowest/bound) — proves the
+                # attribution path end to end in-process
+                with m._lock:
+                    b = m._bulk
+                    if b is None and m._history:
+                        b = m._history[max(m._history)]
+                    skew_rows = (list(b.gang_skew_rows)
+                                 if b is not None else [])
+                # the uncertainty gauge appears once a worker has
+                # heartbeat round-trips banked (~2 beats); give the
+                # publication a bounded grace window
+                deadline = time.time() + 10
+                while time.time() < deadline:
+                    gs = registry().snapshot().get(
+                        "scanner_tpu_clock_offset_uncertainty_seconds",
+                        {}).get("samples", [])
+                    if gs:
+                        break
+                    time.sleep(0.1)
+                fsnap = registry().snapshot()
+                skq = _shq(
+                    fsnap, "scanner_tpu_gang_barrier_skew_seconds")
+                unc = [s["value"] for s in fsnap.get(
+                    "scanner_tpu_clock_offset_uncertainty_seconds",
+                    {}).get("samples", [])]
+                return {
+                    "config": "gang_skew",
+                    "rows_ok": result.get("rows") == n_rows,
+                    "error": result.get("error"),
+                    "gang_barrier_skew_p99_s": skq.get("p99_s"),
+                    "gang_barrier_skew_p50_s": skq.get("p50_s"),
+                    "skews_observed": skq.get("count"),
+                    "clock_offset_uncertainty_s": (
+                        round(max(unc), 6) if unc else None),
+                    "gang_skew_rows": skew_rows[-4:],
+                }
+            finally:
+                _egang.set_form_timeout_s(old_form)
+                gc3.stop()
+                for w in workers:
+                    w.stop()
+                m.stop()
+
+        try:
+            _skew_d = _gang_skew_digest()
+        except Exception as e:  # noqa: BLE001 — bench must not die on
+            # the skew drill
+            _skew_d = {"config": "gang_skew",
+                       "error": f"{type(e).__name__}: {e}"}
+        detail.append(_skew_d)
         # stable per-direction baseline keys (ROADMAP "bank per-item
         # baselines for the new directions"): one flat entry with a
         # declared better= direction per metric, so
@@ -909,6 +1014,12 @@ def main():
                     "better": "lower"},
                 "gang_reform_s": {
                     "value": _gang_d.get("gang_reform_s"),
+                    "better": "lower"},
+                "gang_barrier_skew_p99_s": {
+                    "value": _skew_d.get("gang_barrier_skew_p99_s"),
+                    "better": "lower"},
+                "clock_offset_uncertainty_s": {
+                    "value": _skew_d.get("clock_offset_uncertainty_s"),
                     "better": "lower"},
             },
         })

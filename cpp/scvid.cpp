@@ -648,6 +648,10 @@ struct ScvidEncoder {
   std::vector<uint8_t> out_keys;
   std::vector<int64_t> out_pts;
   std::vector<int64_t> out_dts;
+  // over-aligned scratch for the RGB24 SOURCE surface at widths whose
+  // tight stride is not SIMD-safe (see scvid_encoder_feed_pts — the
+  // read-side sibling of the decoder's convert_frame hazard)
+  std::vector<uint8_t> scratch;
 };
 
 SCVID_API ScvidEncoder* scvid_encoder_create(int32_t width, int32_t height,
@@ -798,12 +802,29 @@ int encoder_drain(ScvidEncoder* e) {
 SCVID_API int32_t scvid_encoder_feed_pts(ScvidEncoder* e, const uint8_t* rgb,
                                          int64_t n_frames,
                                          const int64_t* pts) {
+  const int w = e->ctx->width, h = e->ctx->height;
+  const int tight = 3 * w;
   for (int64_t i = 0; i < n_frames; ++i) {
     av_frame_make_writable(e->frame);
-    const uint8_t* src_planes[4] = {rgb + i * 3 * e->ctx->width * e->ctx->height,
-                                    nullptr, nullptr, nullptr};
-    int src_stride[4] = {3 * e->ctx->width, 0, 0, 0};
-    sws_scale(e->sws, src_planes, src_stride, 0, e->ctx->height,
+    const uint8_t* src = rgb + (size_t)i * tight * h;
+    const uint8_t* src_planes[4] = {src, nullptr, nullptr, nullptr};
+    int src_stride[4] = {tight, 0, 0, 0};
+    if ((w % 16) != 0) {
+      // Unaligned width: swscale's SIMD row READERS load full vector
+      // registers past the tight row end — at the last row of the
+      // caller's packed buffer that read lands PAST the allocation
+      // (the read-side sibling of the decoder convert_frame overrun
+      // fixed in PR 9).  Stage the frame into an over-aligned scratch
+      // source and feed swscale from that.
+      const int stride = FFALIGN(tight, 64);
+      e->scratch.resize((size_t)stride * h + 64);
+      for (int64_t r = 0; r < h; ++r)
+        memcpy(e->scratch.data() + (size_t)r * stride, src + r * tight,
+               tight);
+      src_planes[0] = e->scratch.data();
+      src_stride[0] = stride;
+    }
+    sws_scale(e->sws, src_planes, src_stride, 0, h,
               e->frame->data, e->frame->linesize);
     if (pts) {
       if (pts[i] < e->pts) {

@@ -314,16 +314,22 @@ int main() {
     ScvidEncoder* uenc = scvid_encoder_create(UW, UH, 24, 1, "libx264", 0,
                                               18, KEYINT, 0, 0);
     CHECK(uenc != nullptr, "unaligned encoder create");
-    std::vector<uint8_t> uframe((size_t)UW * UH * 3);
+    // feed every frame from ONE exactly-sized tight-packed buffer: the
+    // encoder's swscale SOURCE rows have the same SIMD overrun hazard
+    // on the read side (feed_pts now stages unaligned widths through
+    // an over-aligned scratch); under `make asan` an exactly-sized
+    // heap allocation proves no row read escapes any frame
+    std::vector<uint8_t> uframes((size_t)UN * UW * UH * 3);
     for (int i = 0; i < UN; ++i) {
+      uint8_t* uframe = uframes.data() + (size_t)i * UW * UH * 3;
       for (int p = 0; p < UW * UH; ++p) {
         uframe[3 * p + 0] = (uint8_t)((i * 16) % 224);
         uframe[3 * p + 1] = (uint8_t)(((p % UW) * 239) / (UW - 1));
         uframe[3 * p + 2] = 0;
       }
-      CHECK(scvid_encoder_feed(uenc, uframe.data(), 1) == 0,
-            "unaligned encoder feed");
     }
+    CHECK(scvid_encoder_feed(uenc, uframes.data(), UN) == 0,
+          "unaligned encoder batched feed");
     CHECK(scvid_encoder_flush(uenc) == 0, "unaligned encoder flush");
     int64_t un = scvid_encoder_pending(uenc);
     std::vector<uint8_t> udata(scvid_encoder_pending_bytes(uenc));

@@ -71,6 +71,20 @@ passes make each one checkable:
          config keys config.default_config() declares, the
          gang.CONFIG_KEYS tuple, and the `[gang] <key>` rows in
          docs/guide.md may not drift (all pairings, both directions)
+  SC314  cross-host time contract drift (util/clocksync.py +
+         engine/gang.py): clocksync.CLOCKSYNC_SERIES and
+         gang.GANG_PHASE_SERIES must match the series each module
+         registers, and their union must match the marker-delimited
+         table in docs/observability.md
+         (`clocksync-series:begin/end`), both directions; the
+         `gang.*` span names engine/gang.py opens must match the
+         `gang-phase-taxonomy:begin/end` table in
+         docs/observability.md, both directions — an undocumented
+         phase span (or a documented phantom) makes merged-timeline
+         skew triage lie; and the `[trace]` clock keys
+         config.default_config() declares (all but the tracing-owned
+         `enabled`) must be exactly clocksync.CONFIG_KEYS (both
+         directions)
 """
 
 from __future__ import annotations
@@ -359,6 +373,11 @@ class ContractPass(AnalysisPass):
                  "must be non-idempotent + fence-wrapped; [gang] "
                  "config keys vs gang.CONFIG_KEYS vs docs/guide.md "
                  "rows)",
+        "SC314": "cross-host time contract drift (CLOCKSYNC_SERIES + "
+                 "GANG_PHASE_SERIES vs registrations vs docs "
+                 "clocksync-series table; gang.* span names vs the "
+                 "gang-phase-taxonomy table; [trace] clock keys vs "
+                 "clocksync.CONFIG_KEYS)",
     }
 
     def run(self, project: Project) -> List[Finding]:
@@ -374,6 +393,7 @@ class ContractPass(AnalysisPass):
         out.extend(self._remediation(project))
         out.extend(self._fence_routing(project))
         out.extend(self._gang_contract(project))
+        out.extend(self._clocksync_contract(project))
         return out
 
     # -- SC301 / SC302 ---------------------------------------------------
@@ -1238,6 +1258,194 @@ class ContractPass(AnalysisPass):
                                     "key",
                             path="docs/guide.md", line=1, scope="",
                             snippet=k))
+        return out
+
+    # -- SC314 -----------------------------------------------------------
+
+    _CS_DOC_BLOCK_RE = re.compile(
+        r"<!--\s*clocksync-series:begin\s*-->(.*?)"
+        r"<!--\s*clocksync-series:end\s*-->", re.S)
+    _PHASE_DOC_BLOCK_RE = re.compile(
+        r"<!--\s*gang-phase-taxonomy:begin\s*-->(.*?)"
+        r"<!--\s*gang-phase-taxonomy:end\s*-->", re.S)
+    _GANG_SPAN_RE = re.compile(r"`(gang\.[a-z0-9_.]+)`")
+
+    @staticmethod
+    def _doc_base_series(block_text: str) -> Set[str]:
+        """Series names in a doc block, exposition suffixes folded
+        into their base series (the SC309/SC310 convention)."""
+        doc_names = set(_SERIES_RE.findall(block_text))
+        base = set()
+        for n in doc_names:
+            for suf in _EXPOSITION_SUFFIXES:
+                if n.endswith(suf) and n[:-len(suf)] in doc_names:
+                    break
+            else:
+                base.add(n)
+        return base
+
+    @staticmethod
+    def _gang_span_names(mod: ModuleInfo) -> Set[str]:
+        """Every `gang.*` string literal handed to an open_span call —
+        the code-side phase taxonomy."""
+        names: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "open_span"):
+                continue
+            for arg in node.args:
+                s = _const_str(arg)
+                if s is not None and s.startswith("gang."):
+                    names.add(s)
+        return names
+
+    def _clocksync_contract(self, project: Project) -> List[Finding]:
+        """Cross-host time lints: the clock-sync + gang-phase metric
+        surface (module tuples ↔ registrations ↔ the clocksync-series
+        doc table), the gang phase-span taxonomy (open_span literals
+        ↔ the gang-phase-taxonomy doc table), and the `[trace]` clock
+        keys (default_config ↔ clocksync.CONFIG_KEYS).  Merged
+        timelines are only trustworthy if the reader can look every
+        series and span name up — an undocumented phase is a blind
+        spot in exactly the trace meant to explain stragglers."""
+        out: List[Finding] = []
+        csmod = project.module("util/clocksync.py")
+        if csmod is None:
+            return out
+        gmod = project.module("engine/gang.py")
+        doc = _read_doc(project, "observability.md")
+
+        declared_union: Set[str] = set()
+        have_tuple = False
+        # per-module: the declared tuple must match what the module
+        # registers.  clocksync registers nothing but clock series, so
+        # the pairing is exact; gang.py also owns lifecycle counters,
+        # so the reverse leg only claims phase/skew-named series
+        cs_series = _module_tuple(csmod, "CLOCKSYNC_SERIES")
+        if cs_series is not None:
+            have_tuple = True
+            declared_union |= set(cs_series)
+            registered = {r.name for r in _metric_registrations(csmod)
+                          if r.name}
+            for name in sorted(registered - set(cs_series)):
+                out.append(csmod.finding(
+                    "SC314",
+                    f"series `{name}` is registered in clocksync but "
+                    "missing from CLOCKSYNC_SERIES — the SC314 catalog "
+                    "contract cannot see it", csmod.tree))
+            for name in sorted(set(cs_series) - registered):
+                out.append(csmod.finding(
+                    "SC314",
+                    f"CLOCKSYNC_SERIES names `{name}` but clocksync "
+                    "registers no such series", csmod.tree))
+        gp_series = _module_tuple(gmod, "GANG_PHASE_SERIES") \
+            if gmod is not None else None
+        if gp_series is not None and gmod is not None:
+            have_tuple = True
+            declared_union |= set(gp_series)
+            registered = {r.name for r in _metric_registrations(gmod)
+                          if r.name}
+            phase_named = {n for n in registered
+                           if "_phase_" in n or "_skew_" in n}
+            for name in sorted(phase_named - set(gp_series)):
+                out.append(gmod.finding(
+                    "SC314",
+                    f"series `{name}` is registered in gang but "
+                    "missing from GANG_PHASE_SERIES — the SC314 "
+                    "catalog contract cannot see it", gmod.tree))
+            for name in sorted(set(gp_series) - registered):
+                out.append(gmod.finding(
+                    "SC314",
+                    f"GANG_PHASE_SERIES names `{name}` but gang "
+                    "registers no such series", gmod.tree))
+        # union <-> the clocksync-series doc table, both directions
+        if have_tuple and doc:
+            block = self._CS_DOC_BLOCK_RE.search(doc)
+            if block is None:
+                out.append(csmod.finding(
+                    "SC314",
+                    "clocksync declares CLOCKSYNC_SERIES but "
+                    "docs/observability.md has no clocksync-series "
+                    "marker table (<!-- clocksync-series:begin/end "
+                    "-->)", csmod.tree))
+            else:
+                base_doc = self._doc_base_series(block.group(1))
+                for name in sorted(declared_union - base_doc):
+                    out.append(csmod.finding(
+                        "SC314",
+                        f"cross-host time series `{name}` is missing "
+                        "from the docs/observability.md "
+                        "clocksync-series table", csmod.tree))
+                for name in sorted(base_doc - declared_union):
+                    out.append(Finding(
+                        code="SC314",
+                        message=f"docs/observability.md "
+                                f"clocksync-series table lists "
+                                f"`{name}` but neither "
+                                "CLOCKSYNC_SERIES nor "
+                                "GANG_PHASE_SERIES has such a series",
+                        path="docs/observability.md", line=1,
+                        scope="", snippet=name))
+        # gang.* phase spans <-> the gang-phase-taxonomy doc table,
+        # both directions
+        span_names = self._gang_span_names(gmod) \
+            if gmod is not None else set()
+        if span_names and doc and gmod is not None:
+            block = self._PHASE_DOC_BLOCK_RE.search(doc)
+            if block is None:
+                out.append(gmod.finding(
+                    "SC314",
+                    "gang opens phase spans but docs/observability.md "
+                    "has no gang-phase-taxonomy marker table (<!-- "
+                    "gang-phase-taxonomy:begin/end -->)", gmod.tree))
+            else:
+                doc_spans = set(
+                    self._GANG_SPAN_RE.findall(block.group(1)))
+                for name in sorted(span_names - doc_spans):
+                    out.append(gmod.finding(
+                        "SC314",
+                        f"gang opens span `{name}` but the "
+                        "docs/observability.md gang-phase-taxonomy "
+                        "table has no row for it — the merged "
+                        "timeline would show an unexplained phase",
+                        gmod.tree))
+                for name in sorted(doc_spans - span_names):
+                    out.append(Finding(
+                        code="SC314",
+                        message=f"docs/observability.md "
+                                f"gang-phase-taxonomy table documents "
+                                f"span `{name}` but gang opens no "
+                                "such span",
+                        path="docs/observability.md", line=1,
+                        scope="", snippet=name))
+        # [trace] clock keys <-> clocksync.CONFIG_KEYS, both
+        # directions.  `enabled` is the tracing core's own switch and
+        # is excluded; everything else under [trace] belongs to the
+        # clock-sync layer and must be declared by it
+        schema = _module_tuple(csmod, "CONFIG_KEYS")
+        cfg_mod = None
+        for m in project.modules:
+            if m.relpath.endswith("config.py") \
+                    and _default_config_keys(m):
+                cfg_mod = m
+                break
+        if schema is not None and cfg_mod is not None:
+            trace_keys = {k for sec, k in _default_config_keys(cfg_mod)
+                          if sec == "trace" and k != "enabled"}
+            if trace_keys or schema:
+                for k in sorted(trace_keys - set(schema)):
+                    out.append(cfg_mod.finding(
+                        "SC314",
+                        f"config key `[trace] {k}` is declared but "
+                        "clocksync.CONFIG_KEYS does not accept it",
+                        cfg_mod.tree))
+                for k in sorted(set(schema) - trace_keys):
+                    out.append(csmod.finding(
+                        "SC314",
+                        f"clocksync.CONFIG_KEYS accepts `{k}` but "
+                        "config.default_config() declares no "
+                        f"`[trace] {k}`", csmod.tree))
         return out
 
     # -- SC306 / SC307 ---------------------------------------------------

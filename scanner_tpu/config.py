@@ -76,6 +76,15 @@ def default_config() -> Dict[str, Any]:
             # observability.md); the SCANNER_TPU_TRACING env var
             # overrides per process.
             "enabled": True,
+            # cross-host clock-offset estimation (util/clocksync.py):
+            # NTP-style exchange piggybacked on heartbeats, published
+            # as clock_offset gauges and carried on span batches.  The
+            # SCANNER_TPU_CLOCKSYNC env var overrides per process.
+            "clocksync_enabled": True,
+            # rebase remote span timestamps onto master time during
+            # trace assembly (GetTrace); per-call raw_clocks /
+            # scanner_trace --raw-clocks is the escape hatch.
+            "rebase_clocks": True,
         },
         "alerts": {
             # the health/SLO engine (util/health.py): declarative alert
@@ -248,6 +257,20 @@ class Config:
         """Distributed-tracing span recording (the deployment default;
         SCANNER_TPU_TRACING overrides per process)."""
         return bool(self.config.get("trace", {}).get("enabled", True))
+
+    @property
+    def clocksync_enabled(self) -> bool:
+        """Cross-host clock-offset estimation (the deployment default;
+        SCANNER_TPU_CLOCKSYNC overrides per process)."""
+        return bool(self.config.get("trace", {}).get(
+            "clocksync_enabled", True))
+
+    @property
+    def rebase_clocks(self) -> bool:
+        """Rebase remote span timestamps onto master time during trace
+        assembly (per-call raw_clocks is the escape hatch)."""
+        return bool(self.config.get("trace", {}).get(
+            "rebase_clocks", True))
 
     @property
     def alerts_enabled(self) -> bool:

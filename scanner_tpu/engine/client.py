@@ -103,6 +103,13 @@ class Client:
             if not os.environ.get("SCANNER_TPU_TRACING"):
                 from ..util import tracing
                 tracing.set_enabled(cfg.tracing_enabled)
+            # [trace] clocksync_enabled / rebase_clocks: cross-host
+            # clock-offset estimation + trace-assembly rebase defaults;
+            # SCANNER_TPU_CLOCKSYNC (read at import) wins when set
+            from ..util import clocksync as _clk_cfg
+            if not os.environ.get("SCANNER_TPU_CLOCKSYNC"):
+                _clk_cfg.set_enabled(cfg.clocksync_enabled)
+            _clk_cfg.set_rebase_enabled(cfg.rebase_clocks)
             # [memory] section: accounting default + report size; the
             # SCANNER_TPU_MEMSTATS* env vars (read at import) win
             from ..util import memstats
@@ -515,13 +522,17 @@ class Client:
             raise ScannerException(f"no profile for job {job_id}")
         return Profile(self._job_profiles[job_id])
 
-    def trace(self, job_id: int, path: Optional[str] = None) -> str:
+    def trace(self, job_id: int, path: Optional[str] = None,
+              raw_clocks: bool = False) -> str:
         """Write ONE merged cross-host Perfetto/Chrome trace for a
         finished job: the assembled span tree (client root → master
         scheduling → worker task → stage → op, all under the job's
         trace_id) plus any captured XLA device timelines — cluster
         profiles carry their device events inline, so remote chips'
-        lanes survive the hop (util/jaxprof.py).  Returns the path
+        lanes survive the hop (util/jaxprof.py).  Remote spans arrive
+        rebased onto master time via the per-node clock offsets
+        (docs/observability.md §Cross-host time) unless raw_clocks=True
+        keeps each host's uncorrected stamps.  Returns the path
         written.  Open in ui.perfetto.dev; `tools/scanner_trace.py` is
         the CLI flavor and adds straggler analytics."""
         from ..util import tracing as _tr
@@ -531,7 +542,8 @@ class Client:
                 f"no trace for job {job_id} (was tracing disabled? "
                 "SCANNER_TPU_TRACING / [trace] enabled)")
         if self._cluster is not None and info.get("bulk_id") is not None:
-            reply = self._cluster.get_trace(info["bulk_id"])
+            reply = self._cluster.get_trace(info["bulk_id"],
+                                            raw_clocks=raw_clocks)
             # the run already shipped this process's root span; merge
             # the flight recorder anyway (dedup by span id) in case
             # that best-effort ship was lost

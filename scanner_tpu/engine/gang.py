@@ -169,6 +169,56 @@ def count_stale_nack(rpc: str) -> None:
     _M_STALE_NACKS.labels(rpc=rpc).inc()
 
 
+# cross-host gang phase telemetry (docs/observability.md §Cross-host
+# time; scanner-check SC314 keeps this tuple, the registrations below
+# and the docs marker table in sync, all directions).  The member child
+# times its phases and returns them in the result dict — its registry
+# is never scraped — and the parent worker folds them here; the skew
+# histogram observes on the MASTER, from offset-corrected member
+# barrier arrivals.
+GANG_PHASE_SERIES = (
+    "scanner_tpu_gang_phase_seconds_total",
+    "scanner_tpu_gang_barrier_skew_seconds",
+)
+
+_M_PHASE = _mx.registry().counter(
+    "scanner_tpu_gang_phase_seconds_total",
+    "Seconds gang members spent per phase (rendezvous = joining the "
+    "multi-process runtime, stage = evaluating the task body, barrier "
+    "= waiting for the slowest member at the pre-collective barrier, "
+    "collective = the post-arrival cross-host reduction), by member "
+    "role.  Folded from member-child results by the parent worker.",
+    labels=["phase", "role"])
+# skew is usually milliseconds; the default latency buckets start at
+# 1ms but top out too coarse between 10-100ms, where gang health lives
+_M_BARRIER_SKEW = _mx.registry().histogram(
+    "scanner_tpu_gang_barrier_skew_seconds",
+    "Per-(gang, epoch) barrier-arrival skew: max - min member arrival "
+    "at the pre-collective barrier, computed on the master from "
+    "offset-corrected member timestamps — the time every host donates "
+    "to the slowest one.",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0))
+
+
+def count_phases(phases: Optional[Dict[str, float]],
+                 role: Optional[str]) -> None:
+    """Fold one member child's phase seconds into this (parent worker)
+    process's registry."""
+    if not phases:
+        return
+    r = str(role or "member")
+    for phase, s in phases.items():
+        try:
+            _M_PHASE.labels(phase=str(phase), role=r).inc(float(s))
+        except (TypeError, ValueError):
+            continue
+
+
+def observe_barrier_skew(seconds: float) -> None:
+    _M_BARRIER_SKEW.observe(max(float(seconds), 0.0))
+
+
 # ---------------------------------------------------------------------------
 # parent side: one member child per (gang, epoch)
 # ---------------------------------------------------------------------------
@@ -232,6 +282,15 @@ def spawn_member(request: Dict[str, Any],
         fh.write(cloudpickle.dumps(request))
     child_env = dict(env if env is not None else os.environ)
     child_env.pop("SCANNER_TPU_FAULTS", None)
+    # deliberate child-side plan pass-through: SCANNER_TPU_GANG_CHILD_FAULTS
+    # arms the MEMBER process itself (e.g. a gang.collective delay that
+    # must slow the member's barrier arrival, not the parent's poll
+    # loop).  Kept separate from SCANNER_TPU_FAULTS so counted
+    # crash-mode plans stay parent-side and converge across re-forms.
+    child_plan = (env if env is not None else os.environ).get(
+        "SCANNER_TPU_GANG_CHILD_FAULTS")
+    if child_plan:
+        child_env["SCANNER_TPU_FAULTS"] = child_plan
     proc = subprocess.Popen(
         [sys.executable, "-m", "scanner_tpu.engine.gang",
          req_path, res_path],
@@ -373,24 +432,53 @@ def _collective_digest_sum(num_processes: int, process_id: int,
 def run_member(req: Dict[str, Any]) -> Dict[str, Any]:
     """The member body (runs inside the child process): rendezvous →
     evaluate → collective agreement → (member 0) save.  Returns a
-    result dict; never raises."""
+    result dict; never raises.
+
+    Phase instrumentation (docs/observability.md §Cross-host time):
+    each phase gets a first-class child span under the gang root —
+    `gang.rendezvous`, `gang.stage`, `gang.barrier` (entry →
+    all-arrived = time donated to the slowest member) and
+    `gang.collective` (all-arrived → result-ready) — and its wall
+    seconds come back in the result dict ("phases"/"role") so the
+    parent worker can fold them into the scraped registry."""
     from ..parallel.distributed import (CoordinatorConfig,
                                         RendezvousError, initialize,
                                         shutdown)
+    from ..util import tracing as _tr
     pid = int(req["process_id"])
     num = int(req["num_processes"])
+    tracer = _tr.Tracer(
+        node=req.get("node") or f"gang-m{pid}", export=True)
+    ctx = _tr.parse_traceparent(req.get("traceparent"))
+    attrs = {"gang": req.get("gang_id"), "epoch": req.get("epoch"),
+             "member": pid, "num": num,
+             "job": req.get("job_idx"), "task": req.get("task_idx")}
+    phases: Dict[str, float] = {}
+    role = "coordinator" if pid == 0 else "member"
+    t_rz = time.time()
+    rz = _tr.open_span(tracer, "gang.rendezvous", parent=ctx, **attrs)
     try:
-        initialize(
-            CoordinatorConfig(address=req["coordinator"],
-                              num_processes=num, process_id=pid),
-            init_timeout=float(req.get("init_timeout")
-                               or init_timeout_s()))
+        # current-span context so distributed.initialize's rendezvous
+        # events land ON the gang.rendezvous span's timeline
+        with _tr.use_span(tracer, rz):
+            initialize(
+                CoordinatorConfig(address=req["coordinator"],
+                                  num_processes=num, process_id=pid),
+                init_timeout=float(req.get("init_timeout")
+                                   or init_timeout_s()))
     except RendezvousError as e:
+        _tr.close_span(tracer, rz, status="error")
         return {"ok": False, "stage": "rendezvous", "transient": True,
-                "error": str(e)}
+                "error": str(e), "spans": tracer.drain_export(),
+                "phases": phases, "role": role}
     except Exception as e:  # noqa: BLE001
+        _tr.close_span(tracer, rz, status="error")
         return {"ok": False, "stage": "rendezvous", "transient": True,
-                "error": f"{type(e).__name__}: {e}"}
+                "error": f"{type(e).__name__}: {e}",
+                "spans": tracer.drain_export(),
+                "phases": phases, "role": role}
+    _tr.close_span(tracer, rz)
+    phases["rendezvous"] = time.time() - t_rz
     marker = req.get("joined_marker")
     if marker:
         try:
@@ -399,17 +487,22 @@ def run_member(req: Dict[str, Any]) -> Dict[str, Any]:
         except OSError:
             pass
     try:
-        return _member_body(req, pid, num)
+        res = _member_body(req, pid, num, tracer, ctx, attrs, phases)
     except Exception as e:  # noqa: BLE001 — collective/commit errors
         # surface as a transient member failure, not a child crash
-        return {"ok": False, "stage": "collective", "transient": True,
-                "error": f"{type(e).__name__}: {e}"}
+        res = {"ok": False, "stage": "collective", "transient": True,
+               "error": f"{type(e).__name__}: {e}",
+               "spans": tracer.drain_export()}
     finally:
         shutdown()
+    res.setdefault("phases", phases)
+    res.setdefault("role", role)
+    return res
 
 
-def _member_body(req: Dict[str, Any], pid: int,
-                 num: int) -> Dict[str, Any]:
+def _member_body(req: Dict[str, Any], pid: int, num: int,
+                 tracer, ctx, attrs: Dict[str, Any],
+                 phases: Dict[str, float]) -> Dict[str, Any]:
     import cloudpickle
 
     from ..storage import Database, make_storage
@@ -419,8 +512,6 @@ def _member_body(req: Dict[str, Any], pid: int,
     db = Database(make_storage(req.get("storage_type") or "posix",
                                db_path=req["db_path"]))
     db.refresh_meta()
-    tracer = _tr.Tracer(
-        node=req.get("node") or f"gang-m{pid}", export=True)
     ex = LocalExecutor(db)
     ex.tracer = tracer
     ex._stream_opt = False  # whole-task evaluation inside the member
@@ -430,7 +521,9 @@ def _member_body(req: Dict[str, Any], pid: int,
     task_idx = int(req["task_idx"])
     w = TaskItem(job, task_idx, tuple(job.tasks[task_idx]),
                  attempt=int(req.get("attempt") or 0))
-    w.trace_ctx = _tr.parse_traceparent(req.get("traceparent"))
+    w.trace_ctx = ctx
+    t_stage = time.time()
+    st = _tr.open_span(tracer, "gang.stage", parent=ctx, **attrs)
     try:
         ex.run_single_task(info, w, save=False,
                            span_attrs={"gang": req.get("gang_id"),
@@ -438,10 +531,13 @@ def _member_body(req: Dict[str, Any], pid: int,
                                        "member": pid})
     except Exception as e:  # noqa: BLE001
         from .service import _is_transient_failure
+        _tr.close_span(tracer, st, status="error")
         return {"ok": False, "stage": "evaluate",
                 "transient": _is_transient_failure(e),
                 "error": f"{type(e).__name__}: {e}",
                 "spans": tracer.drain_export()}
+    _tr.close_span(tracer, st)
+    phases["stage"] = time.time() - t_stage
     # per-host digest shards: member p digests only rows [lo, hi) of
     # every sink's output, the collective assembles the full-task sum
     # across hosts, and member 0 — which evaluated the whole task —
@@ -457,7 +553,34 @@ def _member_body(req: Dict[str, Any], pid: int,
                                            start, end))
     local = sum(_digest_rows(rows[lo:hi])
                 for rows in sink_rows) & 0xFFFFFFFF
+    # child-side collective fault (delay plans via
+    # SCANNER_TPU_GANG_CHILD_FAULTS): fires BEFORE barrier entry, so a
+    # delayed member arrives late and the skew/attribution planes see
+    # a real straggler, not a slowed parent poll
+    if _faults.ACTIVE:
+        _faults.inject("gang.collective",
+                       detail=f"gang={req.get('gang_id')}:"
+                              f"e{req.get('epoch')}:m{pid}")
+    # barrier wait vs transfer/compute, split explicitly: a zero-digest
+    # scalar reduction is the barrier — the time member i spends in it
+    # is (all-arrived - its entry), i.e. time donated to the slowest
+    # member — and only then runs the real digest reduction, whose
+    # duration is pure collective cost.  The entry/all-arrived events
+    # carry the timestamps the master's skew fold compares.
+    t_bar = time.time()
+    bar = _tr.open_span(tracer, "gang.barrier", parent=ctx, **attrs)
+    if bar is not None:
+        bar.add_event("barrier.enter", member=pid)
+    _collective_digest_sum(num, pid, 0)
+    if bar is not None:
+        bar.add_event("barrier.all_arrived", member=pid)
+    _tr.close_span(tracer, bar)
+    t_col = time.time()
+    phases["barrier"] = t_col - t_bar
+    col = _tr.open_span(tracer, "gang.collective", parent=ctx, **attrs)
     total = _collective_digest_sum(num, pid, local)
+    _tr.close_span(tracer, col)
+    phases["collective"] = time.time() - t_col
     if pid == 0:
         expect = 0
         for p in range(num):

@@ -33,6 +33,7 @@ from ..common import CacheMode, JobException, PerfParams, ScannerException
 from ..storage import Database, make_storage
 from ..storage import metadata as md
 from ..storage.items import seal_blob
+from ..util import clocksync as _clocksync
 from ..util import coststats as _coststats
 from ..util import faults as _faults
 from ..util import health as _health
@@ -130,6 +131,9 @@ MAX_MEMORY_REPORTS = 16
 # (the straggler aggregates, which are tiny, are kept for all history)
 MAX_BULK_SPANS = 500_000
 STRAGGLER_TOP_N = 10
+# per-gang straggler attribution rows retained per bulk (newest last);
+# part of the straggler aggregates, so they survive compaction
+MAX_GANG_SKEW_ROWS = 16
 SPAN_HISTORY_BULKS = 4
 
 _mlog = get_logger("master")
@@ -426,6 +430,25 @@ class _BulkJob:
     # instead of counting as a stale-epoch NACK — it is the normal
     # tail of a healthy gang, not fence traffic
     gang_retired: Dict[int, int] = field(default_factory=dict)
+    # cross-host time plane (util/clocksync.py): node -> the worker's
+    # most recent advertised {offset, uncertainty, at}, refreshed from
+    # heartbeats and from the clock field on every ShipSpans /
+    # FinishedWork batch.  GetTrace rebases that node's spans onto
+    # master time with it (unless raw_clocks / rebase disabled); the
+    # barrier-skew fold corrects member arrival stamps with it.
+    clock_offsets: Dict[str, dict] = field(default_factory=dict)
+    # (gang_id, epoch) -> in-flight barrier-arrival fold: per-member
+    # offset-corrected arrival stamps from absorbed gang.barrier spans.
+    # Once all `num` members reported, the max-min skew is observed
+    # into the skew histogram and an attribution row is appended.
+    gang_arrivals: Dict[Tuple[int, int], dict] = field(
+        default_factory=dict)
+    # bounded ring of per-gang straggler attribution rows (newest
+    # last): gang/epoch, the slowest member's node, its lag vs the
+    # median arrival, and whether the gang step was barrier-bound or
+    # collective-bound.  Part of the straggler aggregates — survives
+    # compaction.
+    gang_skew_rows: List[dict] = field(default_factory=list)
     # retention: when this bulk ages out of the last-N history ring its
     # heavy scheduling state (done set, task_rows, per-task maps, the
     # span store) is dropped and status queries serve from this frozen
@@ -470,6 +493,11 @@ class _BulkJob:
         self.gang_forming = {}
         self.gang_retired = {}
         self.gang_aborted_keys = set()
+        # raw spans are gone, so the per-node rebase map and any
+        # incomplete barrier folds go with them; the finished
+        # gang_skew_rows are aggregates and stay
+        self.clock_offsets = {}
+        self.gang_arrivals = {}
         # profiles are deliberately KEPT: GetProfiles / Client.trace
         # device lanes retained them for all history before compaction
         # existed, and they are per-worker (bounded per bulk), not
@@ -534,6 +562,10 @@ class Master:
         self._next_bulk_id = 0
         self._bulk: Optional[_BulkJob] = None
         self._history: Dict[int, _BulkJob] = {}
+        # cluster-level clock-offset map (node -> the newest advertised
+        # estimate, from heartbeats): seeds each bulk's rebase map so a
+        # bulk admitted after the fleet converged starts corrected
+        self._clock_offsets: Dict[str, dict] = {}
         # OOM forensic reports shipped by workers (ShipMemoryReport),
         # newest-last, bounded — served back by GetMemoryReport next to
         # this process's own memstats view
@@ -733,6 +765,12 @@ class Master:
         return {"ok": True}
 
     def _rpc_heartbeat(self, req: dict) -> dict:
+        # clock-sync exchange (util/clocksync.py): t1 = arrival stamp,
+        # t2 = reply-build stamp, echoed with the worker's t0 so it can
+        # compute offset/RTT.  The worker advertises its converged
+        # estimate on the NEXT beat ("clock"); the master publishes it
+        # as the per-node offset gauges and keeps it for trace rebase.
+        t1 = time.time()
         wid = req["worker_id"]
         recs: List[dict] = []
         with self._lock:
@@ -778,6 +816,16 @@ class Master:
                 gang_ids = sorted(
                     g.gang_id for g in bulk.gangs.values()
                     if wid in g.members)
+            # the worker's advertised clock estimate: publish the
+            # gauges and retain per node for GetTrace rebase / the
+            # barrier-skew fold (node label matches its span stamps)
+            est = req.get("clock")
+            if est and _clocksync.enabled():
+                node = f"worker{wid}"
+                self._clock_offsets[node] = dict(est)
+                if bulk is not None and not bulk.compacted:
+                    bulk.clock_offsets[node] = dict(est)
+                _clocksync.publish(node, est)
         # a preemption-triggered gang abort is journaled like any other
         # scheduling mutation (outside the lock, before the ack)
         self._journal_append(recs)
@@ -788,6 +836,12 @@ class Master:
                  "generation": self.generation}
         if gang_ids is not None:
             reply["gangs"] = gang_ids
+        # four-timestamp stamps for the NTP exchange; echoing t0 keeps
+        # the worker side stateless across beats
+        if "t0" in req:
+            reply["t0"] = req["t0"]
+            reply["t1"] = t1
+            reply["t2"] = time.time()
         return reply
 
     def _rpc_new_job(self, req: dict) -> dict:
@@ -1396,6 +1450,7 @@ class Master:
             # the assign spans would otherwise pool in the tracer's
             # export buffer (cap 65536) until end-of-bulk and overflow.
             self._drain_master_spans_locked()
+            self._intake_clock_locked(bulk, req)
             self._absorb_batch_locked(bulk, req.get("spans") or ())
             if bulk.gang_hosts and req.get("gang_id") is not None:
                 # gang single-writer commit: only member 0 of the LIVE
@@ -1624,7 +1679,12 @@ class Master:
                               "members": list(g.members),
                               "coordinator": g.coordinator,
                               "age_s": round(now - g.formed_at, 3)}
-                             for g in bulk.gangs.values()]}
+                             for g in bulk.gangs.values()],
+                    # per-gang straggler attribution (newest first):
+                    # slowest member, lag vs median arrival, and the
+                    # barrier/collective verdict — the skew panel
+                    # (docs/observability.md §Cross-host time)
+                    "skew": list(reversed(bulk.gang_skew_rows))}
         return {"role": "master", "workers": workers,
                 "bulk_id": bulk_id, "bulk": status,
                 "gang": gang_panel,
@@ -1885,11 +1945,17 @@ class Master:
         dur = max(float(d.get("end") or 0.0)
                   - float(d.get("start") or 0.0), 0.0)
         if name in ("task", "load", "evaluate", "save", "gang") \
-                or name.startswith("evaluate:"):
+                or name.startswith("evaluate:") \
+                or name.startswith("gang."):
             st = bulk.span_stats.setdefault(name, [0, 0.0, 0.0])
             st[0] += 1
             st[1] += dur
             st[2] = max(st[2], dur)
+        # gang phase spans feed the per-(gang, epoch) barrier-skew fold
+        # and the straggler attribution rows (docs/observability.md
+        # §Cross-host time)
+        if name in ("gang.barrier", "gang.collective"):
+            self._fold_gang_phase_locked(bulk, name, d, dur)
         # roofline verdicts ride on the op spans (engine/evaluate.py
         # op.efficiency events); fold them into tiny aggregates so
         # stragglers answer "inefficient or overloaded" per op (the
@@ -1903,6 +1969,79 @@ class Master:
                 d.get("node"), d.get("span_id")))
             if len(bulk.slowest) > STRAGGLER_TOP_N:
                 heapq.heappop(bulk.slowest)
+
+    def _fold_gang_phase_locked(self, bulk: _BulkJob, name: str,
+                                d: dict, dur: float) -> None:
+        """One member's gang.barrier / gang.collective span into the
+        per-(gang_id, epoch) fold.  Barrier-entry stamps are corrected
+        with the shipping node's clock offset (when trustworthy) so
+        the max-min skew compares arrivals on ONE clock; once every
+        member reported, the skew histogram observes and an
+        attribution row names the slowest member.  Caller holds
+        self._lock."""
+        a = d.get("attrs") or {}
+        try:
+            gid, ep = int(a["gang"]), int(a["epoch"])
+            member, num = int(a["member"]), int(a["num"])
+        except (KeyError, TypeError, ValueError):
+            return
+        if num <= 0:
+            return
+        rec = bulk.gang_arrivals.get((gid, ep))
+        if rec is None:
+            rec = bulk.gang_arrivals[(gid, ep)] = {
+                "num": num, "job": a.get("job"), "task": a.get("task"),
+                "arrive": {}, "wait": {}, "collective": {}, "node": {},
+                "done": False}
+            # incomplete folds from gangs that aborted mid-report are
+            # garbage after the epoch bumps; bound the map
+            if len(bulk.gang_arrivals) > 4 * MAX_GANG_SKEW_ROWS:
+                for k in sorted(bulk.gang_arrivals)[
+                        :len(bulk.gang_arrivals) - 2 * MAX_GANG_SKEW_ROWS]:
+                    if not bulk.gang_arrivals[k]["done"]:
+                        del bulk.gang_arrivals[k]
+        if rec["done"]:
+            return
+        node = d.get("node")
+        rec["node"][member] = node
+        if name == "gang.barrier":
+            start = float(d.get("start") or 0.0)
+            est = bulk.clock_offsets.get(node) \
+                or self._clock_offsets.get(node)
+            if _clocksync.should_rebase(est):
+                start += float(est["offset"])
+            rec["arrive"][member] = start
+            rec["wait"][member] = dur
+        else:
+            rec["collective"][member] = dur
+        if len(rec["arrive"]) < num or len(rec["collective"]) < num:
+            return
+        rec["done"] = True
+        arrivals = sorted(rec["arrive"].items(), key=lambda kv: kv[1])
+        skew = arrivals[-1][1] - arrivals[0][1]
+        _gang.observe_barrier_skew(skew)
+        vals = [t for _, t in arrivals]
+        median = vals[len(vals) // 2] if len(vals) % 2 \
+            else (vals[len(vals) // 2 - 1] + vals[len(vals) // 2]) / 2.0
+        slow_member, slow_t = arrivals[-1]
+        coll_max = max(rec["collective"].values())
+        row = {
+            "gang": gid, "epoch": ep,
+            "job": rec["job"], "task": rec["task"],
+            "skew_s": round(skew, 4),
+            "slowest": rec["node"].get(slow_member),
+            "member": slow_member,
+            "lag_s": round(slow_t - median, 4),
+            # the gang step's binding cost: time donated to the last
+            # arrival (the skew) vs the post-arrival reduction itself
+            "bound": "barrier" if skew >= coll_max else "collective",
+            "barrier_wait_max_s": round(max(rec["wait"].values()), 4),
+            "collective_max_s": round(coll_max, 4),
+        }
+        bulk.gang_skew_rows.append(row)
+        if len(bulk.gang_skew_rows) > MAX_GANG_SKEW_ROWS:
+            del bulk.gang_skew_rows[:len(bulk.gang_skew_rows)
+                                    - MAX_GANG_SKEW_ROWS]
 
     def _drain_master_spans_locked(self) -> None:
         """Move the master's own completed spans (admission, assigns,
@@ -1940,9 +2079,15 @@ class Master:
                  "span_id": sid}
                 for dur, _seq, j, t, node, sid
                 in sorted(bulk.slowest, reverse=True)]
-        return {"per_stage": per, "slowest_tasks": slow,
-                "spans": len(bulk.spans),
-                "spans_dropped": bulk.span_drops}
+        out = {"per_stage": per, "slowest_tasks": slow,
+               "spans": len(bulk.spans),
+               "spans_dropped": bulk.span_drops}
+        if bulk.gang_skew_rows:
+            # per-gang straggler attribution (newest first): which host
+            # made each gang slow, by how much, and whether the step
+            # was barrier-bound or collective-bound
+            out["gangs"] = list(reversed(bulk.gang_skew_rows))
+        return out
 
     def _absorb_batch_locked(self, bulk: _BulkJob, spans) -> None:
         """A shipped batch into the assembly, routed by trace_id —
@@ -1968,23 +2113,57 @@ class Master:
             bulk = self._history.get(req["bulk_id"])
             if bulk is None:
                 return {"ok": False}
+            self._intake_clock_locked(bulk, req)
             self._absorb_batch_locked(bulk, req.get("spans") or [])
         return {"ok": True}
+
+    def _intake_clock_locked(self, bulk: _BulkJob, req: dict) -> None:
+        """The shipping worker's contemporaneous clock estimate rides
+        every span batch ("clock"): refresh the bulk's per-node rebase
+        map so GetTrace corrects these spans with the estimate that
+        was live when they were stamped.  Caller holds self._lock."""
+        est = req.get("clock")
+        wid = req.get("worker_id")
+        if est and wid is not None and _clocksync.enabled():
+            node = f"worker{wid}"
+            self._clock_offsets[node] = dict(est)
+            if not bulk.compacted:
+                bulk.clock_offsets[node] = dict(est)
+            _clocksync.publish(node, est)
 
     def _rpc_get_trace(self, req: dict) -> dict:
         """The assembled cross-host trace of one bulk: every shipped
         worker span plus the master's own, and the straggler summary
-        (Client.trace / tools/scanner_trace.py)."""
+        (Client.trace / tools/scanner_trace.py).  Spans are stored
+        RAW; remote nodes' timestamps are rebased onto master time at
+        read time from the per-node clock offsets — unless the caller
+        asks for raw_clocks, rebase is disabled ([trace]
+        rebase_clocks), or a node's offset uncertainty exceeds the
+        alignment threshold (that node keeps raw stamps; a wrong
+        correction smears more than it aligns)."""
         with self._lock:
             bulk = self._history.get(req["bulk_id"]) \
                 if req.get("bulk_id") is not None else self._bulk
             if bulk is None:
                 return {"error": "no such bulk job"}
             self._drain_master_spans_locked()
-            return {"trace_id": bulk.trace_id,
-                    "spans": list(bulk.spans),
-                    "spans_dropped": bulk.span_drops,
-                    "stragglers": self._stragglers_locked(bulk)}
+            spans = list(bulk.spans)
+            offsets = dict(self._clock_offsets)
+            offsets.update(bulk.clock_offsets)
+            stragglers = self._stragglers_locked(bulk)
+            trace_id = bulk.trace_id
+            drops = bulk.span_drops
+        rebased = False
+        if offsets and not req.get("raw_clocks") \
+                and _clocksync.rebase_enabled():
+            spans = _clocksync.rebase_spans(spans, offsets)
+            rebased = any(d.get("clock_rebased") for d in spans)
+        return {"trace_id": trace_id,
+                "spans": spans,
+                "spans_dropped": drops,
+                "clock_offsets": offsets,
+                "clock_rebased": rebased,
+                "stragglers": stragglers}
 
     # -- memory observability (util/memstats.py) -----------------------------
 
@@ -2551,6 +2730,11 @@ class Master:
                         # so keeping one -1 series per dead id would
                         # grow every scrape of a week-old master
                         _M_HB_AGE.remove_labels(worker=str(w.worker_id))
+                        # same churn story for the departed node's
+                        # clock gauges (the rebase MAP keeps its
+                        # estimate — already-shipped spans still need
+                        # correcting; only the scrape surface shrinks)
+                        _clocksync.unpublish(f"worker{w.worker_id}")
                 cur = self._bulk
                 if cur is not None and not cur.finished:
                     _M_TASKS_QUEUED.set(cur.q_count())
@@ -2703,6 +2887,10 @@ class Master:
         with self._lock:
             for w in self._workers.values():
                 _M_HB_AGE.remove_labels(worker=str(w.worker_id))
+            # and this master's per-node clock gauges, for the same
+            # outliving-process reason
+            for node in self._clock_offsets:
+                _clocksync.unpublish(node)
         # unbind this master's remediation actions (owner-checked: a
         # NEWER master's re-registration in the same process must
         # survive this one's delayed stop): a later transition must not
@@ -2900,6 +3088,11 @@ class Worker:
         # read as "unknown", never as "aborted".
         self._hb_reply: dict = {}
         self._hb_reply_at = 0.0
+        # clock-offset estimator vs the master (util/clocksync.py):
+        # fed by the four-timestamp exchange riding every heartbeat;
+        # the converged estimate is advertised on the next beat and
+        # stamped onto every span batch this worker ships
+        self._clock = _clocksync.OffsetEstimator()
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, name="worker-hb", daemon=True)
         self._hb_thread.start()
@@ -2935,10 +3128,23 @@ class Worker:
                 firing = _health.firing_rules()
             except Exception:  # noqa: BLE001 — liveness > health detail
                 firing = []
+            # the NTP exchange rides the beat: t0 just before send, the
+            # master echoes it back with its t1/t2 stamps, t3 below on
+            # receipt.  The current estimate is advertised too, so the
+            # master publishes the offset gauges and seeds trace rebase.
+            hb_kwargs = {}
+            if _clocksync.enabled():
+                hb_kwargs["t0"] = time.time()
+                est = self._clock.estimate()
+                if est is not None:
+                    hb_kwargs["clock"] = est
             hb = self.master.try_call("Heartbeat", worker_id=self.worker_id,
                                       timeout=PING_TIMEOUT,
                                       preempting=self._preempting,
-                                      firing=firing)
+                                      firing=firing, **hb_kwargs)
+            if hb is not None and "t1" in hb and "t0" in hb_kwargs:
+                self._clock.add_sample(hb["t0"], hb["t1"], hb["t2"],
+                                       time.time())
             if hb is None:
                 # ride a master restart out for real: a channel whose
                 # peer died mid-dial can wedge past the peer's return
@@ -3109,7 +3315,8 @@ class Worker:
         spans = self.tracer.drain_export()
         if spans:
             self.master.try_call("ShipSpans", bulk_id=bulk_id,
-                                 worker_id=self.worker_id, spans=spans)
+                                 worker_id=self.worker_id, spans=spans,
+                                 clock=self._clock.estimate())
 
     def _ship_memory_report(self) -> None:
         """Push the newest unshipped OOM memory report (if any) to the
@@ -3291,7 +3498,8 @@ class Worker:
             self.master.try_call(
                 "FinishedWork", bulk_id=bulk_id, worker_id=self.worker_id,
                 job_idx=w.job.job_idx, task_idx=w.task_idx,
-                attempt=w.attempt, spans=self.tracer.drain_export())
+                attempt=w.attempt, spans=self.tracer.drain_export(),
+                clock=self._clock.estimate())
 
         def on_task_error(w, exc) -> bool:
             _wlog.exception("worker %d: task (%d,%d) failed",
@@ -3428,13 +3636,19 @@ class Worker:
         res = _gang.spawn_member(
             request, timeout=_gang.member_timeout_s(task_timeout),
             alive=gang_alive)
+        # the member child's phase seconds fold into THIS process's
+        # metrics registry (the child's registry is never scraped)
+        _gang.count_phases(res.get("phases"), res.get("role"))
         # the member's spans (task under the gang root, stages, ops)
         # came back in the result file — ship them so the gang's whole
-        # story assembles under one trace on the master
+        # story assembles under one trace on the master.  The batch
+        # carries this worker's clock estimate: the child shares this
+        # host's clock, so its spans rebase with the same offset.
         spans = list(res.get("spans") or ()) + self.tracer.drain_export()
         if spans:
             self.master.try_call("ShipSpans", bulk_id=bulk_id,
-                                 worker_id=self.worker_id, spans=spans)
+                                 worker_id=self.worker_id, spans=spans,
+                                 clock=self._clock.estimate())
         base = dict(bulk_id=bulk_id, worker_id=self.worker_id,
                     job_idx=role["job_idx"],
                     task_idx=role["task_idx"],
@@ -3671,10 +3885,14 @@ class ClusterClient:
         and each node's firing alerts."""
         return self.master.call("GetHealth", timeout=30.0)
 
-    def get_trace(self, bulk_id: Optional[int] = None) -> dict:
+    def get_trace(self, bulk_id: Optional[int] = None,
+                  raw_clocks: bool = False) -> dict:
         """The master-assembled cross-host trace of a bulk: span dicts
-        from every node plus the straggler summary (GetTrace RPC)."""
-        return self.master.call("GetTrace", bulk_id=bulk_id)
+        from every node plus the straggler summary (GetTrace RPC).
+        Remote spans arrive rebased onto master time per node clock
+        offset unless raw_clocks=True."""
+        return self.master.call("GetTrace", bulk_id=bulk_id,
+                                raw_clocks=raw_clocks)
 
     def memory_report(self) -> dict:
         """Cluster memory forensics (GetMemoryReport RPC): the master's

@@ -118,6 +118,14 @@ def initialize(config: CoordinatorConfig,
     if init_timeout is None:
         init_timeout = DEFAULT_INIT_TIMEOUT_S
     kwargs["initialization_timeout"] = int(init_timeout)
+    # timestamped rendezvous events onto the caller's current span
+    # (engine/gang.py runs this under its gang.rendezvous span):
+    # connect -> initialized brackets the actual coordinator wait, so
+    # a slow member's join cost is readable off the merged timeline
+    from ..util import tracing as _tracing
+    _tracing.add_event("rendezvous.connect", address=config.address,
+                       process_id=config.process_id,
+                       num_processes=config.num_processes)
     try:
         jax.distributed.initialize(
             coordinator_address=config.address,
@@ -128,10 +136,14 @@ def initialize(config: CoordinatorConfig,
         # failure as RuntimeError and timeouts as XlaRuntimeError
         # (DEADLINE_EXCEEDED) depending on version; both are the same
         # transient peer-set failure to the engine
+        _tracing.add_event("rendezvous.failed",
+                           error=f"{type(e).__name__}")
         raise RendezvousError(
             f"jax.distributed.initialize failed for "
             f"process {config.process_id}/{config.num_processes} at "
             f"{config.address}: {e}") from e
+    _tracing.add_event("rendezvous.initialized",
+                       process_id=config.process_id)
     _init_config = config
 
 

@@ -529,6 +529,7 @@ def straggler_summary(span_dicts: Iterable[dict],
     their trace ids, so one jump lands in the merged trace).  Used by
     tools/scanner_trace.py on full dumps; the master maintains the same
     shape incrementally (engine/service.py) for GetJobStatus//statusz."""
+    span_dicts = list(span_dicts)  # iterated twice (gang fold below)
     per: Dict[str, List[float]] = {}
     tasks: List[Tuple[float, dict]] = []
     # roofline verdicts from op.efficiency events on evaluate:<op>
@@ -563,7 +564,73 @@ def straggler_summary(span_dicts: Iterable[dict],
             row["gang"] = a.get("gang")
             row["member"] = a.get("member")
         slowest.append(row)
-    return {"per_stage": out_stages, "slowest_tasks": slowest}
+    out = {"per_stage": out_stages, "slowest_tasks": slowest}
+    gangs = gang_skew_summary(
+        d for d in span_dicts
+        if d.get("name") in ("gang.barrier", "gang.collective"))
+    if gangs:
+        out["gangs"] = gangs
+    return out
+
+
+def gang_skew_summary(span_dicts: Iterable[dict]) -> List[dict]:
+    """Per-(gang, epoch) straggler attribution from a full span dump —
+    the same rows the master folds incrementally from absorbed
+    gang.barrier/gang.collective spans (engine/service.py
+    `_fold_gang_phase_locked`): barrier-arrival skew (max - min member
+    entry), the slowest member's node and lag vs the median arrival,
+    and whether the gang step was barrier-bound or collective-bound.
+    Assumes timestamps are already on one clock (the master rebases
+    remote spans before handing out the dump); newest epochs last."""
+    folds: Dict[Tuple[Any, Any], dict] = {}
+    for d in span_dicts:
+        name = d.get("name")
+        if name not in ("gang.barrier", "gang.collective"):
+            continue
+        a = d.get("attrs") or {}
+        if a.get("gang") is None or a.get("member") is None:
+            continue
+        rec = folds.setdefault((a.get("gang"), a.get("epoch")), {
+            "num": a.get("num"), "job": a.get("job"),
+            "task": a.get("task"),
+            "arrive": {}, "wait": {}, "collective": {}, "node": {}})
+        m = a.get("member")
+        rec["node"][m] = d.get("node")
+        dur = max(float(d.get("end") or 0.0)
+                  - float(d.get("start") or 0.0), 0.0)
+        if name == "gang.barrier":
+            rec["arrive"][m] = float(d.get("start") or 0.0)
+            rec["wait"][m] = dur
+        else:
+            rec["collective"][m] = dur
+    rows = []
+    for (gid, ep), rec in sorted(folds.items(),
+                                 key=lambda kv: (str(kv[0][0]),
+                                                 str(kv[0][1]))):
+        num = rec.get("num")
+        if not rec["arrive"] or not rec["collective"] \
+                or (num and (len(rec["arrive"]) < num
+                             or len(rec["collective"]) < num)):
+            continue  # incomplete fold (aborted gang / partial dump)
+        arrivals = sorted(rec["arrive"].items(), key=lambda kv: kv[1])
+        vals = [t for _, t in arrivals]
+        skew = vals[-1] - vals[0]
+        median = vals[len(vals) // 2] if len(vals) % 2 \
+            else (vals[len(vals) // 2 - 1] + vals[len(vals) // 2]) / 2.0
+        slow_member, slow_t = arrivals[-1]
+        coll_max = max(rec["collective"].values())
+        rows.append({
+            "gang": gid, "epoch": ep,
+            "job": rec["job"], "task": rec["task"],
+            "skew_s": round(skew, 4),
+            "slowest": rec["node"].get(slow_member),
+            "member": slow_member,
+            "lag_s": round(slow_t - median, 4),
+            "bound": "barrier" if skew >= coll_max else "collective",
+            "barrier_wait_max_s": round(max(rec["wait"].values()), 4),
+            "collective_max_s": round(coll_max, 4),
+        })
+    return rows
 
 
 def verify_chain(span_dicts: Iterable[dict]) -> Dict[str, Any]:

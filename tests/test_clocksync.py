@@ -1,0 +1,232 @@
+"""Adversarial clock-sync suite (scanner_tpu/util/clocksync.py).
+
+The NTP-style heartbeat exchange is only useful if its failure modes
+are honest, so every test here attacks the estimator the way a real
+deployment would: a fixed skew, asymmetric network delay (the one error
+NTP cannot remove, only bound), jittered RTT, and a step change in the
+peer clock (VM migration / ntpd slew).  The assertions are about the
+CONTRACT, not the arithmetic: the error stays within the published
+uncertainty, the uncertainty stays bounded by RTT/2, and an
+untrustworthy estimate refuses to rebase rather than smearing spans.
+"""
+
+import random
+import time
+
+import pytest
+
+from scanner_tpu.util import clocksync
+from scanner_tpu.util import faults
+from scanner_tpu.util.clocksync import OffsetEstimator
+
+
+def _exchange(est, true_offset, up_s, down_s, proc_s=0.0001,
+              t0=1000.0):
+    """Feed one four-timestamp exchange: the worker clock reads
+    `true_offset` LESS than the master clock (offset estimate should
+    converge to +true_offset), with `up_s`/`down_s` one-way delays."""
+    t1 = t0 + true_offset + up_s              # master stamps arrival
+    t2 = t1 + proc_s                          # master stamps reply
+    t3 = t2 - true_offset + down_s            # worker stamps receipt
+    est.add_sample(t0, t1, t2, t3)
+    return t3
+
+
+def test_fixed_offset_converges():
+    est = OffsetEstimator()
+    t0 = 1000.0
+    for _ in range(40):
+        t0 = _exchange(est, 0.5, up_s=0.002, down_s=0.002, t0=t0) + 1.0
+    e = est.estimate()
+    assert e is not None
+    assert abs(e["offset"] - 0.5) < 1e-3
+    # symmetric fixed delay: uncertainty is best-RTT/2 + no spread
+    assert e["uncertainty"] <= 0.005
+    assert e["at"] > 1000.0
+
+
+def test_asymmetric_delay_error_stays_within_uncertainty():
+    # the classic NTP blind spot: 9 ms up, 1 ms down biases the offset
+    # by (up-down)/2 = +4 ms.  The estimator cannot remove that error —
+    # the contract is that the published uncertainty COVERS it
+    # (best-RTT/2 = 5 ms >= 4 ms bias).
+    est = OffsetEstimator()
+    t0 = 1000.0
+    for _ in range(40):
+        t0 = _exchange(est, 0.1, up_s=0.009, down_s=0.001, t0=t0) + 1.0
+    e = est.estimate()
+    assert e is not None
+    err = abs(e["offset"] - 0.1)
+    assert err > 1e-4          # the bias is real...
+    assert err <= e["uncertainty"] + 1e-9   # ...and the bound is honest
+
+
+def test_jittered_rtt_prefers_low_rtt_samples():
+    # queueing jitter up to 20 ms on each leg, floor 1 ms: best-K
+    # selection should keep the estimate near truth with uncertainty
+    # far below the worst-case jitter
+    rng = random.Random(7)
+    est = OffsetEstimator()
+    t0 = 1000.0
+    for _ in range(64):
+        up = 0.001 + rng.random() * 0.020
+        down = 0.001 + rng.random() * 0.020
+        t0 = _exchange(est, -0.25, up_s=up, down_s=down, t0=t0) + 1.0
+    e = est.estimate()
+    assert e is not None
+    assert abs(e["offset"] - (-0.25)) <= e["uncertainty"] + 1e-9
+    assert e["uncertainty"] < 0.020
+
+
+def test_step_change_flushes_and_reconverges():
+    est = OffsetEstimator()
+    t0 = 1000.0
+    for _ in range(40):
+        t0 = _exchange(est, 0.05, up_s=0.002, down_s=0.002, t0=t0) + 1.0
+    assert abs(est.estimate()["offset"] - 0.05) < 1e-3
+    # the peer clock steps by 300 ms (far beyond 4x the ~1 ms bound):
+    # the window must flush, so a handful of new samples reconverge
+    # instead of EWMA-dragging through 32 stale ones
+    for _ in range(6):
+        t0 = _exchange(est, 0.35, up_s=0.002, down_s=0.002, t0=t0) + 1.0
+    e = est.estimate()
+    assert abs(e["offset"] - 0.35) < 1e-3
+
+
+def test_non_causal_stamps_discarded():
+    est = OffsetEstimator()
+    # t3 before t0 net of server time: negative RTT, clock stepped
+    # mid-RPC — must not poison the window
+    est.add_sample(1000.0, 1000.5, 1000.5001, 999.9)
+    assert est.estimate() is None
+    t0 = 1000.0
+    for _ in range(10):
+        t0 = _exchange(est, 0.0, up_s=0.001, down_s=0.001, t0=t0) + 1.0
+    assert abs(est.estimate()["offset"]) < 1e-3
+
+
+def test_should_rebase_thresholds():
+    assert not clocksync.should_rebase(None)
+    assert not clocksync.should_rebase({})
+    assert not clocksync.should_rebase(
+        {"offset": 0.1, "uncertainty": 1.0})
+    assert clocksync.should_rebase(
+        {"offset": 0.1, "uncertainty": 0.01})
+    # per-call override tightens/loosens the gate
+    assert not clocksync.should_rebase(
+        {"offset": 0.1, "uncertainty": 0.01}, max_uncertainty_s=0.001)
+    assert clocksync.should_rebase(
+        {"offset": 0.1, "uncertainty": 1.0}, max_uncertainty_s=2.0)
+    # junk uncertainty is untrustworthy, not an exception
+    assert not clocksync.should_rebase(
+        {"offset": 0.1, "uncertainty": "nan?"})
+
+
+def test_rebase_spans_shifts_trusted_nodes_only():
+    spans = [
+        {"node": "workerA", "name": "task", "start": 10.0, "end": 11.0,
+         "events": [{"name": "barrier.enter", "t": 10.5}]},
+        {"node": "workerB", "name": "task", "start": 20.0, "end": 21.0},
+        {"node": "master", "name": "job", "start": 5.0, "end": 30.0},
+    ]
+    offsets = {
+        "workerA": {"offset": 2.0, "uncertainty": 0.001},
+        # beyond REBASE_MAX_UNCERTAINTY_S: raw timestamps kept
+        "workerB": {"offset": 9.0, "uncertainty": 5.0},
+    }
+    out = clocksync.rebase_spans(spans, offsets)
+    a, b, m = out
+    assert a["start"] == 12.0 and a["end"] == 13.0
+    assert a["events"][0]["t"] == 12.5
+    assert a["clock_rebased"] is True
+    assert b["start"] == 20.0 and "clock_rebased" not in b
+    assert m["start"] == 5.0 and "clock_rebased" not in m
+    # inputs untouched (copies, not in-place edits)
+    assert spans[0]["start"] == 10.0
+    assert "clock_rebased" not in spans[0]
+
+
+def test_rebase_spans_duration_invariant():
+    spans = [{"node": "w", "name": "op", "start": 1.0, "end": 1.5}]
+    out = clocksync.rebase_spans(
+        spans, {"w": {"offset": -3.0, "uncertainty": 0.0}})
+    assert out[0]["end"] - out[0]["start"] == pytest.approx(0.5)
+
+
+@pytest.mark.chaos
+def test_heartbeat_piggyback_live_cluster(tmp_path):
+    """The real wire path: an in-process master + worker exchange
+    stamps on the heartbeat; the master ends up holding a published
+    per-node estimate whose offset is ~0 (same host clock)."""
+    from scanner_tpu.engine.service import Master, Worker
+    from scanner_tpu.util.metrics import registry
+
+    master = Master(db_path=str(tmp_path / "db"),
+                    no_workers_timeout=30.0)
+    worker = None
+    try:
+        worker = Worker(f"localhost:{master.port}",
+                        db_path=str(tmp_path / "db"))
+        deadline = time.time() + 15
+        est = None
+        while time.time() < deadline:
+            with master._lock:
+                offs = dict(master._clock_offsets)
+            if offs:
+                est = next(iter(offs.values()))
+                break
+            time.sleep(0.1)
+        assert est is not None, "no clock estimate reached the master"
+        # same host, loopback RPC: offset within a generous 50 ms
+        assert abs(est["offset"]) < 0.05
+        assert est["uncertainty"] < 0.25
+        snap = registry().snapshot()
+        for series in clocksync.CLOCKSYNC_SERIES:
+            assert snap.get(series, {}).get("samples"), series
+    finally:
+        if worker is not None:
+            worker.stop()
+        master.stop()
+
+
+@pytest.mark.chaos
+def test_asymmetric_rpc_delay_bounds_error(tmp_path):
+    """Adversarial wire test: a client-side delay on every Heartbeat
+    attempt sits BETWEEN the worker's t0 stamp and the master's t1
+    stamp — a purely asymmetric up-leg delay, the worst case for NTP.
+    The estimate may be biased by up to delay/2, but the published
+    uncertainty (best-RTT/2) must cover the bias."""
+    from scanner_tpu.engine.service import Master, Worker
+
+    delay = 0.05
+    faults.install(
+        f"rpc.client.call:delay:seconds={delay}:method=Heartbeat")
+    master = Master(db_path=str(tmp_path / "db"),
+                    no_workers_timeout=30.0)
+    worker = None
+    try:
+        worker = Worker(f"localhost:{master.port}",
+                        db_path=str(tmp_path / "db"))
+        deadline = time.time() + 20
+        est = None
+        while time.time() < deadline:
+            with master._lock:
+                offs = dict(master._clock_offsets)
+            if offs:
+                est = next(iter(offs.values()))
+                if est.get("uncertainty", 0) >= delay / 2:
+                    break
+            time.sleep(0.1)
+        assert faults.fired("rpc.client.call") > 0, \
+            "delay fault never fired"
+        assert est is not None
+        # bias is bounded by delay/2 (+ loopback slop); the bound covers
+        # it, so should_rebase still accepts this estimate only while
+        # the uncertainty stays under the rebase threshold
+        assert abs(est["offset"]) <= est["uncertainty"] + 0.01
+        assert est["uncertainty"] >= delay / 2 - 0.01
+    finally:
+        faults.clear()
+        if worker is not None:
+            worker.stop()
+        master.stop()

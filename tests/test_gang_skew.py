@@ -1,0 +1,406 @@
+"""Gang phase instrumentation + per-host straggler attribution
+(docs/observability.md §Cross-host time; engine/gang.py phase spans,
+engine/service.py `_fold_gang_phase_locked`, util/tracing.py
+`gang_skew_summary`).
+
+Layers:
+  * fold units — the master's incremental per-(gang, epoch) fold fed
+    synthetic gang.barrier/gang.collective spans: skew math, median
+    lag, barrier- vs collective-bound attribution, clock-offset
+    correction of member arrivals (a trustworthy offset flips which
+    host is "slowest"; an untrustworthy one is ignored), bounded row
+    retention, and parity with the dump-side `gang_skew_summary`;
+  * metric units — `count_phases` / `observe_barrier_skew` series;
+  * spawned e2e (slow) — the headline drill: a 2-host gang bulk with a
+    `gang.collective` delay injected into ONE worker's member children
+    (SCANNER_TPU_GANG_CHILD_FAULTS); the merged trace's barrier
+    all-arrived events align within the published uncertainty after
+    rebase, and the attribution rows name the delayed host as the
+    barrier-bound slowest member.
+"""
+
+import os
+import struct
+import subprocess
+import sys
+import time
+
+import cloudpickle
+import pytest
+
+from scanner_tpu import (CacheMode, Client, Kernel, NamedStream,
+                         PerfParams, register_op)
+from scanner_tpu.engine import gang as egang
+from scanner_tpu.engine.service import (MASTER_SERVICE,
+                                        MAX_GANG_SKEW_ROWS, Master,
+                                        _BulkJob)
+from scanner_tpu.util import metrics as _mx
+from scanner_tpu.util import tracing
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+pytestmark = pytest.mark.chaos
+
+N_ROWS = 8
+
+
+def _pk(v: int) -> bytes:
+    return struct.pack("<q", v)
+
+
+@register_op(name="GangSkewDouble")
+class GangSkewDouble(Kernel):
+    def execute(self, x: bytes) -> bytes:
+        return _pk(2 * struct.unpack("<q", x)[0])
+
+
+class _FoldHost:
+    """The minimum `self` the fold method needs: the master-side
+    per-node offset map (normally fed by heartbeats)."""
+
+    def __init__(self, offsets=None):
+        self._clock_offsets = dict(offsets or {})
+
+
+def _bulk() -> _BulkJob:
+    return _BulkJob(bulk_id=1, spec_blob=b"", task_timeout=0.0)
+
+
+def _span(name, member, node, start, dur, gang=7, epoch=2, num=2):
+    return {"name": name, "node": node, "start": start,
+            "end": start + dur, "span_id": f"s{member}",
+            "attrs": {"gang": gang, "epoch": epoch, "member": member,
+                      "num": num, "job": 0, "task": 3}}
+
+
+def _fold(host, bulk, spans):
+    for d in spans:
+        dur = max(d["end"] - d["start"], 0.0)
+        Master._fold_gang_phase_locked(host, bulk, d["name"], d, dur)
+
+
+def _skew_count() -> float:
+    entry = _mx.registry().snapshot().get(
+        "scanner_tpu_gang_barrier_skew_seconds", {})
+    return sum(s.get("count", 0) for s in entry.get("samples", []))
+
+
+# ---------------------------------------------------------------------------
+# fold units
+# ---------------------------------------------------------------------------
+
+def test_fold_attributes_barrier_bound_slowest():
+    bulk = _bulk()
+    before = _skew_count()
+    spans = [
+        # member 0 arrives at 100.0 and waits 0.4 s for member 1
+        _span("gang.barrier", 0, "workerA", 100.0, 0.4),
+        _span("gang.barrier", 1, "workerB", 100.4, 0.0),
+        _span("gang.collective", 0, "workerA", 100.4, 0.05),
+        _span("gang.collective", 1, "workerB", 100.4, 0.05),
+    ]
+    _fold(_FoldHost(), bulk, spans)
+    assert len(bulk.gang_skew_rows) == 1
+    row = bulk.gang_skew_rows[0]
+    assert row["gang"] == 7 and row["epoch"] == 2
+    assert row["skew_s"] == pytest.approx(0.4)
+    assert row["slowest"] == "workerB" and row["member"] == 1
+    # lag vs the median arrival (mean of the two): 0.2 s
+    assert row["lag_s"] == pytest.approx(0.2)
+    assert row["bound"] == "barrier"      # skew 0.4 >= collective 0.05
+    assert row["barrier_wait_max_s"] == pytest.approx(0.4)
+    assert row["collective_max_s"] == pytest.approx(0.05)
+    assert _skew_count() == before + 1
+
+
+def test_fold_collective_bound_when_arrivals_tight():
+    bulk = _bulk()
+    spans = [
+        _span("gang.barrier", 0, "workerA", 100.0, 0.001),
+        _span("gang.barrier", 1, "workerB", 100.001, 0.0),
+        _span("gang.collective", 0, "workerA", 100.0, 0.8),
+        _span("gang.collective", 1, "workerB", 100.0, 0.9),
+    ]
+    _fold(_FoldHost(), bulk, spans)
+    row = bulk.gang_skew_rows[0]
+    assert row["bound"] == "collective"
+    assert row["collective_max_s"] == pytest.approx(0.9)
+
+
+def test_fold_corrects_arrivals_with_trusted_offsets():
+    # raw stamps say workerB arrived 0.4 s late — but workerB's clock
+    # runs 0.5 s AHEAD of the master (offset -0.5): on one clock it
+    # actually arrived first, so workerA is the slowest member
+    offsets = {"workerB": {"offset": -0.5, "uncertainty": 0.001}}
+    bulk = _bulk()
+    spans = [
+        _span("gang.barrier", 0, "workerA", 100.0, 0.4),
+        _span("gang.barrier", 1, "workerB", 100.4, 0.0),
+        _span("gang.collective", 0, "workerA", 100.4, 0.01),
+        _span("gang.collective", 1, "workerB", 100.4, 0.01),
+    ]
+    _fold(_FoldHost(offsets), bulk, spans)
+    row = bulk.gang_skew_rows[0]
+    assert row["slowest"] == "workerA"
+    assert row["skew_s"] == pytest.approx(0.1)
+    # an UNTRUSTWORTHY offset (uncertainty above the rebase threshold)
+    # must be ignored — raw order stands
+    offsets_bad = {"workerB": {"offset": -0.5, "uncertainty": 5.0}}
+    bulk2 = _bulk()
+    _fold(_FoldHost(offsets_bad), bulk2, spans)
+    assert bulk2.gang_skew_rows[0]["slowest"] == "workerB"
+
+
+def test_fold_prefers_bulk_scoped_offsets():
+    # the span-batch-scoped estimate (shipped WITH the spans) wins over
+    # the master's latest heartbeat estimate
+    bulk = _bulk()
+    bulk.clock_offsets["workerB"] = {"offset": -0.5,
+                                     "uncertainty": 0.001}
+    stale = {"workerB": {"offset": 0.0, "uncertainty": 0.001}}
+    spans = [
+        _span("gang.barrier", 0, "workerA", 100.0, 0.4),
+        _span("gang.barrier", 1, "workerB", 100.4, 0.0),
+        _span("gang.collective", 0, "workerA", 100.4, 0.01),
+        _span("gang.collective", 1, "workerB", 100.4, 0.01),
+    ]
+    _fold(_FoldHost(stale), bulk, spans)
+    assert bulk.gang_skew_rows[0]["slowest"] == "workerA"
+
+
+def test_fold_incomplete_and_malformed_spans():
+    bulk = _bulk()
+    host = _FoldHost()
+    # only one member reported: no row, no histogram observation
+    before = _skew_count()
+    _fold(host, bulk, [
+        _span("gang.barrier", 0, "workerA", 100.0, 0.1),
+        _span("gang.collective", 0, "workerA", 100.1, 0.05),
+    ])
+    assert bulk.gang_skew_rows == []
+    assert _skew_count() == before
+    # malformed attrs never raise, never fold
+    Master._fold_gang_phase_locked(
+        host, bulk, "gang.barrier",
+        {"name": "gang.barrier", "attrs": {"gang": "x"}}, 0.0)
+    Master._fold_gang_phase_locked(
+        host, bulk, "gang.barrier", {"name": "gang.barrier"}, 0.0)
+    assert bulk.gang_skew_rows == []
+    # late duplicates after the fold finalized are ignored
+    _fold(host, bulk, [
+        _span("gang.barrier", 1, "workerB", 100.2, 0.0),
+        _span("gang.collective", 1, "workerB", 100.2, 0.05),
+    ])
+    assert len(bulk.gang_skew_rows) == 1
+    rows_before = list(bulk.gang_skew_rows)
+    _fold(host, bulk, [_span("gang.barrier", 0, "workerA", 200.0, 0.1)])
+    assert bulk.gang_skew_rows == rows_before
+
+
+def test_fold_bounds_rows_and_arrival_map():
+    bulk = _bulk()
+    host = _FoldHost()
+    n_epochs = MAX_GANG_SKEW_ROWS + 6
+    for ep in range(n_epochs):
+        _fold(host, bulk, [
+            _span("gang.barrier", 0, "workerA", 100.0, 0.1, epoch=ep),
+            _span("gang.barrier", 1, "workerB", 100.1, 0.0, epoch=ep),
+            _span("gang.collective", 0, "workerA", 100.1, 0.01,
+                  epoch=ep),
+            _span("gang.collective", 1, "workerB", 100.1, 0.01,
+                  epoch=ep),
+        ])
+    assert len(bulk.gang_skew_rows) == MAX_GANG_SKEW_ROWS
+    # newest epochs survive the trim
+    assert bulk.gang_skew_rows[-1]["epoch"] == n_epochs - 1
+    assert bulk.gang_skew_rows[0]["epoch"] == n_epochs \
+        - MAX_GANG_SKEW_ROWS
+
+
+def test_dump_side_summary_matches_master_fold():
+    spans = [
+        _span("gang.barrier", 0, "workerA", 100.0, 0.4),
+        _span("gang.barrier", 1, "workerB", 100.4, 0.0),
+        _span("gang.collective", 0, "workerA", 100.4, 0.05),
+        _span("gang.collective", 1, "workerB", 100.4, 0.05),
+    ]
+    bulk = _bulk()
+    _fold(_FoldHost(), bulk, spans)
+    dump_rows = tracing.gang_skew_summary(spans)
+    assert dump_rows == bulk.gang_skew_rows
+    # and straggler_summary surfaces the same rows under "gangs"
+    s = tracing.straggler_summary(spans)
+    assert s["gangs"] == dump_rows
+    # incomplete dumps yield no partial rows
+    assert tracing.gang_skew_summary(spans[:2]) == []
+
+
+# ---------------------------------------------------------------------------
+# metric units
+# ---------------------------------------------------------------------------
+
+def test_count_phases_folds_member_results():
+    def phase(name, role="member"):
+        entry = _mx.registry().snapshot().get(
+            "scanner_tpu_gang_phase_seconds_total", {})
+        for s in entry.get("samples", []):
+            if s["labels"] == {"phase": name, "role": role}:
+                return s["value"]
+        return 0.0
+
+    r0 = phase("rendezvous", "coordinator")
+    b0 = phase("barrier")
+    egang.count_phases({"rendezvous": 1.5, "barrier": 0.25,
+                        "bogus": "nan?"}, "coordinator")
+    assert phase("rendezvous", "coordinator") == pytest.approx(r0 + 1.5)
+    egang.count_phases({"barrier": 0.75}, None)   # None -> "member"
+    assert phase("barrier") == pytest.approx(b0 + 0.75)
+    egang.count_phases(None, "member")            # no-op, no raise
+
+
+def test_observe_barrier_skew_clamps_negative():
+    before = _skew_count()
+    egang.observe_barrier_skew(-0.5)
+    egang.observe_barrier_skew(0.002)
+    assert _skew_count() == before + 2
+
+
+def test_gang_phase_series_declared():
+    # SC314's contract: the series the instrumentation owns are
+    # declared next to it
+    assert "scanner_tpu_gang_phase_seconds_total" \
+        in egang.GANG_PHASE_SERIES
+    assert "scanner_tpu_gang_barrier_skew_seconds" \
+        in egang.GANG_PHASE_SERIES
+
+
+# ---------------------------------------------------------------------------
+# spawned e2e: the headline drill
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_gang_e2e_injected_delay_attributed_to_host(tmp_path):
+    """2-host gang bulk; ONE worker's member children delay 1.2 s
+    before entering the barrier (SCANNER_TPU_GANG_CHILD_FAULTS rides
+    the gang.collective site, injected pre-barrier).  Afterwards:
+
+      (a) the merged, clock-rebased trace shows barrier all-arrived
+          events aligned within the published per-node uncertainty;
+      (b) the master's attribution rows name the delayed worker's node
+          as the barrier-bound slowest member, lagging ~the delay.
+    """
+    from scanner_tpu.engine.rpc import RpcClient, wait_for_server
+    from scanner_tpu.util.jaxenv import cpu_only_env
+
+    delay = 1.2
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    db_path = str(tmp_path / "db")
+    seed = Client(db_path=db_path)
+    seed.new_table("gskew_src", ["output"],
+                   [[_pk(100 + i)] for i in range(N_ROWS)])
+    env = cpu_only_env()
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("SCANNER_TPU_FAULTS", None)
+    env.pop("SCANNER_TPU_GANG_CHILD_FAULTS", None)
+    env["SCANNER_TPU_GANG_INIT_TIMEOUT"] = "30"
+    env["SCANNER_TPU_GANG_FORM_TIMEOUT"] = "6"
+    master = Master(db_path=db_path, no_workers_timeout=30.0)
+    addr = f"localhost:{master.port}"
+
+    def spawn(extra_env=None):
+        e = dict(env)
+        e.update(extra_env or {})
+        return subprocess.Popen(
+            [sys.executable,
+             os.path.join(repo, "tests", "spawn_worker.py"), addr,
+             db_path], env=e, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+
+    # worker 0 clean; worker 1's member CHILDREN get the delay plan
+    procs = [spawn(), spawn({
+        "SCANNER_TPU_GANG_CHILD_FAULTS":
+            f"gang.collective:delay:seconds={delay}"})]
+    sc = None
+    try:
+        wait_for_server(addr, MASTER_SERVICE, timeout=60.0)
+        sc = Client(db_path=db_path, master=addr)
+        deadline = time.time() + 300
+        while time.time() < deadline \
+                and sc.job_status().get("num_workers", 0) < 2:
+            time.sleep(0.25)
+        assert sc.job_status()["num_workers"] == 2
+        col = sc.io.Input([NamedStream(sc, "gskew_src")])
+        col = sc.ops.GangSkewDouble(x=col)
+        out = NamedStream(sc, "gskew_out")
+        sc.run(sc.io.Output(col, [out]),
+               PerfParams.manual(4, N_ROWS // 2, gang_hosts=2),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+        rows = [bytes(r) for r in out.load()]
+        assert rows == [_pk(2 * (100 + i)) for i in range(N_ROWS)]
+
+        # (b) attribution: every completed gang row is barrier-bound
+        # with a lag in the ballpark of the injected delay, and they
+        # all blame the SAME node (the armed worker)
+        status = sc.job_status()
+        gangs = (status.get("stragglers") or {}).get("gangs") or []
+        assert gangs, "no gang attribution rows on GetJobStatus"
+        blamed = {g["slowest"] for g in gangs}
+        assert len(blamed) == 1, f"blame spread across {blamed}"
+        for g in gangs:
+            assert g["bound"] == "barrier", g
+            assert g["skew_s"] >= delay * 0.5, g
+            assert g["lag_s"] >= delay * 0.25, g
+
+        # (a) merged rebased trace: barrier enter events split by the
+        # delay, all-arrived events aligned within the published
+        # uncertainty (+ scheduling slop)
+        cl = RpcClient(addr, MASTER_SERVICE, timeout=30.0)
+        try:
+            reply = cl.try_call("GetTrace", bulk_id=None, retries=1)
+        finally:
+            cl.close()
+        assert reply is not None and "spans" in reply
+        offs = reply.get("clock_offsets") or {}
+        assert offs, "no clock offsets reached trace assembly"
+        for est in offs.values():
+            assert est["uncertainty"] < 0.25
+        budget = sum(e["uncertainty"] for e in offs.values()) + 0.25
+        by_epoch = {}
+        for d in reply["spans"]:
+            if d.get("name") != "gang.barrier":
+                continue
+            a = d.get("attrs") or {}
+            for ev in d.get("events") or []:
+                if ev.get("name") == "barrier.all_arrived":
+                    by_epoch.setdefault(
+                        (a.get("gang"), a.get("epoch")), []).append(
+                            (ev["t"], d.get("node")))
+        complete = {k: v for k, v in by_epoch.items() if len(v) >= 2}
+        assert complete, "no complete barrier in the merged trace"
+        for (gid, ep), stamps in complete.items():
+            ts = sorted(t for t, _ in stamps)
+            assert ts[-1] - ts[0] <= budget, (
+                f"gang {gid} epoch {ep}: all-arrived spread "
+                f"{ts[-1] - ts[0]:.3f}s > budget {budget:.3f}s")
+        # the trace's latest barrier ENTER per epoch names the same
+        # node the master blamed
+        rows_by_key = {(g["gang"], g["epoch"]): g for g in gangs}
+        checked = 0
+        for d in reply["spans"]:
+            if d.get("name") != "gang.barrier":
+                continue
+            a = d.get("attrs") or {}
+            row = rows_by_key.get((a.get("gang"), a.get("epoch")))
+            if row is not None and a.get("member") == row["member"]:
+                assert d.get("node") == row["slowest"]
+                checked += 1
+        assert checked, "no barrier span matched an attribution row"
+    finally:
+        if sc is not None:
+            sc.stop()
+        seed.stop()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait(timeout=10)
+        master.stop()

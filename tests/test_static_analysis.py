@@ -992,6 +992,193 @@ def test_gang_doc_row_without_schema_key_flagged(tmp_path):
     assert any("phantom_row" in m and "no such key" in m for m in msgs)
 
 
+def _clocksync_repo(tmp_path,
+                    cs_declared=("scanner_tpu_clock_offset_seconds",
+                                 "scanner_tpu_clock_uncert_seconds"),
+                    cs_registered=("scanner_tpu_clock_offset_seconds",
+                                   "scanner_tpu_clock_uncert_seconds"),
+                    gp_declared=("scanner_tpu_gang_phase_seconds",),
+                    gp_registered=("scanner_tpu_gang_phase_seconds",),
+                    doc_series=None,
+                    spans=("gang.rendezvous", "gang.barrier"),
+                    doc_spans=None,
+                    cfg_keys=("enabled", "clocksync_enabled",
+                              "rebase_clocks"),
+                    schema_keys=("clocksync_enabled", "rebase_clocks"),
+                    with_series_markers=True,
+                    with_span_markers=True):
+    """Synthetic mini-repo for the SC314 cross-host time lints.
+
+    gang.py always also registers a lifecycle counter that is NOT in
+    GANG_PHASE_SERIES — the reverse leg must only claim
+    phase/skew-named series, not every gang metric."""
+    if doc_series is None:
+        doc_series = tuple(cs_declared) + tuple(gp_declared)
+    if doc_spans is None:
+        doc_spans = spans
+    _write(tmp_path, "setup.py", "# root marker\n")
+    regs = "\n        ".join(
+        f'_G{i} = _mx.registry().gauge("{n}", "help text", '
+        f'labels=["node"])' for i, n in enumerate(cs_registered))
+    decl = ", ".join(f'"{n}"' for n in cs_declared)
+    schema = ", ".join(f'"{k}"' for k in schema_keys)
+    _write(tmp_path, "pkg/util/clocksync.py", f"""
+        from . import metrics as _mx
+
+        {regs}
+
+        CLOCKSYNC_SERIES = ({decl},)
+
+        CONFIG_KEYS = ({schema},)
+    """)
+    gregs = "\n        ".join(
+        f'_P{i} = _mx.registry().counter("{n}", "help text", '
+        f'labels=["phase"])' for i, n in enumerate(gp_registered))
+    gdecl = ", ".join(f'"{n}"' for n in gp_declared)
+    opens = "\n            ".join(
+        f'_tr.open_span(None, "{s}")' for s in spans)
+    _write(tmp_path, "pkg/engine/gang.py", f"""
+        from ..util import metrics as _mx
+        from ..util import tracing as _tr
+
+        _M_FORMED = _mx.registry().counter(
+            "scanner_tpu_gang_formed_total", "help text")
+
+        {gregs}
+
+        GANG_PHASE_SERIES = ({gdecl},)
+
+        def member():
+            {opens}
+    """)
+    _write(tmp_path, "pkg/util/metrics.py", """
+        def registry():
+            return None
+    """)
+    _write(tmp_path, "pkg/util/tracing.py", """
+        def open_span(tracer, name, **kw):
+            return None
+    """)
+    cfg = ", ".join(f'"{k}": True' for k in cfg_keys)
+    _write(tmp_path, "pkg/config.py", f"""
+        def default_config():
+            return {{"trace": {{{cfg}}}}}
+    """)
+    rows = "\n".join(f"| `{n}` | gauge | x |" for n in doc_series)
+    stable = (f"<!-- clocksync-series:begin -->\n"
+              f"| Series | Type | Meaning |\n|---|---|---|\n"
+              f"{rows}\n<!-- clocksync-series:end -->\n"
+              if with_series_markers else rows)
+    srows = "\n".join(f"| `{s}` | a phase |" for s in doc_spans)
+    ptable = (f"<!-- gang-phase-taxonomy:begin -->\n"
+              f"| Span | Meaning |\n|---|---|\n"
+              f"{srows}\n<!-- gang-phase-taxonomy:end -->\n"
+              if with_span_markers else srows)
+    all_series = sorted(set(cs_declared) | set(cs_registered)
+                        | set(gp_declared) | set(gp_registered)
+                        | set(doc_series)
+                        | {"scanner_tpu_gang_formed_total"})
+    keys = " ".join(f"`{k}`"
+                    for k in sorted(set(cfg_keys) | set(schema_keys)))
+    _write(tmp_path, "docs/observability.md", f"""
+        Catalog (every fixture series mentioned so SC301 stays quiet):
+        {" ".join(f"`{n}`" for n in all_series)}
+
+        Config keys documented for SC304: {keys}
+
+        {stable}
+
+        {ptable}
+    """)
+    return tmp_path
+
+
+def test_clocksync_clean_fixture_is_quiet(tmp_path):
+    _clocksync_repo(tmp_path)
+    _, findings = _analyze(tmp_path, "pkg")
+    assert [f for f in findings if f.code == "SC314"] == []
+
+
+def test_clocksync_series_all_pairings_both_directions(tmp_path):
+    _clocksync_repo(
+        tmp_path,
+        cs_declared=("scanner_tpu_clock_offset_seconds",
+                     "scanner_tpu_clock_phantom"),
+        cs_registered=("scanner_tpu_clock_offset_seconds",
+                       "scanner_tpu_clock_unlisted"),
+        doc_series=("scanner_tpu_clock_offset_seconds",
+                    "scanner_tpu_gang_phase_seconds",
+                    "scanner_tpu_clock_ghost"))
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC314"]
+    assert any("scanner_tpu_clock_unlisted" in m
+               and "missing from CLOCKSYNC_SERIES" in m for m in msgs)
+    assert any("scanner_tpu_clock_phantom" in m
+               and "registers no such series" in m for m in msgs)
+    assert any("scanner_tpu_clock_phantom" in m
+               and "missing from the" in m for m in msgs)
+    assert any("scanner_tpu_clock_ghost" in m
+               and "has such a series" in m for m in msgs)
+    assert not any("`scanner_tpu_clock_offset_seconds`" in m
+                   for m in msgs)
+
+
+def test_clocksync_gang_phase_series_scoped_to_phase_names(tmp_path):
+    """The reverse leg on gang.py must flag an undeclared
+    phase/skew-named registration but NOT the lifecycle counters the
+    module also owns (SC310's exact-pairing shape would false-positive
+    on every gang metric)."""
+    _clocksync_repo(
+        tmp_path,
+        gp_declared=("scanner_tpu_gang_phase_seconds",),
+        gp_registered=("scanner_tpu_gang_phase_seconds",
+                       "scanner_tpu_gang_barrier_skew_seconds"))
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC314"]
+    assert any("scanner_tpu_gang_barrier_skew_seconds" in m
+               and "missing from GANG_PHASE_SERIES" in m for m in msgs)
+    assert not any("scanner_tpu_gang_formed_total" in m for m in msgs)
+
+
+def test_clocksync_missing_marker_tables(tmp_path):
+    _clocksync_repo(tmp_path, with_series_markers=False,
+                    with_span_markers=False)
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC314"]
+    assert any("clocksync-series" in m and "marker table" in m
+               for m in msgs)
+    assert any("gang-phase-taxonomy" in m and "marker table" in m
+               for m in msgs)
+
+
+def test_clocksync_span_taxonomy_both_directions(tmp_path):
+    _clocksync_repo(
+        tmp_path,
+        spans=("gang.rendezvous", "gang.barrier", "gang.stealth"),
+        doc_spans=("gang.rendezvous", "gang.barrier", "gang.phantom"))
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC314"]
+    assert any("`gang.stealth`" in m and "no row" in m for m in msgs)
+    assert any("`gang.phantom`" in m and "opens no" in m for m in msgs)
+    assert not any("`gang.barrier`" in m for m in msgs)
+
+
+def test_clocksync_trace_config_keys_both_directions(tmp_path):
+    """`[trace] enabled` belongs to the tracing core and is exempt;
+    every other [trace] key must pair with clocksync.CONFIG_KEYS."""
+    _clocksync_repo(
+        tmp_path,
+        cfg_keys=("enabled", "clocksync_enabled", "bogus_key"),
+        schema_keys=("clocksync_enabled", "ghost_key"))
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC314"]
+    assert any("bogus_key" in m and "does not accept" in m
+               for m in msgs)
+    assert any("ghost_key" in m and "declares no" in m for m in msgs)
+    assert not any("`enabled`" in m for m in msgs)
+    assert not any("clocksync_enabled" in m for m in msgs)
+
+
 def test_contract_rpc_contracts_table_both_directions(tmp_path):
     _write(tmp_path, "setup.py", "# root\n")
     _write(tmp_path, "pkg/rpcmod.py", """

@@ -27,8 +27,51 @@ import json
 import os
 import re
 import sys
+import time
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+# replayed-capture staleness guard: a round whose headline is an
+# opportunistic hardware capture replay (bench.py _report_capture,
+# source="opportunistic_capture") is only as fresh as the capture it
+# replays — past this age the "hardware trajectory" is a fossil and the
+# tool says so loudly instead of letting it pass as current data.
+DEFAULT_MAX_CAPTURE_AGE_DAYS = 14.0
+
+
+def capture_staleness(bench_dir, rounds, max_age_days, now=None):
+    """{} unless the NEWEST round replays an opportunistic hardware
+    capture; otherwise {"captured_at", "age_days", "stale"} — stale
+    when the capture is older than `max_age_days` (or undatable)."""
+    if not rounds:
+        return {}
+    newest = rounds[-1][1]
+    if newest.get("source") != "opportunistic_capture":
+        return {}
+    stamp = newest.get("captured_at")
+    if not stamp:
+        # older rounds didn't echo captured_at into the headline; fall
+        # back to the capture file itself
+        try:
+            with open(os.path.join(bench_dir,
+                                   "BENCH_TPU_CAPTURE.json")) as f:
+                stamp = json.load(f).get("captured_at")
+        except (OSError, ValueError):
+            stamp = None
+    age_days = None
+    if stamp:
+        try:
+            tm = time.strptime(str(stamp), "%Y-%m-%dT%H:%M:%S")
+            age_days = round(
+                ((now if now is not None else time.time())
+                 - time.mktime(tm)) / 86400.0, 2)
+        except (ValueError, OverflowError):
+            age_days = None
+    # an undatable capture counts as stale: "can't tell how old" must
+    # not read as "fresh"
+    return {"captured_at": stamp, "age_days": age_days,
+            "max_age_days": max_age_days,
+            "stale": age_days is None or age_days > max_age_days}
 
 
 def load_rounds(bench_dir):
@@ -96,7 +139,7 @@ def detail_digest(bench_dir):
         return {}
     out = {"fps_by_config": {}, "task_latency": {}, "health": {},
            "op_efficiency": {}, "frame_cache": {}, "remediation": {},
-           "failover": {}, "baseline_metrics": {}}
+           "failover": {}, "gang_skew": {}, "baseline_metrics": {}}
     for d in detail:
         if not isinstance(d, dict):
             continue
@@ -120,6 +163,9 @@ def detail_digest(bench_dir):
         elif d.get("config") == "failover":
             out["failover"] = {k: v for k, v in d.items()
                                if k != "config"}
+        elif d.get("config") in ("gang_skew", "gang_skew_hw"):
+            out["gang_skew"][d["config"]] = {
+                k: v for k, v in d.items() if k != "config"}
         elif d.get("config") == "baseline_metrics":
             out["baseline_metrics"] = d.get("metrics") or {}
     return out
@@ -193,6 +239,12 @@ def main(argv=None) -> int:
                          "not just the newest")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
+    ap.add_argument("--max-capture-age-days", type=float,
+                    default=DEFAULT_MAX_CAPTURE_AGE_DAYS,
+                    help="when the newest round replays a hardware "
+                         "capture (source=opportunistic_capture), "
+                         "captures older than this print a STALE "
+                         "CAPTURE banner (default %(default)s)")
     ap.add_argument("--write-baselines", action="store_true",
                     help="snapshot the latest BENCH_DETAIL "
                          "baseline_metrics into BENCH_BASELINES.json — "
@@ -216,6 +268,8 @@ def main(argv=None) -> int:
               file=sys.stderr)
     detail_regs = find_detail_regressions(
         load_baselines(args.dir), base_metrics, args.threshold)
+    stale = capture_staleness(args.dir, rounds,
+                              args.max_capture_age_days)
 
     if args.json:
         print(json.dumps({
@@ -232,10 +286,25 @@ def main(argv=None) -> int:
                  "change": round(ch, 4)}
                 for m, b, c, ch in detail_regs],
             "threshold": args.threshold,
+            "stale_capture": stale,
             "detail": detail,
         }, indent=1))
         return 1 if regs or detail_regs else 0
 
+    if stale.get("stale"):
+        age = stale.get("age_days")
+        print("=" * 64)
+        print(f"  STALE CAPTURE: newest round replays a hardware "
+              f"capture from {stale.get('captured_at') or 'unknown'}"
+              + (f" ({age} days old" if age is not None
+                 else " (age unknown")
+              + f" > --max-capture-age-days "
+                f"{args.max_capture_age_days:g}).")
+        print("  The hardware trajectory below is NOT current data — "
+              "re-run bench.py with the")
+        print("  TPU tunnel up (tools/tpu_window.py) to bank a fresh "
+              "capture.")
+        print("=" * 64)
     print(f"bench-history: {len(rounds)} rounds "
           f"(r{rounds[0][0]:02d}..r{rounds[-1][0]:02d}), "
           f"threshold {args.threshold:.0%}")
@@ -300,6 +369,18 @@ def main(argv=None) -> int:
                   f"task(s) lost, "
                   f"{int(fo.get('journal_replayed') or 0)} journal "
                   f"record(s) replayed")
+        for cfg, gs in sorted(
+                (detail.get("gang_skew") or {}).items()):
+            p99 = gs.get("gang_barrier_skew_p99_s")
+            unc = gs.get("clock_offset_uncertainty_s")
+            print(f"  {cfg}: barrier skew p99 "
+                  + (f"{p99 * 1e3:.1f}ms" if p99 is not None
+                     else "n/a")
+                  + ", clock uncertainty "
+                  + (f"{unc * 1e3:.1f}ms" if unc is not None
+                     else "n/a")
+                  + f", {int(gs.get('skews_observed') or 0)} "
+                    f"epoch(s) observed")
         if base_metrics:
             print("  baselines: " + "  ".join(
                 f"{k}={v.get('value')}" for k, v in

@@ -308,6 +308,24 @@ def render(status: dict, cur: dict, prev: dict, master: str,
                      f"{'EFF%':>7} {'BOUND':>8} {'GFLOP/s':>9} "
                      f"{'GB/s':>8} {'XCACHE':>6}")
         lines.extend(eff_rows)
+    # GANG SKEW (docs/observability.md §Cross-host time): per-gang
+    # straggler attribution from the master's barrier-arrival fold —
+    # which host made each gang slow, by how much, and whether the
+    # step was barrier-bound (a late arrival) or collective-bound
+    skew = ((status or {}).get("stragglers") or {}).get("gangs") or []
+    if skew:
+        lines.append("")
+        lines.append(f"GANG SKEW{'':5} {'GANG':>5} {'EPOCH':>5} "
+                     f"{'SKEW ms':>8} {'SLOWEST':>10} {'LAG ms':>7} "
+                     f"{'BOUND':>10}")
+        for g in skew[:8]:
+            lines.append(
+                f"{'':14} {str(g.get('gang')):>5} "
+                f"{str(g.get('epoch')):>5} "
+                f"{g.get('skew_s', 0) * 1e3:>8.1f} "
+                f"{str(g.get('slowest')):>10} "
+                f"{g.get('lag_s', 0) * 1e3:>7.1f} "
+                f"{str(g.get('bound')):>10}")
     # cluster health (GetHealth): the judgment layer — which rules fire
     # where, so "is it healthy" doesn't require reading the counters
     if health:
@@ -383,7 +401,12 @@ def json_doc(status: dict, cur: dict, master: str,
             },
         }
     return {"time": cur["t"], "master": master, "status": status,
-            "health": health, "nodes": nodes}
+            "health": health, "nodes": nodes,
+            # per-gang straggler attribution (also inside
+            # status.stragglers.gangs; surfaced top-level so scripts
+            # need not know the straggler summary's shape)
+            "gang_skew": ((status or {}).get("stragglers") or {})
+            .get("gangs") or []}
 
 
 # -- main -------------------------------------------------------------------

@@ -45,6 +45,18 @@ def _fmt_stragglers(trace_id: str, s: dict) -> str:
             lines.append(f"{'#%d' % (i + 1):>8} {str(t['job']):>4} "
                          f"{str(t['task']):>5} {t['seconds']:>8.3f} "
                          f"{str(t['node']):>9}  {t['span_id']}")
+    gangs = s.get("gangs") or []
+    if gangs:
+        lines.append("")
+        lines.append(f"{'GANG':>5} {'EPOCH':>5} {'SKEW ms':>8} "
+                     f"{'SLOWEST':>10} {'LAG ms':>7} {'BOUND':>10}")
+        for g in gangs:
+            lines.append(
+                f"{str(g.get('gang')):>5} {str(g.get('epoch')):>5} "
+                f"{g.get('skew_s', 0) * 1e3:>8.1f} "
+                f"{str(g.get('slowest')):>10} "
+                f"{g.get('lag_s', 0) * 1e3:>7.1f} "
+                f"{str(g.get('bound')):>10}")
     return "\n".join(lines)
 
 
@@ -67,6 +79,10 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output (straggler summary / "
                          "verify report)")
+    ap.add_argument("--raw-clocks", action="store_true",
+                    help="keep each host's uncorrected timestamps "
+                         "instead of rebasing remote spans onto master "
+                         "time via the per-node clock offsets")
     args = ap.parse_args(argv)
 
     from scanner_tpu.engine.rpc import RpcClient
@@ -75,7 +91,8 @@ def main(argv=None) -> int:
 
     client = RpcClient(args.master, MASTER_SERVICE, timeout=30.0)
     try:
-        reply = client.try_call("GetTrace", bulk_id=args.bulk, retries=1)
+        reply = client.try_call("GetTrace", bulk_id=args.bulk,
+                                raw_clocks=args.raw_clocks, retries=1)
     finally:
         client.close()
     if reply is None:
@@ -89,8 +106,11 @@ def main(argv=None) -> int:
     spans = reply["spans"]
     if args.out:
         tracing.write_chrome_trace(spans, args.out)
-        print(f"scanner-trace: wrote {len(spans)} spans to {args.out}",
-              file=sys.stderr)
+        clocks = "raw clocks" if args.raw_clocks else (
+            "clock-rebased" if reply.get("clock_rebased")
+            else "no clock correction")
+        print(f"scanner-trace: wrote {len(spans)} spans to {args.out} "
+              f"({clocks})", file=sys.stderr)
     if args.verify:
         report = tracing.verify_chain(spans)
         if args.json:

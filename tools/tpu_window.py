@@ -566,6 +566,113 @@ with open(path, "w") as f:
 print("GANG_HW " + json.dumps(res))
 """
 
+_GANG_SKEW_HW = r"""
+import json, os, struct, subprocess, sys, tempfile, time
+
+# hardware companion to bench.py's gang_skew digest: a CLEAN 2-worker
+# gang run on the real host (no injected loss), banking the barrier
+# skew p99 the master folded from offset-corrected member arrivals and
+# the worst clock-offset uncertainty a worker published
+# (util/clocksync.py).  Same single-process-exclusive constraint as
+# gang_hw: the TPU identity is probed in a throwaway subprocess, the
+# member math runs on the CPU backend — what the hardware window adds
+# is the real host's clock/net/spawn behavior under the NTP-style
+# heartbeat exchange.
+probe = subprocess.run(
+    [sys.executable, "-c",
+     "import jax; d = jax.devices()[0]; print(d.platform, d)"],
+    capture_output=True, text=True, timeout=300)
+tpu_dev = probe.stdout.strip()
+assert tpu_dev.startswith("tpu"), f"no TPU: {tpu_dev or probe.stderr[-200:]}"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import cloudpickle, jax
+from scanner_tpu import CacheMode, Client, Kernel, NamedStream, PerfParams, \
+    register_op
+from scanner_tpu.engine import gang as egang
+from scanner_tpu.engine.service import Master, Worker
+from scanner_tpu.util.metrics import registry, \
+    snapshot_histogram_quantiles
+
+def pk(v):
+    return struct.pack("<q", v)
+
+@register_op(name="GangSkewHwSleep")
+class GangSkewHwSleep(Kernel):
+    def execute(self, x: bytes) -> bytes:
+        time.sleep(0.05)
+        return pk(3 * struct.unpack("<q", x)[0])
+
+cloudpickle.register_pickle_by_value(sys.modules["__main__"])
+
+root = tempfile.mkdtemp(prefix="gang_skew_hw_")
+N = 16
+sc = Client(db_path=os.path.join(root, "db"))
+sc.new_table("gskew_src", ["output"], [[pk(200 + i)] for i in range(N)])
+m = Master(db_path=os.path.join(root, "db"), no_workers_timeout=120.0)
+addr = f"localhost:{m.port}"
+egang.set_form_timeout_s(4.0)
+workers = [Worker(addr, db_path=os.path.join(root, "db"))
+           for _ in range(2)]
+gc = Client(db_path=os.path.join(root, "db"), master=addr)
+col = gc.io.Input([NamedStream(gc, "gskew_src")])
+col = gc.ops.GangSkewHwSleep(x=col)
+out = NamedStream(gc, "gskew_out")
+t0 = time.time()
+gc.run(gc.io.Output(col, [out]), PerfParams.manual(4, 4, gang_hosts=2),
+       cache_mode=CacheMode.Overwrite, show_progress=False)
+elapsed = round(time.time() - t0, 2)
+rows = [bytes(r) for r in out.load()]
+with m._lock:
+    b = m._bulk
+    if b is None and m._history:
+        b = m._history[max(m._history)]
+    skew_rows = list(b.gang_skew_rows) if b is not None else []
+# the uncertainty gauge needs ~2 heartbeat round-trips; bounded wait
+deadline = time.time() + 10
+while time.time() < deadline:
+    if registry().snapshot().get(
+            "scanner_tpu_clock_offset_uncertainty_seconds",
+            {}).get("samples"):
+        break
+    time.sleep(0.1)
+snap = registry().snapshot()
+skq = snapshot_histogram_quantiles(
+    snap, "scanner_tpu_gang_barrier_skew_seconds")
+unc = [s["value"] for s in snap.get(
+    "scanner_tpu_clock_offset_uncertainty_seconds",
+    {}).get("samples", [])]
+res = {
+    "device": tpu_dev,
+    "members_on": "cpu (libtpu is single-process-exclusive)",
+    "rows_ok": rows == [pk(3 * (200 + i)) for i in range(N)],
+    "elapsed_s": elapsed,
+    "gang_barrier_skew_p99_s": skq.get("p99_s"),
+    "gang_barrier_skew_p50_s": skq.get("p50_s"),
+    "skews_observed": skq.get("count"),
+    "clock_offset_uncertainty_s": (round(max(unc), 6) if unc else None),
+    "gang_skew_rows": skew_rows[-4:],
+}
+gc.stop()
+for w in workers:
+    w.stop()
+m.stop()
+# bank the hardware skew digest next to bench.py's digests so
+# tools/bench_history.py folds gang_skew_hw into its gang_skew section
+path = os.path.join(os.getcwd(), "BENCH_DETAIL.json")
+try:
+    detail = json.load(open(path))
+    if not isinstance(detail, list):
+        detail = [detail]
+except Exception:
+    detail = []
+detail.append({"config": "gang_skew_hw",
+               "clock": time.strftime("%Y-%m-%dT%H:%M:%S"), **res})
+with open(path, "w") as f:
+    json.dump(detail, f, indent=1)
+print("GANG_SKEW_HW " + json.dumps(res))
+"""
+
 
 def tunnel_up() -> bool:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -630,6 +737,10 @@ def main() -> int:
         "gang-scheduled multi-host bulk on hardware (engine/gang.py "
         "-> BENCH_DETAIL.json gang_hw)", code=_GANG_HW,
         timeout=1200, marker="GANG_HW ")
+    results["gang_skew"] = run_step(
+        "clean gang barrier-skew + clock-sync digest on hardware "
+        "(util/clocksync.py -> BENCH_DETAIL.json gang_skew_hw)",
+        code=_GANG_SKEW_HW, timeout=1200, marker="GANG_SKEW_HW ")
     results["op_bench"] = run_step(
         "per-op device/host A/B (tools/op_bench.py -> OP_BENCH.json)",
         argv=[sys.executable, "tools/op_bench.py"], timeout=1200)
