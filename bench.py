@@ -967,6 +967,150 @@ def main():
             _skew_d = {"config": "gang_skew",
                        "error": f"{type(e).__name__}: {e}"}
         detail.append(_skew_d)
+
+        # sharded gang digest (engine/gang.py sharded body): the
+        # mesh-partitioned A/B — the SAME stencil bulk over a 2-host
+        # gang, run replicated (gang_sharded=False: every member
+        # evaluates all rows) then sharded (each member evaluates only
+        # its shard_range; boundary rows ride the halo exchange) —
+        # banking `gang_sharded_speedup` (better=higher): the ratio of
+        # stage-phase rows/s, measured from the slowest member role's
+        # gang.stage seconds, which excludes the fixed per-gang
+        # rendezvous constant both modes pay identically.  Per-host
+        # decode rows and halo bytes ride along as the
+        # decode-isolation trajectory (each member should decode ~1/N
+        # of the rows, not N x 1/1).
+        def _gang_sharded_digest() -> dict:
+            import struct as _struct
+            from typing import Sequence as _Seq
+
+            import numpy as _np
+
+            from scanner_tpu import FrameType, Kernel, register_op
+            from scanner_tpu.engine import gang as _egang
+            from scanner_tpu.engine.service import Master, Worker
+
+            def _pk(v: int) -> bytes:
+                return _struct.pack("<q", v)
+
+            def _tot(name: str) -> float:
+                s = registry().snapshot().get(name, {})
+                return sum(x["value"] for x in s.get("samples", []))
+
+            @register_op(name="BenchShardStencil", stencil=[-1, 0])
+            class BenchShardStencil(Kernel):
+                def execute(self, frame: _Seq[FrameType]) -> bytes:
+                    # heavy enough per row that eval dominates the
+                    # per-task fixed costs: the A/B ratio should
+                    # measure compute partitioning, not scheduler
+                    # constants, and must clear the 1.6x gate with
+                    # margin under ambient bench load
+                    time.sleep(0.08)
+                    return _pk(int(_np.asarray(frame,
+                                               _np.int64).sum()))
+
+            hdb = os.path.join(root, "gang_sharded_db")
+            n_rows = 16
+            hvid = os.path.join(root, "gang_sharded.mp4")
+            scv.synthesize_video(hvid, num_frames=n_rows, width=64,
+                                 height=48, fps=24, keyint=8)
+            seedh = Client(db_path=hdb)
+            seedh.ingest_videos([("gshard_vid", hvid)])
+            m = Master(db_path=hdb, no_workers_timeout=60.0)
+            addr = f"localhost:{m.port}"
+            old_form = _egang.form_timeout_s()
+            _egang.set_form_timeout_s(6.0)
+            workers = [Worker(addr, db_path=hdb) for _ in range(2)]
+            gc4 = Client(db_path=hdb, master=addr)
+
+            def _stage_by_role() -> dict:
+                fam = registry().snapshot().get(
+                    "scanner_tpu_gang_phase_seconds_total", {})
+                out: dict = {}
+                for s in fam.get("samples", []):
+                    if s["labels"].get("phase") == "stage":
+                        out[s["labels"].get("role")] = s["value"]
+                return out
+
+            def _shards_by_role(name: str) -> dict:
+                fam = registry().snapshot().get(name, {})
+                return {s["labels"].get("role"): s["value"]
+                        for s in fam.get("samples", [])}
+
+            def _run_mode(mode: str, sharded: bool) -> dict:
+                st0 = _stage_by_role()
+                dr0 = _shards_by_role(
+                    "scanner_tpu_gang_shard_decode_rows_total")
+                hb0 = _tot("scanner_tpu_gang_shard_halo_bytes_total")
+                col = gc4.io.Input(
+                    [NamedVideoStream(gc4, "gshard_vid")])
+                col = gc4.ops.BenchShardStencil(frame=col)
+                out = NamedStream(gc4, f"gshard_{mode}")
+                w0 = time.time()
+                gc4.run(gc4.io.Output(col, [out]),
+                        PerfParams.manual(4, 8, gang_hosts=2,
+                                          gang_sharded=sharded),
+                        cache_mode=CacheMode.Overwrite,
+                        show_progress=False)
+                wall = time.time() - w0
+                rows = len(list(out.load()))
+                st1 = _stage_by_role()
+                stage_max = max(
+                    (st1.get(r, 0.0) - st0.get(r, 0.0)
+                     for r in st1), default=0.0)
+                dr1 = _shards_by_role(
+                    "scanner_tpu_gang_shard_decode_rows_total")
+                return {
+                    "mode": mode,
+                    "rows_ok": rows == n_rows,
+                    "wall_s": round(wall, 3),
+                    "stage_s": round(stage_max, 3),
+                    "stage_rows_per_s": (
+                        round(rows / stage_max, 3)
+                        if stage_max > 0 else None),
+                    "decode_rows_by_member": {
+                        r: dr1.get(r, 0.0) - dr0.get(r, 0.0)
+                        for r in dr1},
+                    "halo_bytes": _tot(
+                        "scanner_tpu_gang_shard_halo_bytes_total")
+                        - hb0,
+                }
+
+            try:
+                rep = _run_mode("replicated", sharded=False)
+                sha = _run_mode("sharded", sharded=True)
+                speedup = None
+                if rep["stage_rows_per_s"] and sha["stage_rows_per_s"]:
+                    speedup = round(sha["stage_rows_per_s"]
+                                    / rep["stage_rows_per_s"], 3)
+                return {
+                    "config": "gang_sharded",
+                    "rows_ok": rep["rows_ok"] and sha["rows_ok"],
+                    "error": None,
+                    "replicated": rep,
+                    "sharded": sha,
+                    "gang_sharded_speedup": speedup,
+                    "shard_commit_folds_ok": sum(
+                        s["value"] for s in registry().snapshot().get(
+                            "scanner_tpu_gang_shard_commit_folds_total",
+                            {}).get("samples", [])
+                        if s["labels"].get("result") == "ok"),
+                }
+            finally:
+                _egang.set_form_timeout_s(old_form)
+                gc4.stop()
+                for w in workers:
+                    w.stop()
+                m.stop()
+                seedh.stop()
+
+        try:
+            _shard_d = _gang_sharded_digest()
+        except Exception as e:  # noqa: BLE001 — bench must not die on
+            # the sharded drill
+            _shard_d = {"config": "gang_sharded",
+                        "error": f"{type(e).__name__}: {e}"}
+        detail.append(_shard_d)
         # stable per-direction baseline keys (ROADMAP "bank per-item
         # baselines for the new directions"): one flat entry with a
         # declared better= direction per metric, so
@@ -1021,6 +1165,9 @@ def main():
                 "clock_offset_uncertainty_s": {
                     "value": _skew_d.get("clock_offset_uncertainty_s"),
                     "better": "lower"},
+                "gang_sharded_speedup": {
+                    "value": _shard_d.get("gang_sharded_speedup"),
+                    "better": "higher"},
             },
         })
         # health digest (util/health.py): alert transitions fired during

@@ -85,6 +85,16 @@ passes make each one checkable:
          config.default_config() declares (all but the tracing-owned
          `enabled`) must be exactly clocksync.CONFIG_KEYS (both
          directions)
+  SC315  sharded gang data-plane drift (engine/gang.py):
+         gang.GANG_SHARD_SERIES must match the `_shard_`-named series
+         the module registers AND the marker-delimited
+         `gang-shard-series:begin/end` table in docs/observability.md
+         (all pairings, both directions); and the sharded path's
+         config gates (`[gang] sharded` / `halo_exchange`) must
+         travel with the data plane — gang.CONFIG_KEYS and
+         config.default_config() must declare both whenever
+         GANG_SHARD_SERIES exists, and a gate without a data plane is
+         flagged too (both directions)
 """
 
 from __future__ import annotations
@@ -378,6 +388,10 @@ class ContractPass(AnalysisPass):
                  "clocksync-series table; gang.* span names vs the "
                  "gang-phase-taxonomy table; [trace] clock keys vs "
                  "clocksync.CONFIG_KEYS)",
+        "SC315": "sharded gang data-plane drift (GANG_SHARD_SERIES vs "
+                 "gang registrations vs docs gang-shard-series table; "
+                 "[gang] sharded/halo_exchange gates vs the data "
+                 "plane)",
     }
 
     def run(self, project: Project) -> List[Finding]:
@@ -394,6 +408,7 @@ class ContractPass(AnalysisPass):
         out.extend(self._fence_routing(project))
         out.extend(self._gang_contract(project))
         out.extend(self._clocksync_contract(project))
+        out.extend(self._gang_shard_contract(project))
         return out
 
     # -- SC301 / SC302 ---------------------------------------------------
@@ -1446,6 +1461,116 @@ class ContractPass(AnalysisPass):
                         f"clocksync.CONFIG_KEYS accepts `{k}` but "
                         "config.default_config() declares no "
                         f"`[trace] {k}`", csmod.tree))
+        return out
+
+    # -- SC315 -----------------------------------------------------------
+
+    _SHARD_DOC_BLOCK_RE = re.compile(
+        r"<!--\s*gang-shard-series:begin\s*-->(.*?)"
+        r"<!--\s*gang-shard-series:end\s*-->", re.S)
+    # the [gang] keys that gate the sharded data plane: mode switch +
+    # halo exchange.  They must exist wherever the plane's series do —
+    # a data plane without its kill switches strands an operator mid-
+    # incident, and gates with no plane are stale doc surface
+    _SHARD_GATE_KEYS = ("sharded", "halo_exchange")
+
+    def _gang_shard_contract(self, project: Project) -> List[Finding]:
+        """Sharded gang data-plane lints: GANG_SHARD_SERIES ↔ the
+        `_shard_`-named series engine/gang.py registers ↔ the
+        gang-shard-series marker table in docs/observability.md (all
+        pairings, both directions), plus the travel-together rule for
+        the `[gang] sharded`/`halo_exchange` gates (gang.CONFIG_KEYS
+        and config.default_config() must both declare them exactly
+        when the data plane exists)."""
+        out: List[Finding] = []
+        gmod = project.module("engine/gang.py")
+        if gmod is None:
+            return out
+        series = _module_tuple(gmod, "GANG_SHARD_SERIES")
+        registered = {r.name for r in _metric_registrations(gmod)
+                      if r.name}
+        shard_named = {n for n in registered if "_shard_" in n}
+        schema = _module_tuple(gmod, "CONFIG_KEYS") or ()
+        if series is None:
+            if shard_named:
+                out.append(gmod.finding(
+                    "SC315",
+                    "gang registers shard series ("
+                    + ", ".join(f"`{n}`" for n in sorted(shard_named))
+                    + ") but declares no GANG_SHARD_SERIES tuple — "
+                    "the SC315 catalog contract cannot see them",
+                    gmod.tree))
+            else:
+                for k in self._SHARD_GATE_KEYS:
+                    if k in schema:
+                        out.append(gmod.finding(
+                            "SC315",
+                            f"gang.CONFIG_KEYS accepts `{k}` but the "
+                            "module declares no GANG_SHARD_SERIES "
+                            "data plane — a sharding gate with "
+                            "nothing to gate", gmod.tree))
+            return out
+        for name in sorted(shard_named - set(series)):
+            out.append(gmod.finding(
+                "SC315",
+                f"series `{name}` is registered in gang but missing "
+                "from GANG_SHARD_SERIES — the SC315 catalog contract "
+                "cannot see it", gmod.tree))
+        for name in sorted(set(series) - registered):
+            out.append(gmod.finding(
+                "SC315",
+                f"GANG_SHARD_SERIES names `{name}` but gang "
+                "registers no such series", gmod.tree))
+        doc = _read_doc(project, "observability.md")
+        if doc:
+            block = self._SHARD_DOC_BLOCK_RE.search(doc)
+            if block is None:
+                out.append(gmod.finding(
+                    "SC315",
+                    "gang declares GANG_SHARD_SERIES but "
+                    "docs/observability.md has no gang-shard-series "
+                    "marker table (<!-- gang-shard-series:begin/end "
+                    "-->)", gmod.tree))
+            else:
+                base_doc = self._doc_base_series(block.group(1))
+                for name in sorted(set(series) - base_doc):
+                    out.append(gmod.finding(
+                        "SC315",
+                        f"sharded gang series `{name}` is missing "
+                        "from the docs/observability.md "
+                        "gang-shard-series table", gmod.tree))
+                for name in sorted(base_doc - set(series)):
+                    out.append(Finding(
+                        code="SC315",
+                        message="docs/observability.md "
+                                "gang-shard-series table lists "
+                                f"`{name}` but GANG_SHARD_SERIES has "
+                                "no such series",
+                        path="docs/observability.md", line=1,
+                        scope="", snippet=name))
+        cfg_mod = None
+        for m in project.modules:
+            if m.relpath.endswith("config.py") \
+                    and _default_config_keys(m):
+                cfg_mod = m
+                break
+        gang_cfg = {k for sec, k in _default_config_keys(cfg_mod)
+                    if sec == "gang"} if cfg_mod is not None else None
+        for k in self._SHARD_GATE_KEYS:
+            if k not in schema:
+                out.append(gmod.finding(
+                    "SC315",
+                    "gang declares GANG_SHARD_SERIES but "
+                    f"gang.CONFIG_KEYS has no `{k}` gate — the "
+                    "sharded data plane ships without its kill "
+                    "switch", gmod.tree))
+            if gang_cfg is not None and k not in gang_cfg:
+                out.append(cfg_mod.finding(
+                    "SC315",
+                    "gang declares GANG_SHARD_SERIES but "
+                    f"config.default_config() declares no `[gang] "
+                    f"{k}` — the sharded data plane ships without "
+                    "its declared default", cfg_mod.tree))
         return out
 
     # -- SC306 / SC307 ---------------------------------------------------

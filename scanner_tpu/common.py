@@ -141,11 +141,24 @@ class PerfParams:
     # alone (exactly-once sink).  Every gang RPC is fenced by
     # (gang_id, gang_epoch): any member loss aborts the gang, bumps
     # the epoch and re-forms on the remaining capacity, strike-free.
-    # Row-sharded gang evaluation over the global mesh is the planned
-    # follow-up on this substrate.  0 (default) = ordinary
-    # independent task pulls; local (in-process) runs treat any value
-    # as a single-host gang and execute normally.
+    # 0 (default) = ordinary independent task pulls; local
+    # (in-process) runs treat any value as a single-host gang and
+    # execute normally.
     gang_hosts: int = 0
+    # Mesh-partitioned gang evaluation (default): each member loads,
+    # decodes and evaluates ONLY its contiguous row shard of every
+    # task (shard_range over the gang mesh), stencil boundary rows
+    # move between neighbors over the interconnect (parallel/halo.py)
+    # instead of widening each member's decode, and member 0 — still
+    # the single writer — assembles the per-member output shards over
+    # one all-gather and commits after the digest collective agrees:
+    # per-gang throughput is ~N× the replicated path's.  False = the
+    # pre-sharding replicated evaluation (every member computes all
+    # rows; N× redundancy, kept as the A/B + fallback mode).  A
+    # re-formed smaller gang just recomputes shard_range at the new
+    # member count.  Effective only with gang_hosts > 0; the master's
+    # [gang] sharded config must also be on.
+    gang_sharded: bool = True
 
     # reference-compat kwargs that are meaningless on TPU and accepted but
     # ignored (XLA owns device/host memory pooling; there is no CUDA pool

@@ -149,6 +149,18 @@ def default_config() -> Dict[str, Any]:
             # loss-tolerant re-form path);
             # SCANNER_TPU_GANG_FORM_TIMEOUT overrides.
             "form_timeout_s": 5,
+            # mesh-partitioned gang evaluation: each member evaluates
+            # only its row shard and member 0 assembles the output
+            # over the interconnect (~N× per-gang throughput); off =
+            # the replicated N×-redundant evaluation.  The master's
+            # value decides per gang; SCANNER_TPU_GANG_SHARDED
+            # overrides per process.
+            "sharded": True,
+            # stencil boundary rows exchange between neighbor members
+            # over the mesh (parallel/halo.py) instead of each member
+            # decoding past its shard edge; SCANNER_TPU_GANG_HALO
+            # overrides per process.
+            "halo_exchange": True,
         },
         "faults": {
             # deterministic fault-injection plan (docs/robustness.md for
@@ -338,6 +350,21 @@ class Config:
         (SCANNER_TPU_GANG_FORM_TIMEOUT overrides per process)."""
         return float(self.config.get("gang", {}).get("form_timeout_s",
                                                      5))
+
+    @property
+    def gang_sharded(self) -> bool:
+        """Mesh-partitioned gang evaluation — members evaluate only
+        their row shard (the deployment default;
+        SCANNER_TPU_GANG_SHARDED overrides per process)."""
+        return bool(self.config.get("gang", {}).get("sharded", True))
+
+    @property
+    def gang_halo_exchange(self) -> bool:
+        """Stencil boundary rows exchange between neighbor members
+        over the mesh instead of decoding redundantly (the deployment
+        default; SCANNER_TPU_GANG_HALO overrides per process)."""
+        return bool(self.config.get("gang", {}).get("halo_exchange",
+                                                    True))
 
     @property
     def faults_plan(self) -> Optional[str]:

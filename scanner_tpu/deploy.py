@@ -190,6 +190,13 @@ class ClusterConfig:
     gang: bool = True
     gang_init_timeout_s: int = 60
     gang_form_timeout_s: int = 5
+    # mesh-partitioned gang evaluation (members compute only their row
+    # shard; ~N× per-gang throughput) and the stencil halo exchange
+    # that rides on it — the fleet-wide [gang] sharded/halo_exchange
+    # defaults; gang_sharded=False pins a fleet to the replicated
+    # N×-redundant evaluation (the A/B + fallback mode)
+    gang_sharded: bool = True
+    gang_halo_exchange: bool = True
 
     def price_per_hour(self) -> float:
         return (self.master_cpus * CPU_PRICE_PER_CORE
@@ -324,6 +331,8 @@ def config_manifest(cfg: ClusterConfig) -> Dict:
         "enabled": cfg.gang,
         "init_timeout_s": cfg.gang_init_timeout_s,
         "form_timeout_s": cfg.gang_form_timeout_s,
+        "sharded": cfg.gang_sharded,
+        "halo_exchange": cfg.gang_halo_exchange,
     }
     toml = dump_toml(sections)
     return {

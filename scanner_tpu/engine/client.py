@@ -149,6 +149,10 @@ class Client:
                 _gang_cfg.set_init_timeout_s(cfg.gang_init_timeout_s)
             if not os.environ.get("SCANNER_TPU_GANG_FORM_TIMEOUT"):
                 _gang_cfg.set_form_timeout_s(cfg.gang_form_timeout_s)
+            if not os.environ.get("SCANNER_TPU_GANG_SHARDED"):
+                _gang_cfg.set_sharded(cfg.gang_sharded)
+            if not os.environ.get("SCANNER_TPU_GANG_HALO"):
+                _gang_cfg.set_halo(cfg.gang_halo_exchange)
             # [remediation] section: the alert->action controller's
             # deployment defaults; SCANNER_TPU_REMEDIATION (read at
             # import) is the per-process kill switch and wins

@@ -169,6 +169,17 @@ class TaskItem:
     # evaluation finishes (released by the executor; a finalizer on
     # this TaskItem is the abort backstop)
     cache_leases: Optional[List[Any]] = None
+    # sharded gang members (engine/gang.py): per source node, the global
+    # rows this member does NOT decode — its neighbors own them and the
+    # post-load halo exchange delivers them over the mesh; halo_fill is
+    # the hook that runs that exchange and splices the received rows
+    # into the loaded elements before device prestaging
+    halo_drop: Optional[Dict[int, Any]] = None
+    halo_fill: Optional[Any] = None
+    # rows the loader actually decoded/read for this task (set by
+    # _load_task after any halo restriction) — the per-member decode
+    # accounting the sharded-gang metrics report
+    decode_rows: int = 0
 
 
 class _StatefulChain:
@@ -697,8 +708,17 @@ class LocalExecutor:
         # evaluator task t+1 before t and every inversion costs a
         # StateCarryMiss reload+recompute — per-task decode parallelism
         # stays available via decoder_threads.
-        n_evals = 1 if self._chains else self.pipeline_instances
-        n_loaders = 1 if self._chains else self.num_load_workers
+        # ANY stateful op serializes the same way, chained or not: a
+        # bounded-state kernel's maybe_reset only fires on row
+        # DISCONTINUITY, so an inverted first task (fresh instance,
+        # _last_row still None) would run on virgin state with no reset
+        # and no carry-miss to catch it — order is correctness here,
+        # not a perf knob.
+        stateful = any(n.spec is not None and n.spec.is_stateful
+                       for n in info.ops)
+        serialize = bool(self._chains) or stateful
+        n_evals = 1 if serialize else self.pipeline_instances
+        n_loaders = 1 if serialize else self.num_load_workers
         # Device-affine routing: when instances own distinct chips, each
         # gets its OWN queue and the loader assigns each task to the
         # least-loaded instance (round-robin tie-break) at enqueue time
@@ -1514,13 +1534,37 @@ class LocalExecutor:
                     w.elements = None
                     w.chunk_q = queue.Queue(maxsize=2)
                     w.chunk_abort = threading.Event()
+                    w.decode_rows = int(sum(
+                        len(r) for p in plans
+                        for r in p.source_rows.values()))
                     return w
             w.plan = A.derive_task_streams(
                 info, w.job.jr, w.output_range,
                 job_idx=w.job.job_idx, task_idx=w.task_idx, carry=carry)
             if chain is not None:
                 chain.planned(w.task_idx, w.plan.carry_watermarks)
+            # sharded gang members: rows owned by neighbor shards are
+            # dropped from this member's decode plan BEFORE loading —
+            # the loader and frame cache never see them — and restored
+            # afterwards so downstream row math stays whole-plan; the
+            # halo_fill hook then splices the exchanged boundary rows
+            # into the loaded batches (engine/gang.py _make_halo_filler)
+            restore: Dict[int, Any] = {}
+            if w.halo_drop:
+                for nid, drop in w.halo_drop.items():
+                    rows = w.plan.source_rows.get(nid)
+                    if rows is None or not len(drop):
+                        continue
+                    restore[nid] = rows
+                    w.plan.source_rows[nid] = \
+                        rows[~np.isin(rows, drop)]
+            w.decode_rows = int(sum(
+                len(r) for r in w.plan.source_rows.values()))
             w.elements = self._load_sources(info, w, tls)
+            if restore:
+                w.plan.source_rows.update(restore)
+            if w.halo_fill is not None:
+                w.halo_fill(info, w)
             self._prestage_device_columns(info, w)
         return w
 

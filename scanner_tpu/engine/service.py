@@ -268,6 +268,11 @@ class _Gang:
     formed_at: float
     roles_handed: Set[int] = field(default_factory=set)
     acks: Set[int] = field(default_factory=set)   # non-0 members done
+    # sharded gangs: member rank -> its shard digest, recorded from
+    # GangMemberDone acks that beat the writer's FinishedWork; the
+    # commit fold cross-checks them against the digests the writer
+    # assembled from (count_shard_fold)
+    shard_digests: Dict[int, int] = field(default_factory=dict)
     trace_parent: str = ""               # gang root span traceparent
 
 
@@ -410,6 +415,13 @@ class _BulkJob:
     # `gang_aborted_keys` marks tasks whose re-formation counts as a
     # reform in the metrics.
     gang_hosts: int = 0
+    # mesh-partitioned gang evaluation (engine/gang.py sharded members):
+    # decided once per bulk from PerfParams.gang_sharded AND the
+    # master's [gang] sharded config, and carried on every role reply so
+    # all members of a gang run the same mode; gang_halo rides along
+    # the same way ([gang] halo_exchange)
+    gang_sharded: bool = True
+    gang_halo: bool = True
     next_gang_id: int = 0
     gang_epoch: int = 0
     gangs: Dict[int, _Gang] = field(default_factory=dict)
@@ -918,6 +930,10 @@ class Master:
                     checkpoint_frequency=int(
                         getattr(perf, "checkpoint_frequency", 0) or 0),
                     sticky=sticky, gang_hosts=gang_hosts,
+                    gang_sharded=bool(
+                        getattr(perf, "gang_sharded", True))
+                    and _gang.sharded_enabled(),
+                    gang_halo=_gang.halo_enabled(),
                     admission_token=token,
                     trace_id=trace_id, trace_parent=trace_parent)
                 self._next_bulk_id += 1
@@ -1231,6 +1247,13 @@ class Master:
                 "job_idx": g.key[0], "task_idx": g.key[1],
                 "attempt": g.attempt,
                 "task_timeout": bulk.task_timeout,
+                # the MASTER decides the evaluation mode per gang and
+                # every member reads it off this reply — members can
+                # never disagree about sharding mid-gang (a single-host
+                # gang degenerates to the replicated body either way)
+                "sharded": bool(bulk.gang_sharded
+                                and len(g.members) > 1),
+                "halo": bool(bulk.gang_halo),
                 "traceparent": g.trace_parent or None}
 
     def _abort_gang_locked(self, bulk: _BulkJob, g: _Gang, reason: str,
@@ -1332,6 +1355,40 @@ class Master:
             return None
         return g
 
+    def _fold_gang_shards_locked(self, g: _Gang, req: dict) -> None:
+        """Master-side shard commit fold (sharded gangs): the writer's
+        FinishedWork carries the per-member shard digests it assembled
+        the output from plus the collective total; verify that the
+        shards sum to the total and that every member whose ack already
+        landed reported the SAME shard digest the writer assembled.
+        The gang itself already refused to commit on disagreement
+        (member 0's pre-save check), so a mismatch here means a
+        reporting-path bug — counted and logged loudly, never a strike
+        against the (already committed, already verified) task.  Caller
+        holds self._lock."""
+        result = "ok"
+        try:
+            sds = [int(x) & 0xFFFFFFFF
+                   for x in (req.get("shard_digests") or ())]
+        except (TypeError, ValueError):
+            sds = []
+        total = req.get("digest")
+        if len(sds) != len(g.members) or total is None:
+            result = "partial"
+        elif sum(sds) & 0xFFFFFFFF != int(total) & 0xFFFFFFFF:
+            result = "mismatch"
+        else:
+            for rank, d in g.shard_digests.items():
+                if 0 <= rank < len(sds) and sds[rank] != d:
+                    result = "mismatch"
+                    break
+        if result != "ok":
+            _mlog.warning(
+                "gang %d epoch %d: shard commit fold %s (writer "
+                "digests %s, total %s, acked %s)", g.gang_id, g.epoch,
+                result, sds, total, dict(g.shard_digests))
+        _gang.count_shard_fold(result)
+
     def _rpc_gang_member_done(self, req: dict) -> dict:
         """A non-coordinator member finished its (non-writing) part of
         the gang program: record the ack and release its slot in the
@@ -1360,6 +1417,15 @@ class Master:
             if wid not in g.acks:
                 g.acks.add(wid)
                 self._dec_held(bulk, wid)
+            # sharded members carry their shard digest on the ack — the
+            # ack path extended to carry shard results; the commit fold
+            # verifies them against the writer's assembled view
+            if req.get("shard_digest") is not None:
+                try:
+                    g.shard_digests[g.members.index(wid)] = \
+                        int(req["shard_digest"]) & 0xFFFFFFFF
+                except (TypeError, ValueError):
+                    pass
             return {"ok": True}
 
     def _rpc_gang_failed(self, req: dict) -> dict:
@@ -1468,6 +1534,8 @@ class Master:
                 # accepted: retire the gang — survivors' late acks are
                 # acknowledged via the retired map, and their held
                 # slots release here
+                if req.get("shard_digests") is not None:
+                    self._fold_gang_shards_locked(g, req)
                 bulk.gangs.pop(g.gang_id, None)
                 bulk.gang_by_task.pop(g.key, None)
                 bulk.gang_retired[g.gang_id] = g.epoch
@@ -2270,6 +2338,8 @@ class Master:
             "job_output_rows": dict(bulk.job_output_rows),
             "sticky": bulk.sticky,
             "gang_hosts": bulk.gang_hosts,
+            "gang_sharded": bulk.gang_sharded,
+            "gang_halo": bulk.gang_halo,
             "token": bulk.admission_token,
         }
 
@@ -2520,6 +2590,11 @@ class Master:
             sticky=bool(state.get("sticky", False)),
             # pre-gang checkpoints default to independent pulls
             gang_hosts=int(state.get("gang_hosts", 0) or 0),
+            # a failed-over master must keep the SAME evaluation mode
+            # the bulk started with (pre-sharding checkpoints ran
+            # replicated)
+            gang_sharded=bool(state.get("gang_sharded", False)),
+            gang_halo=bool(state.get("gang_halo", True)),
             admission_token=str(state.get("token", "") or ""),
             # pre-crash spans are gone with the old process; post-
             # recovery assignments still assemble under one fresh trace
@@ -3606,6 +3681,10 @@ class Worker:
             "coordinator": role["coordinator"],
             "init_timeout": _gang.init_timeout_s(),
             "task_timeout": task_timeout,
+            # evaluation mode is the MASTER's call, read off the role
+            # reply verbatim — never this worker's local config
+            "sharded": bool(role.get("sharded")),
+            "halo": bool(role.get("halo", True)),
             "traceparent": role.get("traceparent"),
             "node": f"worker{self.worker_id}",
         }
@@ -3637,8 +3716,11 @@ class Worker:
             request, timeout=_gang.member_timeout_s(task_timeout),
             alive=gang_alive)
         # the member child's phase seconds fold into THIS process's
-        # metrics registry (the child's registry is never scraped)
+        # metrics registry (the child's registry is never scraped);
+        # sharded data-plane stats (shard rows, decode rows, halo
+        # bytes) fold the same way
         _gang.count_phases(res.get("phases"), res.get("role"))
+        _gang.count_shard_stats(res.get("shard"), res.get("role"))
         # the member's spans (task under the gang root, stages, ops)
         # came back in the result file — ship them so the gang's whole
         # story assembles under one trace on the master.  The batch
@@ -3656,11 +3738,19 @@ class Worker:
                     gang_id=gid, epoch=epoch)
         if res.get("ok"):
             # single-writer completion: member 0 carries the gang's
-            # FinishedWork; everyone else acks
+            # FinishedWork — with the collective digest total and the
+            # per-member shard digests it assembled from (sharded runs)
+            # for the master's shard commit fold; everyone else acks,
+            # the ack extended to carry its own shard digest
             if pid == 0:
-                reply = self.master.try_call("FinishedWork", **base)
+                reply = self.master.try_call(
+                    "FinishedWork", **base,
+                    digest=res.get("digest"),
+                    shard_digests=res.get("shard_digests"))
             else:
-                reply = self.master.try_call("GangMemberDone", **base)
+                reply = self.master.try_call(
+                    "GangMemberDone", **base,
+                    shard_digest=res.get("shard_digest"))
             if reply is not None and self._gen.observe(reply) \
                     and reply.get("gang_stale"):
                 _wlog.warning(

@@ -26,6 +26,7 @@ requeues strike-free instead of striking a healthy job
 
 from __future__ import annotations
 
+import functools
 import os
 from dataclasses import dataclass
 from typing import Dict, Optional, Sequence
@@ -187,21 +188,106 @@ def current_config() -> Optional[CoordinatorConfig]:
     return _init_config
 
 
-def host_local_array(mesh, spec, local_data):
+def ceil_chunk(n_rows: int, num_shards: int) -> int:
+    """Rows per shard under the ceil-chunk layout (the uneven-staging
+    unit: every shard holds `chunk` rows except a short or empty tail)."""
+    if num_shards <= 0:
+        raise ScannerException(f"num_shards must be > 0, got {num_shards}")
+    return -(-max(int(n_rows), 0) // num_shards) if n_rows > 0 else 0
+
+
+def shard_rows(n_rows: int, rank: int, num_shards: int) -> tuple:
+    """Contiguous row shard [lo, hi) of rank `rank` under the ceil-chunk
+    layout: equal `ceil(n/num)` chunks with the remainder on the LAST
+    non-empty shard (tail shards may be empty).  This is the one row
+    layout shared by `shard_range` on the data plane (engine/gang.py)
+    and the uneven `host_local_array` staging below — data decoded per
+    this split stages with zero re-indexing."""
+    chunk = ceil_chunk(n_rows, num_shards)
+    lo = min(rank * chunk, n_rows)
+    hi = min((rank + 1) * chunk, n_rows)
+    return lo, hi
+
+
+def host_local_array(mesh, spec, local_data, global_rows: Optional[int]
+                     = None):
     """Assemble a global jax.Array from THIS process's shard of the data.
 
     `local_data` is the numpy block this host contributes (its slice along
     the sharded axes); the result is a global array laid out per `spec`
     over `mesh`.  The per-host data-feeding primitive for input pipelines
     (each engine worker decodes only its own rows).
+
+    `global_rows` engages the UNEVEN staging path for row counts not
+    divisible by the host axis (the last-shard-remainder case
+    `shard_rows` produces): each host passes only its own rows —
+    possibly fewer than a full chunk, possibly zero — and the function
+    zero-pads every host block to `ceil_chunk` rows so XLA sees an
+    evenly divisible global array of `num_hosts * chunk` rows.  Callers
+    slice logical rows back out after any gather (`all_gather_rows`
+    does this for you); zero padding is also identity-safe under the
+    digest-sum collectives.  Requires the LEADING dim sharded over a
+    single mesh axis (the gang "hosts" layout).
     """
     import jax
+    import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec
 
     if not isinstance(spec, PartitionSpec):
         spec = PartitionSpec(*spec)
+    if global_rows is None:
+        return jax.make_array_from_process_local_data(
+            NamedSharding(mesh, spec), local_data)
+    axis = spec[0] if len(spec) else None
+    if not isinstance(axis, str):
+        raise ScannerException(
+            "uneven host_local_array staging requires the leading dim "
+            f"sharded over one named mesh axis, got spec {spec}")
+    num = int(mesh.shape[axis])
+    chunk = ceil_chunk(int(global_rows), num)
+    local_data = np.asarray(local_data)
+    if len(local_data) > chunk:
+        raise ScannerException(
+            f"host block of {len(local_data)} rows exceeds the "
+            f"ceil-chunk of {chunk} ({global_rows} rows over {num} "
+            f"'{axis}' shards)")
+    padded = np.zeros((chunk,) + local_data.shape[1:], local_data.dtype)
+    if len(local_data):
+        padded[:len(local_data)] = local_data
     return jax.make_array_from_process_local_data(
-        NamedSharding(mesh, spec), local_data)
+        NamedSharding(mesh, spec), padded)
+
+
+@functools.lru_cache(maxsize=32)
+def _replicated_identity(mesh):
+    """The jitted replicate-everything identity for one mesh.  Cached on
+    the mesh: rebuilding the jit per call keys jax's compile cache on a
+    fresh lambda every time, so each gather re-traces — a ~100ms-1s tax
+    per collective instead of a one-time compile."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return jax.jit(lambda a: a,
+                   out_shardings=NamedSharding(mesh, PartitionSpec()))
+
+
+def all_gather_rows(mesh, axis: str, local_block,
+                    global_rows: Optional[int] = None):
+    """All-gather per-host row blocks into one full host ndarray on
+    EVERY process: stage this host's block via `host_local_array`
+    (uneven-aware when `global_rows` is passed) and run one jitted
+    identity whose output sharding is fully replicated — XLA lowers the
+    resharding to an all-gather over ICI/DCN (gloo on CPU runs).  The
+    transport primitive sharded gang members assemble their output
+    shards through (engine/gang.py)."""
+    import jax
+    import numpy as np
+
+    arr = host_local_array(mesh, (axis,), local_block,
+                           global_rows=global_rows)
+    rep = _replicated_identity(mesh)(arr)
+    out = np.asarray(jax.device_get(rep))
+    return out[:global_rows] if global_rows is not None else out
 
 
 def replicate_to_global(mesh, spec, full_data):

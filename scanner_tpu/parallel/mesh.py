@@ -74,6 +74,26 @@ def make_mesh(axes: Optional[Dict[str, int]] = None,
     return Mesh(dev_array, order)
 
 
+def host_mesh(num_processes: int,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """The gang mesh: a ("hosts", "local") Mesh whose row p is process
+    p's local device slice.  Built by every gang member over the GLOBAL
+    device set after jax.distributed rendezvous; host_local_array
+    staging and the gang's collectives (digest reduction, output-shard
+    all-gather, halo exchange — engine/gang.py) all key off the "hosts"
+    axis.  Requires the device count to divide evenly across processes
+    (jax guarantees this for homogeneous hosts)."""
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    num = int(num_processes)
+    if num <= 0 or devices.size % num:
+        raise ValueError(
+            f"cannot split {devices.size} devices over {num} hosts")
+    return Mesh(devices.reshape(num, devices.size // num),
+                ("hosts", "local"))
+
+
 def auto_axes(n: int) -> Dict[str, int]:
     """Factor n devices into a balanced (dp, sp, tp) assignment."""
     def split(x):

@@ -518,9 +518,13 @@ NAMED_PLANS = {
     # worker dies the moment its first gang member enters the
     # cross-host collective (the runner dies with it via pdeathsig) ->
     # the gang aborts on member loss, the epoch bumps, and the task
-    # re-forms on the surviving workers with zero blacklist strikes;
-    # chaos_run.py runs a gang_hosts bulk under this plan and requires
-    # bit-exact output plus a reform at epoch+1
+    # re-forms on the surviving workers with zero blacklist strikes.
+    # Gangs evaluate SHARDED by default (engine/gang.py _sharded_body),
+    # so the member dies mid-collective holding undelivered shard rows
+    # and the re-formed smaller mesh recomputes shard_range from
+    # scratch; chaos_run.py runs a gang_hosts bulk under this plan and
+    # requires bit-exact output, a reform at epoch+1, and zero non-ok
+    # shard commit folds
     "gang-host-loss": "gang.collective:crash:n=1:times=1",
 }
 

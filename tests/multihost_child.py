@@ -4,9 +4,13 @@ sharded train step whose collectives cross the process boundaries.
 
 Usage: python multihost_child.py <coordinator_port> <process_id> [n_procs]
                                  [mode]
-mode: "train" (default) or "crash" — crash exits(1) right after joining
-the runtime, simulating a host dying mid-job (the surviving ranks must
-fail or be killable, never complete wrongly).
+mode: "train" (default), "crash" — exits(1) right after joining the
+runtime, simulating a host dying mid-job (the surviving ranks must
+fail or be killable, never complete wrongly) — or "gather": every rank
+stages its UNEVEN shard of 7 rows (4 + 3 under the ceil-chunk layout)
+through all_gather_rows and prints the digest of the full gathered
+block, proving the zero-padded staging slices back to exact logical
+rows on every process.
 
 Every mode prints MULTIHOST_JOINED once the runtime rendezvous
 completes, so a launcher can kill a rank deterministically AFTER the
@@ -29,7 +33,7 @@ def free_port() -> int:
 
 def spawn_multihost(n_processes: int = 2, devices_per_process: int = 4,
                     timeout: float = 600.0, crash_rank=None, port=None,
-                    sigkill_rank=None):
+                    sigkill_rank=None, mode: str = "train"):
     """Launch n child processes running this script against one fresh
     coordinator and collect their stdout.  `timeout` bounds the WHOLE
     launch (shared deadline across children).  Kills the set on any
@@ -62,7 +66,7 @@ def spawn_multihost(n_processes: int = 2, devices_per_process: int = 4,
     deadline = time.time() + timeout
     procs = [subprocess.Popen(
         [sys.executable, child, str(port), str(pid), str(n_processes),
-         "crash" if pid == crash_rank else "train"],
+         "crash" if pid == crash_rank else mode],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
         for pid in range(n_processes)]
 
@@ -150,6 +154,30 @@ def main() -> None:
     if mode == "crash":
         # simulate this host dying mid-job, after the group is formed
         sys.exit(1)
+
+    if mode == "gather":
+        # uneven all-gather proof: 7 rows over the host axis stage as
+        # 4 + 3 (ceil-chunk, zero-padded to an even global) and gather
+        # back to the exact logical rows on EVERY rank
+        import zlib
+
+        import numpy as np
+
+        from scanner_tpu.parallel.distributed import (all_gather_rows,
+                                                      shard_rows)
+        from scanner_tpu.parallel.mesh import host_mesh
+
+        n_rows = 7
+        mesh = host_mesh(n_procs)
+        lo, hi = shard_rows(n_rows, pid, n_procs)
+        full = (np.arange(n_rows * 3, dtype=np.float32)
+                .reshape(n_rows, 3) * 1.5)
+        out = all_gather_rows(mesh, "hosts", full[lo:hi],
+                              global_rows=n_rows)
+        digest = zlib.crc32(np.ascontiguousarray(out).tobytes())
+        status = "ok" if np.array_equal(out, full) else "BAD"
+        print(f"MULTIHOST_GATHER {digest} {status}", flush=True)
+        return
 
     from scanner_tpu.models import make_sharded_train_step
     from scanner_tpu.parallel import auto_axes, make_mesh

@@ -1,0 +1,636 @@
+"""Sharded gang execution (docs/robustness.md §Sharded gangs;
+scanner_tpu/engine/gang.py sharded body + engine/service.py shard fold).
+
+Layers:
+  * pure units — ceil-chunk layout properties shared by the digest and
+    data planes, uneven host_local_array validation, deterministic
+    null-row digests, frame-cache host-shard page scoping, the
+    sharded/halo config gates;
+  * in-process master units — role replies carry the master-decided
+    sharded/halo flags (PerfParams AND the master gate AND gang size),
+    and the shard commit fold classifies ok / mismatch / partial from
+    the writer's FinishedWork against early member acks;
+  * spawned e2e (slow) — bit-exact equivalence sweeps
+    sharded vs replicated vs single-host over real virtual multi-host
+    gangs (stateless uneven rows, stencil-with-halo over synthesized
+    video, null-interleaved, Gather sampling), per-member decode
+    isolation (~1/N rows each), a SIGKILL-mid-collective chaos run that
+    re-forms smaller and stays bit-exact with zero strikes, and the
+    2-process uneven all_gather_rows proof over a real gloo runtime.
+"""
+
+import os
+import struct
+import subprocess
+import sys
+import time
+from typing import Sequence
+
+import cloudpickle
+import numpy as np
+import pytest
+
+from scanner_tpu import (CacheMode, Client, FrameType, Kernel,
+                         NamedStream, NamedVideoStream, NullElement,
+                         PerfParams, register_op)
+from scanner_tpu.common import ScannerException
+from scanner_tpu.engine import framecache as fc
+from scanner_tpu.engine import gang as egang
+from scanner_tpu.engine.service import MASTER_SERVICE, Master, Worker
+from scanner_tpu.parallel import distributed as dist
+from scanner_tpu.util import faults
+from scanner_tpu.util import metrics as _mx
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+pytestmark = pytest.mark.chaos
+
+N_ROWS = 10
+
+
+def _pk(v: int) -> bytes:
+    return struct.pack("<q", v)
+
+
+@register_op(name="ShardDouble")
+class ShardDouble(Kernel):
+    def execute(self, x: bytes) -> bytes:
+        return _pk(2 * struct.unpack("<q", x)[0])
+
+
+@register_op(name="ShardStencilSum", stencil=[-1, 0])
+class ShardStencilSum(Kernel):
+    def execute(self, frame: Sequence[FrameType]) -> bytes:
+        return _pk(int(np.asarray(frame, np.int64).sum()))
+
+
+@register_op(name="ShardFrameSum")
+class ShardFrameSum(Kernel):
+    def execute(self, frame: FrameType) -> bytes:
+        return _pk(int(np.asarray(frame, np.int64).sum()))
+
+
+def _counter(name: str, **labels) -> float:
+    entry = _mx.registry().snapshot().get(name, {})
+    if labels:
+        for s in entry.get("samples", []):
+            if all(s["labels"].get(k) == v for k, v in labels.items()):
+                return s["value"]
+        return 0.0
+    return sum(s["value"] for s in entry.get("samples", []))
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faults.clear()
+    fc.set_host_shard(None)
+    yield
+    faults.clear()
+    fc.set_host_shard(None)
+
+
+# ---------------------------------------------------------------------------
+# pure units
+# ---------------------------------------------------------------------------
+
+def test_ceil_chunk_layout_properties():
+    """The one row layout both planes share: equal ceil(n/num) chunks,
+    remainder on the last non-empty shard, tail shards empty — and
+    shard_range (the gang data plane) is exactly shard_rows."""
+    for n in (0, 1, 5, 8, 10, 17, 64):
+        for num in (1, 2, 3, 4, 7, 9):
+            chunk = dist.ceil_chunk(n, num)
+            assert chunk * num >= n
+            spans = [dist.shard_rows(n, p, num) for p in range(num)]
+            assert spans == [egang.shard_range(n, p, num)
+                             for p in range(num)]
+            assert spans[0][0] == 0 and spans[-1][1] == n
+            for (alo, ahi), (blo, bhi) in zip(spans, spans[1:]):
+                assert ahi == blo and alo <= ahi
+            lens = [hi - lo for lo, hi in spans]
+            # every shard is a full chunk until the remainder, then
+            # one short shard, then only empties
+            short = [i for i, ln in enumerate(lens) if 0 < ln < chunk]
+            assert len(short) <= 1
+            if short:
+                assert all(ln == 0 for ln in lens[short[0] + 1:])
+    with pytest.raises(ScannerException):
+        dist.ceil_chunk(4, 0)
+
+
+def test_host_local_array_uneven_validation():
+    """The uneven staging path's contracts that don't need a second
+    process: a named leading axis is required, and a host block larger
+    than the ceil-chunk is rejected."""
+    from jax.sharding import PartitionSpec
+
+    from scanner_tpu.parallel.mesh import host_mesh
+
+    mesh = host_mesh(1)
+    block = np.arange(12, dtype=np.float32).reshape(3, 4)
+    with pytest.raises(ScannerException, match="named mesh axis"):
+        dist.host_local_array(mesh, PartitionSpec(None), block,
+                              global_rows=3)
+    with pytest.raises(ScannerException, match="exceeds"):
+        dist.host_local_array(mesh, ("hosts",), np.zeros((5, 2)),
+                              global_rows=3)
+    # single-host roundtrip: uneven staging degenerates to identity
+    out = dist.all_gather_rows(mesh, "hosts", block, global_rows=3)
+    assert np.array_equal(out, block)
+
+
+def test_digest_rows_null_and_object_deterministic():
+    """Null rows digest as a fixed sentinel and object rows by count —
+    NEVER by buffer pointer bytes, which would differ across gang
+    member processes and break the cross-host agreement."""
+    rows = [b"abc", NullElement(), np.arange(3)]
+    assert egang._digest_rows(rows) == egang._digest_rows(
+        [b"abc", NullElement(), np.arange(3)])
+    # a null is distinguishable from an absent row and from data
+    assert egang._digest_rows([NullElement()]) \
+        != egang._digest_rows([])
+    assert egang._digest_rows([NullElement()]) \
+        != egang._digest_rows([b""])
+    # object-dtype arrays contribute a constant (their buffer is
+    # process-local pointers), so two distinct instances agree
+    o1 = np.array([object(), object()], dtype=object)
+    o2 = np.array([object(), object()], dtype=object)
+    assert egang._digest_rows([o1]) == egang._digest_rows([o2])
+
+
+def test_framecache_pages_scoped_by_host_shard():
+    """Pages staged under one shard identity never serve another (or
+    the unsharded identity): a re-formed gang at a different N — whose
+    shard boundaries moved — can never gather a stale page."""
+    import jax.numpy as jnp
+
+    pool = fc.FrameCache()
+    old_pf = fc._page_frames_cfg
+    fc.set_page_frames(4)
+    try:
+        rows = np.arange(4)
+        block = jnp.asarray(np.arange(4 * 3, dtype=np.uint8)
+                            .reshape(4, 3))
+        fc.set_host_shard("s0of2")
+        p = pool.plan(None, ("db", 1), "frame", 0, "rgb", rows, 4)
+        assert p.skey[0] == "s0of2"
+        assert not p.hit_mask.any()
+        pool._offer_block(p, rows, block, (1, 3))
+        p.lease.release()
+        warm = pool.plan(None, ("db", 1), "frame", 0, "rgb", rows, 4)
+        assert warm.hit_mask.all(), "page never completed"
+        warm.lease.release()
+        # the same rows under a DIFFERENT shard identity: all misses
+        fc.set_host_shard("s1of2")
+        other = pool.plan(None, ("db", 1), "frame", 0, "rgb", rows, 4)
+        assert not other.hit_mask.any()
+        other.lease.release()
+        # ... and under the unsharded identity too
+        fc.set_host_shard(None)
+        plain = pool.plan(None, ("db", 1), "frame", 0, "rgb", rows, 4)
+        assert plain.skey == (("db", 1), "frame", 0, "rgb")
+        assert not plain.hit_mask.any()
+        plain.lease.release()
+    finally:
+        fc.set_page_frames(old_pf)
+
+
+def test_sharded_config_gates_roundtrip():
+    assert "sharded" in egang.CONFIG_KEYS
+    assert "halo_exchange" in egang.CONFIG_KEYS
+    old_s, old_h = egang.sharded_enabled(), egang.halo_enabled()
+    try:
+        egang.set_sharded(False)
+        assert not egang.sharded_enabled()
+        egang.set_sharded(True)
+        assert egang.sharded_enabled()
+        egang.set_halo(False)
+        assert not egang.halo_enabled()
+    finally:
+        egang.set_sharded(old_s)
+        egang.set_halo(old_h)
+
+
+# ---------------------------------------------------------------------------
+# in-process master units
+# ---------------------------------------------------------------------------
+
+def _seed_db(tmp_path, name="db"):
+    db_path = str(tmp_path / name)
+    sc = Client(db_path=db_path)
+    sc.new_table("shard_src", ["output"],
+                 [[_pk(100 + i)] for i in range(N_ROWS)])
+    return sc, db_path
+
+
+def _spec_blob(sc, out_name, gang_hosts=2, io=4, **perf_kw):
+    col = sc.io.Input([NamedStream(sc, "shard_src")])
+    col = sc.ops.ShardDouble(x=col)
+    out = NamedStream(sc, out_name)
+    node = sc.io.Output(col, [out])
+    return cloudpickle.dumps({
+        "outputs": [node],
+        "perf": PerfParams.manual(2, io, gang_hosts=gang_hosts,
+                                  **perf_kw),
+        "cache_mode": CacheMode.Overwrite.value})
+
+
+def _register(master, n, base_port=7200):
+    return [master._rpc_register_worker(
+        {"address": "", "gang_address": f"localhost:{base_port + i}"}
+    )["worker_id"] for i in range(n)]
+
+
+def _form(master, bid, wids):
+    roles = {}
+    deadline = time.time() + 10
+    while time.time() < deadline and len(roles) < len(wids):
+        for wid in wids:
+            r = master._rpc_next_work({"worker_id": wid,
+                                       "bulk_id": bid})
+            if r.get("status") == "gang":
+                roles[wid] = r
+        if not roles:
+            time.sleep(0.02)
+    assert roles, "no gang formed"
+    return roles
+
+
+def test_role_reply_carries_master_decided_mode(tmp_path):
+    """The sharded/halo decision is minted ONCE, by the master, and
+    rides the role reply — members can never disagree about the
+    evaluation mode.  PerfParams.gang_sharded=False, the master-side
+    gate, and a singleton gang each force it off."""
+    sc, db_path = _seed_db(tmp_path)
+    m = Master(db_path=db_path, no_workers_timeout=60.0)
+    old = egang.sharded_enabled()
+    try:
+        w0, w1 = _register(m, 2)
+        bid = m._rpc_new_job({"spec": _spec_blob(sc, "mode_on"),
+                              "token": "t"})["bulk_id"]
+        roles = _form(m, bid, [w0, w1])
+        for r in roles.values():
+            assert r["sharded"] is True and r["halo"] is True
+        m.stop()
+        # PerfParams opt-out (fresh db: an unfinished bulk would be
+        # recovered by a successor master over the same journal)
+        sc2, db2 = _seed_db(tmp_path, "db2")
+        m = Master(db_path=db2, no_workers_timeout=60.0)
+        try:
+            w0, w1 = _register(m, 2)
+            bid2 = m._rpc_new_job({"spec": _spec_blob(
+                sc2, "mode_perf", gang_sharded=False),
+                "token": "t2"})["bulk_id"]
+            roles = _form(m, bid2, [w0, w1])
+            assert all(r["sharded"] is False
+                       for r in roles.values())
+        finally:
+            sc2.stop()
+    finally:
+        egang.set_sharded(old)
+        m.stop()
+        sc.stop()
+
+
+def test_role_reply_master_gate_and_singleton(tmp_path):
+    sc, db_path = _seed_db(tmp_path)
+    old_s = egang.sharded_enabled()
+    old_t = egang.form_timeout_s()
+    egang.set_sharded(False)  # master-side gate wins over PerfParams
+    m = Master(db_path=db_path, no_workers_timeout=60.0)
+    try:
+        w0, w1 = _register(m, 2)
+        bid = m._rpc_new_job({"spec": _spec_blob(sc, "mode_gate"),
+                              "token": "t"})["bulk_id"]
+        roles = _form(m, bid, [w0, w1])
+        assert all(r["sharded"] is False for r in roles.values())
+        m.stop()
+        # a singleton gang has nothing to shard: flag is off even with
+        # every gate open (fresh db: see the opt-out test above)
+        egang.set_sharded(True)
+        egang.set_form_timeout_s(0.05)
+        sc2, db2 = _seed_db(tmp_path, "db2")
+        m2 = Master(db_path=db2, no_workers_timeout=60.0)
+        try:
+            (v0,) = _register(m2, 1)
+            bid = m2._rpc_new_job({"spec": _spec_blob(
+                sc2, "mode_one", gang_hosts=1),
+                "token": "t"})["bulk_id"]
+            roles = _form(m2, bid, [v0])
+            assert all(r["sharded"] is False for r in roles.values())
+        finally:
+            m2.stop()
+            sc2.stop()
+    finally:
+        egang.set_sharded(old_s)
+        egang.set_form_timeout_s(old_t)
+        sc.stop()
+
+
+def test_shard_commit_fold_ok_mismatch_partial(tmp_path):
+    """The master-side shard commit fold over the real RPC path: the
+    writer's FinishedWork digests vs early GangMemberDone acks —
+    ok when shards sum to the collective total and acked ranks agree,
+    mismatch when either check fails, partial when digests are
+    missing.  Never a strike: the fold is observational."""
+    sc, db_path = _seed_db(tmp_path)
+    m = Master(db_path=db_path, no_workers_timeout=60.0)
+    try:
+        w0, w1 = _register(m, 2)
+        # io=2 over 10 rows -> 5 tasks -> 5 gangs: one per scenario
+        bid = m._rpc_new_job({"spec": _spec_blob(sc, "fold", io=2),
+                              "token": "t"})["bulk_id"]
+        strikes0 = _counter("scanner_tpu_blacklist_strikes_total")
+
+        def run_gang(shard_digest_ack, digest, shard_digests):
+            roles = _form(m, bid, [w0, w1])
+            r = next(iter(roles.values()))
+            m0 = w0 if roles[w0]["process_id"] == 0 else w1
+            m1 = w1 if m0 == w0 else w0
+            base = dict(bulk_id=bid, gang_id=r["gang_id"],
+                        epoch=r["epoch"], job_idx=r["job_idx"],
+                        task_idx=r["task_idx"], attempt=r["attempt"])
+            if shard_digest_ack is not None:
+                assert m._rpc_gang_member_done(
+                    dict(base, worker_id=m1,
+                         shard_digest=shard_digest_ack))["ok"]
+            assert m._rpc_finished_work(
+                dict(base, worker_id=m0, digest=digest,
+                     shard_digests=shard_digests)) == {"ok": True}
+
+        def fold(result):
+            return _counter(
+                "scanner_tpu_gang_shard_commit_folds_total",
+                result=result)
+
+        ok0, mis0, par0 = fold("ok"), fold("mismatch"), fold("partial")
+        run_gang(7, (5 + 7) & 0xFFFFFFFF, [5, 7])           # ok
+        assert fold("ok") == ok0 + 1
+        run_gang(None, (5 + 7) & 0xFFFFFFFF, [5, 8])        # bad sum
+        assert fold("mismatch") == mis0 + 1
+        run_gang(9, (5 + 7) & 0xFFFFFFFF, [5, 7])           # ack differs
+        assert fold("mismatch") == mis0 + 2
+        run_gang(None, (5 + 7) & 0xFFFFFFFF, [12])          # short list
+        assert fold("partial") == par0 + 1
+        run_gang(None, None, [5, 7])                        # no total
+        assert fold("partial") == par0 + 2
+        # observational only: no strikes for any fold outcome
+        assert _counter("scanner_tpu_blacklist_strikes_total") \
+            == strikes0
+    finally:
+        m.stop()
+        sc.stop()
+
+
+# ---------------------------------------------------------------------------
+# spawned e2e (slow): bit-exact equivalence + chaos
+# ---------------------------------------------------------------------------
+
+def _assert_rows_equal(a, b, ctx=""):
+    assert len(a) == len(b), f"{ctx}: {len(a)} vs {len(b)} rows"
+    for i, (x, y) in enumerate(zip(a, b)):
+        if isinstance(x, NullElement) or isinstance(y, NullElement):
+            assert isinstance(x, NullElement) \
+                and isinstance(y, NullElement), f"{ctx} row {i}"
+        elif isinstance(x, (bytes, bytearray)) \
+                or isinstance(y, (bytes, bytearray)):
+            assert bytes(x) == bytes(y), f"{ctx} row {i}"
+        else:
+            assert np.array_equal(np.asarray(x), np.asarray(y)), \
+                f"{ctx} row {i}"
+
+
+def _run_one(client, build, out_name, perf):
+    out = NamedStream(client, out_name)
+    client.run(client.io.Output(build(client), [out]), perf,
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+    return list(out.load())
+
+
+def _equivalence(tmp_path, build, wp=1, io=5, seed_table=False,
+                 video_frames=0):
+    """Run `build` single-host, then replicated and sharded on a real
+    2-worker gang over the same db; return the three row lists (already
+    asserted bit-exact) plus the shard-metric deltas of the sharded
+    run."""
+    from scanner_tpu import video as scv
+
+    db_path = str(tmp_path / "db")
+    seed = Client(db_path=db_path)
+    if seed_table:
+        seed.new_table("shard_src", ["output"],
+                       [[_pk(100 + i)] for i in range(N_ROWS)])
+    if video_frames:
+        vid = str(tmp_path / "v.mp4")
+        scv.synthesize_video(vid, num_frames=video_frames, width=64,
+                             height=48, fps=24, keyint=8)
+        seed.ingest_videos([("shard_vid", vid)])
+    single = _run_one(seed, build, "eq_single",
+                      PerfParams.manual(wp, io))
+
+    m = Master(db_path=db_path, no_workers_timeout=60.0)
+    addr = f"localhost:{m.port}"
+    old_t = egang.form_timeout_s()
+    egang.set_form_timeout_s(6.0)
+    workers = [Worker(addr, db_path=db_path) for _ in range(2)]
+    sc = Client(db_path=db_path, master=addr)
+    try:
+        repl = _run_one(sc, build, "eq_repl",
+                        PerfParams.manual(wp, io, gang_hosts=2,
+                                          gang_sharded=False))
+        s0 = {k: _counter(k) for k in egang.GANG_SHARD_SERIES[:3]}
+        d0 = {r: _counter("scanner_tpu_gang_shard_decode_rows_total",
+                          role=r) for r in ("coordinator", "member")}
+        shard = _run_one(sc, build, "eq_shard",
+                         PerfParams.manual(wp, io, gang_hosts=2))
+        deltas = {k: _counter(k) - s0[k]
+                  for k in egang.GANG_SHARD_SERIES[:3]}
+        decode = {
+            r: _counter("scanner_tpu_gang_shard_decode_rows_total",
+                        role=r) - d0.get(r, 0.0)
+            for r in ("coordinator", "member")}
+    finally:
+        sc.stop()
+        for w in workers:
+            w.stop()
+        m.stop()
+        egang.set_form_timeout_s(old_t)
+        seed.stop()
+    _assert_rows_equal(single, repl, "single-vs-replicated")
+    _assert_rows_equal(single, shard, "single-vs-sharded")
+    assert _counter("scanner_tpu_blacklist_strikes_total") == 0
+    return single, deltas, decode
+
+
+@pytest.mark.slow
+def test_equivalence_stateless_uneven(tmp_path):
+    """Stateless kernel over an UNEVEN split (io=5 over 2 members ->
+    3+2 rows): sharded == replicated == single-host bit-exact, and
+    each member evaluates only its shard of every task."""
+    def build(s):
+        return s.ops.ShardDouble(
+            x=s.io.Input([NamedStream(s, "shard_src")]))
+
+    rows, deltas, decode = _equivalence(tmp_path, build,
+                                        seed_table=True)
+    assert [bytes(r) for r in rows] \
+        == [_pk(2 * (100 + i)) for i in range(N_ROWS)]
+    assert deltas["scanner_tpu_gang_shard_rows_total"] == N_ROWS
+    # per-member decode isolation under the ceil-chunk split of the
+    # 2 tasks (5 rows each -> 3+2): the coordinator plans 6 rows, the
+    # other member 4 — each ~1/2, never the full 10
+    assert decode["coordinator"] == 6 and decode["member"] == 4
+
+
+@pytest.mark.slow
+def test_equivalence_stencil_halo(tmp_path):
+    """Stencil windows that straddle the shard boundary ride the halo
+    exchange (halo bytes flow) instead of widening each member's
+    decode — and the output is still bit-exact everywhere."""
+    def build(s):
+        return s.ops.ShardStencilSum(
+            frame=s.io.Input([NamedVideoStream(s, "shard_vid")]))
+
+    rows, deltas, _ = _equivalence(tmp_path, build, io=8,
+                                   video_frames=16)
+    assert len(rows) == 16
+    assert deltas["scanner_tpu_gang_shard_rows_total"] == 16
+    assert deltas["scanner_tpu_gang_shard_halo_bytes_total"] > 0
+    # each member decodes ~1/2 the rows: the only extra decode is the
+    # stencil back-reach past a TASK edge, never the shard boundary
+    assert deltas["scanner_tpu_gang_shard_decode_rows_total"] <= 16 + 2
+
+
+@pytest.mark.slow
+def test_equivalence_null_interleaved(tmp_path):
+    """RepeatNull-spaced domains: null rows cross the member gather and
+    the digest collective deterministically."""
+    def build(s):
+        f = s.io.Input([NamedVideoStream(s, "shard_vid")])
+        ranged = s.streams.Range(f, [(0, 8)])
+        spaced = s.streams.RepeatNull(ranged, [2])
+        return s.ops.ShardFrameSum(frame=spaced)
+
+    rows, deltas, _ = _equivalence(tmp_path, build, io=8,
+                                   video_frames=16)
+    assert len(rows) == 16
+    assert any(isinstance(r, NullElement) for r in rows)
+    assert any(not isinstance(r, NullElement) for r in rows)
+    assert deltas["scanner_tpu_gang_shard_rows_total"] == 16
+
+
+@pytest.mark.slow
+def test_equivalence_gather_sampling(tmp_path):
+    """Gather-sampled domains shard by OUTPUT row: members decode only
+    the source frames their sampled rows reference."""
+    def build(s):
+        f = s.io.Input([NamedVideoStream(s, "shard_vid")])
+        sampled = s.streams.Gather(f, [[0, 3, 9, 13]])
+        return s.ops.ShardFrameSum(frame=sampled)
+
+    rows, deltas, _ = _equivalence(tmp_path, build, io=4,
+                                   video_frames=16)
+    assert len(rows) == 4
+    assert deltas["scanner_tpu_gang_shard_rows_total"] == 4
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_sharded_chaos_sigkill_reforms_smaller_bit_exact(tmp_path):
+    """SIGKILL one member the moment it enters the cross-host
+    collective of a SHARDED gang: the gang aborts strike-free, re-forms
+    SMALLER (the survivor recomputes shard_range over num=1 and runs
+    the whole row range), and the output is bit-exact."""
+    from scanner_tpu.engine.rpc import wait_for_server
+    from scanner_tpu.util.jaxenv import cpu_only_env
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    db_path = str(tmp_path / "db")
+    seed = Client(db_path=db_path)
+    seed.new_table("shard_src", ["output"],
+                   [[_pk(100 + i)] for i in range(N_ROWS)])
+    env = cpu_only_env()
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("SCANNER_TPU_FAULTS", None)
+    env["SCANNER_TPU_GANG_INIT_TIMEOUT"] = "30"
+    env["SCANNER_TPU_GANG_FORM_TIMEOUT"] = "6"
+    port = _free_port()
+    addr = f"localhost:{port}"
+
+    def spawn(script, argv, plan=None):
+        e = dict(env)
+        if plan:
+            e["SCANNER_TPU_FAULTS"] = plan
+        return subprocess.Popen(
+            [sys.executable, os.path.join(repo, "tests", script),
+             *argv], env=e)
+
+    procs = [spawn("spawn_master.py", [db_path, str(port)])]
+    procs.append(spawn("spawn_worker.py", [addr, db_path],
+                       plan=faults.NAMED_PLANS["gang-host-loss"]))
+    procs.append(spawn("spawn_worker.py", [addr, db_path]))
+    sc = None
+    try:
+        wait_for_server(addr, MASTER_SERVICE, timeout=60.0)
+        sc = Client(db_path=db_path, master=addr)
+        deadline = time.time() + 60
+        while time.time() < deadline \
+                and sc.job_status().get("num_workers", 0) < 2:
+            time.sleep(0.25)
+        col = sc.io.Input([NamedStream(sc, "shard_src")])
+        col = sc.ops.ShardDouble(x=col)
+        out = NamedStream(sc, "chaos_out")
+        sc.run(sc.io.Output(col, [out]),
+               PerfParams.manual(5, N_ROWS // 2, gang_hosts=2),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+        rows = [bytes(r) for r in out.load()]
+        assert rows == [_pk(2 * (100 + i)) for i in range(N_ROWS)]
+        time.sleep(0.5)
+        crashed = [p for p in procs
+                   if p.poll() == faults.CRASH_EXIT_CODE]
+        assert crashed, "gang.collective crash never fired"
+        snap = sc.metrics()
+
+        def tot(name):
+            return sum(s.get("value", 0) for s in
+                       snap.get(name, {}).get("samples", []))
+
+        assert tot("scanner_tpu_gang_aborted_total") >= 1
+        assert tot("scanner_tpu_gang_reforms_total") >= 1
+        assert tot("scanner_tpu_blacklist_strikes_total") == 0
+        # the fold ran for every sharded commit, and never flagged
+        folds = snap.get("scanner_tpu_gang_shard_commit_folds_total",
+                         {}).get("samples", [])
+        assert all(s["labels"].get("result") == "ok" for s in folds)
+    finally:
+        if sc is not None:
+            sc.stop()
+        seed.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+
+@pytest.mark.slow
+def test_multihost_uneven_all_gather_rows():
+    """The uneven staging path over a REAL 2-process gloo runtime:
+    7 rows over 2 host shards (4 + 3, zero-padded to even staging)
+    gather back to the exact logical rows on every rank."""
+    from multihost_child import free_port, spawn_multihost
+
+    outs = spawn_multihost(n_processes=2, devices_per_process=2,
+                           timeout=240, port=free_port(),
+                           mode="gather")
+    assert len(outs) == 2
+    lines = [ln for o in outs for ln in o.splitlines()
+             if ln.startswith("MULTIHOST_GATHER")]
+    assert len(lines) == 2 and len(set(lines)) == 1, lines
+    assert lines[0].endswith("ok"), lines

@@ -1179,6 +1179,155 @@ def test_clocksync_trace_config_keys_both_directions(tmp_path):
     assert not any("clocksync_enabled" in m for m in msgs)
 
 
+_SHARD_FIX_SERIES = ("scanner_tpu_gang_shard_rows_total",
+                     "scanner_tpu_gang_shard_commit_folds_total")
+
+
+def _gang_shard_repo(tmp_path,
+                     declared=_SHARD_FIX_SERIES,
+                     registered=None,
+                     doc_series=None,
+                     schema_keys=("enabled", "sharded",
+                                  "halo_exchange"),
+                     cfg_keys=("enabled", "sharded", "halo_exchange"),
+                     with_markers=True,
+                     with_tuple=True):
+    """Synthetic mini-repo for the SC315 sharded-gang data-plane
+    lints.  gang.py also registers a lifecycle counter NOT named
+    `_shard_` — the reverse leg must only claim shard-named series."""
+    if registered is None:
+        registered = declared
+    if doc_series is None:
+        doc_series = declared
+    _write(tmp_path, "setup.py", "# root marker\n")
+    regs = "\n        ".join(
+        f'_S{i} = _mx.registry().counter("{n}", "help text", '
+        f'labels=["role"])' for i, n in enumerate(registered))
+    decl = (f"GANG_SHARD_SERIES = ("
+            + ", ".join(f'"{n}"' for n in declared) + ",)"
+            if with_tuple else "")
+    schema = ", ".join(f'"{k}"' for k in schema_keys)
+    _write(tmp_path, "pkg/engine/gang.py", f"""
+        from ..util import metrics as _mx
+
+        _M_FORMED = _mx.registry().counter(
+            "scanner_tpu_gang_formed_total", "help text")
+
+        {regs}
+
+        {decl}
+
+        CONFIG_KEYS = ({schema},)
+    """)
+    _write(tmp_path, "pkg/util/metrics.py", """
+        def registry():
+            return None
+    """)
+    cfg = ", ".join(f'"{k}": True' for k in cfg_keys)
+    _write(tmp_path, "pkg/config.py", f"""
+        def default_config():
+            return {{"gang": {{{cfg}}}}}
+    """)
+    rows = "\n".join(f"| `{n}` | counter | `role` | x |"
+                     for n in doc_series)
+    stable = (f"<!-- gang-shard-series:begin -->\n"
+              f"| Series | Type | Labels | Meaning |\n|---|---|---|"
+              f"---|\n{rows}\n<!-- gang-shard-series:end -->\n"
+              if with_markers else rows)
+    all_series = sorted(set(declared) | set(registered)
+                        | set(doc_series)
+                        | {"scanner_tpu_gang_formed_total"})
+    _write(tmp_path, "docs/observability.md", f"""
+        Catalog (every fixture series mentioned so SC301 stays
+        quiet): {" ".join(f"`{n}`" for n in all_series)}
+
+        {stable}
+    """)
+    gkeys = "\n".join(f"| `[gang] {k}` | a row |"
+                      for k in sorted(set(schema_keys)
+                                      | set(cfg_keys)))
+    _write(tmp_path, "docs/guide.md", f"""
+        Keys mentioned so SC304 stays quiet: `enabled` `sharded`
+        `halo_exchange`
+
+        {gkeys}
+    """)
+    return tmp_path
+
+
+def test_gang_shard_clean_fixture_is_quiet(tmp_path):
+    _gang_shard_repo(tmp_path)
+    _, findings = _analyze(tmp_path, "pkg")
+    assert [f for f in findings if f.code == "SC315"] == []
+
+
+def test_gang_shard_series_all_pairings_both_directions(tmp_path):
+    _gang_shard_repo(
+        tmp_path,
+        declared=("scanner_tpu_gang_shard_rows_total",
+                  "scanner_tpu_gang_shard_phantom_total"),
+        registered=("scanner_tpu_gang_shard_rows_total",
+                    "scanner_tpu_gang_shard_unlisted_total"),
+        doc_series=("scanner_tpu_gang_shard_rows_total",
+                    "scanner_tpu_gang_shard_ghost_total"))
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC315"]
+    assert any("scanner_tpu_gang_shard_unlisted_total" in m
+               and "missing from GANG_SHARD_SERIES" in m for m in msgs)
+    assert any("scanner_tpu_gang_shard_phantom_total" in m
+               and "registers no such series" in m for m in msgs)
+    assert any("scanner_tpu_gang_shard_phantom_total" in m
+               and "missing from the" in m for m in msgs)
+    assert any("scanner_tpu_gang_shard_ghost_total" in m
+               and "no such series" in m for m in msgs)
+    assert not any("`scanner_tpu_gang_shard_rows_total`" in m
+                   for m in msgs)
+    # the lifecycle counter the module also owns is NOT claimed
+    assert not any("scanner_tpu_gang_formed_total" in m for m in msgs)
+
+
+def test_gang_shard_missing_marker_table(tmp_path):
+    _gang_shard_repo(tmp_path, with_markers=False)
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC315"]
+    assert any("gang-shard-series" in m and "marker table" in m
+               for m in msgs)
+
+
+def test_gang_shard_missing_tuple_flagged(tmp_path):
+    _gang_shard_repo(tmp_path, with_tuple=False)
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC315"]
+    assert any("declares no GANG_SHARD_SERIES tuple" in m
+               for m in msgs)
+
+
+def test_gang_shard_gate_keys_travel_with_plane(tmp_path):
+    """The data plane without its `[gang]` gates — both the schema
+    side (kill switch) and the config side (declared default)."""
+    _gang_shard_repo(tmp_path,
+                     schema_keys=("enabled", "sharded"),
+                     cfg_keys=("enabled", "halo_exchange"))
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC315"]
+    assert any("halo_exchange" in m and "kill switch" in m
+               for m in msgs)
+    assert any("sharded" in m and "declared default" in m
+               for m in msgs)
+
+
+def test_gang_shard_gate_without_plane_flagged(tmp_path):
+    """CONFIG_KEYS carrying the sharding gates while the module has
+    no shard data plane at all — stale gate surface."""
+    _gang_shard_repo(tmp_path, registered=(), with_tuple=False)
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC315"]
+    assert any("sharded" in m and "nothing to gate" in m
+               for m in msgs)
+    assert any("halo_exchange" in m and "nothing to gate" in m
+               for m in msgs)
+
+
 def test_contract_rpc_contracts_table_both_directions(tmp_path):
     _write(tmp_path, "setup.py", "# root\n")
     _write(tmp_path, "pkg/rpcmod.py", """

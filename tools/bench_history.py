@@ -139,7 +139,8 @@ def detail_digest(bench_dir):
         return {}
     out = {"fps_by_config": {}, "task_latency": {}, "health": {},
            "op_efficiency": {}, "frame_cache": {}, "remediation": {},
-           "failover": {}, "gang_skew": {}, "baseline_metrics": {}}
+           "failover": {}, "gang_skew": {}, "gang_sharded": {},
+           "baseline_metrics": {}}
     for d in detail:
         if not isinstance(d, dict):
             continue
@@ -165,6 +166,9 @@ def detail_digest(bench_dir):
                                if k != "config"}
         elif d.get("config") in ("gang_skew", "gang_skew_hw"):
             out["gang_skew"][d["config"]] = {
+                k: v for k, v in d.items() if k != "config"}
+        elif d.get("config") in ("gang_sharded", "gang_sharded_hw"):
+            out["gang_sharded"][d["config"]] = {
                 k: v for k, v in d.items() if k != "config"}
         elif d.get("config") == "baseline_metrics":
             out["baseline_metrics"] = d.get("metrics") or {}

@@ -279,8 +279,23 @@ def main() -> int:
             print(f"gang: formed={int(formed)} aborted={int(aborted)} "
                   f"reforms={int(reforms)} epoch={int(epoch)} "
                   f"strikes={int(strikes)}")
+            # sharded-path evidence: gang_sharded defaults on, so the
+            # drill's tasks evaluate mesh-partitioned — every shard
+            # commit the master folded must agree ("ok"); any mismatch
+            # or partial fold under member loss is a real divergence
+            folds = snap.get("scanner_tpu_gang_shard_commit_folds_total",
+                             {}).get("samples", [])
+            fold_ok = sum(s.get("value", 0) for s in folds
+                          if s.get("labels", {}).get("result") == "ok")
+            fold_bad = sum(s.get("value", 0) for s in folds
+                           if s.get("labels", {}).get("result") != "ok")
+            shard_rows = _tot("scanner_tpu_gang_shard_rows_total")
+            if shard_rows or folds:
+                print(f"gang shard: rows={int(shard_rows)} "
+                      f"folds ok={int(fold_ok)} non-ok={int(fold_bad)}")
             extra_ok = bool(aborted >= 1 and reforms >= 1
-                            and epoch >= 2 and strikes == 0)
+                            and epoch >= 2 and strikes == 0
+                            and fold_bad == 0)
         if failover:
             # failover-specific evidence: the successor replayed the
             # journal, zero blacklist strikes anywhere, and a

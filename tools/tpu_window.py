@@ -566,6 +566,134 @@ with open(path, "w") as f:
 print("GANG_HW " + json.dumps(res))
 """
 
+_GANG_SHARDED_HW = r"""
+import json, os, struct, subprocess, sys, tempfile, time
+
+# hardware companion to bench.py's gang_sharded digest: the
+# mesh-partitioned A/B on the real host — one stencil bulk over a
+# 2-host gang run replicated (every member evaluates all rows) then
+# sharded (each member evaluates only its shard_range; boundary rows
+# ride the halo exchange) — banking the stage-phase speedup, per-host
+# decode rows, and halo bytes.  Same single-process-exclusive
+# constraint as gang_hw: the TPU identity is probed in a throwaway
+# subprocess, the member math runs on the CPU backend — what the
+# hardware window adds is the real host's decode/spawn/net behavior
+# under the sharded data plane.
+probe = subprocess.run(
+    [sys.executable, "-c",
+     "import jax; d = jax.devices()[0]; print(d.platform, d)"],
+    capture_output=True, text=True, timeout=300)
+tpu_dev = probe.stdout.strip()
+assert tpu_dev.startswith("tpu"), f"no TPU: {tpu_dev or probe.stderr[-200:]}"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+from typing import Sequence
+
+import cloudpickle, jax
+import numpy as np
+from scanner_tpu import CacheMode, Client, FrameType, Kernel, \
+    NamedStream, NamedVideoStream, PerfParams, register_op
+from scanner_tpu import video as scv
+from scanner_tpu.engine import gang as egang
+from scanner_tpu.engine.service import Master, Worker
+from scanner_tpu.util.metrics import registry
+
+def pk(v):
+    return struct.pack("<q", v)
+
+@register_op(name="GangShardHwStencil", stencil=[-1, 0])
+class GangShardHwStencil(Kernel):
+    def execute(self, frame: Sequence[FrameType]) -> bytes:
+        time.sleep(0.05)
+        return pk(int(np.asarray(frame, np.int64).sum()))
+
+cloudpickle.register_pickle_by_value(sys.modules["__main__"])
+
+def stage_by_role():
+    fam = registry().snapshot().get(
+        "scanner_tpu_gang_phase_seconds_total", {})
+    return {s["labels"].get("role"): s["value"]
+            for s in fam.get("samples", [])
+            if s["labels"].get("phase") == "stage"}
+
+def tot(name):
+    s = registry().snapshot().get(name, {})
+    return sum(x["value"] for x in s.get("samples", []))
+
+root = tempfile.mkdtemp(prefix="gang_sharded_hw_")
+N = 16
+vid = os.path.join(root, "v.mp4")
+scv.synthesize_video(vid, num_frames=N, width=64, height=48, fps=24,
+                     keyint=8)
+sc = Client(db_path=os.path.join(root, "db"))
+sc.ingest_videos([("gshard_vid", vid)])
+m = Master(db_path=os.path.join(root, "db"), no_workers_timeout=120.0)
+addr = f"localhost:{m.port}"
+egang.set_form_timeout_s(6.0)
+workers = [Worker(addr, db_path=os.path.join(root, "db"))
+           for _ in range(2)]
+gc = Client(db_path=os.path.join(root, "db"), master=addr)
+
+def run_mode(mode, sharded):
+    st0 = stage_by_role()
+    hb0 = tot("scanner_tpu_gang_shard_halo_bytes_total")
+    col = gc.io.Input([NamedVideoStream(gc, "gshard_vid")])
+    col = gc.ops.GangShardHwStencil(frame=col)
+    out = NamedStream(gc, f"gshard_{mode}")
+    t0 = time.time()
+    gc.run(gc.io.Output(col, [out]),
+           PerfParams.manual(4, 8, gang_hosts=2, gang_sharded=sharded),
+           cache_mode=CacheMode.Overwrite, show_progress=False)
+    wall = round(time.time() - t0, 3)
+    rows = len(list(out.load()))
+    st1 = stage_by_role()
+    stage = max((st1.get(r, 0.0) - st0.get(r, 0.0) for r in st1),
+                default=0.0)
+    return {"mode": mode, "rows_ok": rows == N, "wall_s": wall,
+            "stage_s": round(stage, 3),
+            "stage_rows_per_s": (round(rows / stage, 3)
+                                 if stage > 0 else None),
+            "halo_bytes": tot(
+                "scanner_tpu_gang_shard_halo_bytes_total") - hb0}
+
+rep = run_mode("replicated", False)
+sha = run_mode("sharded", True)
+speedup = None
+if rep["stage_rows_per_s"] and sha["stage_rows_per_s"]:
+    speedup = round(sha["stage_rows_per_s"] / rep["stage_rows_per_s"], 3)
+decode = {s["labels"].get("role"): s["value"]
+          for s in registry().snapshot().get(
+              "scanner_tpu_gang_shard_decode_rows_total",
+              {}).get("samples", [])}
+res = {
+    "device": tpu_dev,
+    "members_on": "cpu (libtpu is single-process-exclusive)",
+    "rows_ok": rep["rows_ok"] and sha["rows_ok"],
+    "replicated": rep,
+    "sharded": sha,
+    "gang_sharded_speedup": speedup,
+    "decode_rows_by_member": decode,
+}
+gc.stop()
+for w in workers:
+    w.stop()
+m.stop()
+# bank the hardware sharded digest next to bench.py's digests so
+# tools/bench_history.py folds gang_sharded_hw into its section
+path = os.path.join(os.getcwd(), "BENCH_DETAIL.json")
+try:
+    detail = json.load(open(path))
+    if not isinstance(detail, list):
+        detail = [detail]
+except Exception:
+    detail = []
+detail.append({"config": "gang_sharded_hw",
+               "clock": time.strftime("%Y-%m-%dT%H:%M:%S"), **res})
+with open(path, "w") as f:
+    json.dump(detail, f, indent=1)
+print("GANG_SHARDED_HW " + json.dumps(res))
+"""
+
 _GANG_SKEW_HW = r"""
 import json, os, struct, subprocess, sys, tempfile, time
 
@@ -737,6 +865,10 @@ def main() -> int:
         "gang-scheduled multi-host bulk on hardware (engine/gang.py "
         "-> BENCH_DETAIL.json gang_hw)", code=_GANG_HW,
         timeout=1200, marker="GANG_HW ")
+    results["gang_sharded"] = run_step(
+        "sharded-vs-replicated gang A/B on hardware (engine/gang.py "
+        "sharded body -> BENCH_DETAIL.json gang_sharded_hw)",
+        code=_GANG_SHARDED_HW, timeout=1200, marker="GANG_SHARDED_HW ")
     results["gang_skew"] = run_step(
         "clean gang barrier-skew + clock-sync digest on hardware "
         "(util/clocksync.py -> BENCH_DETAIL.json gang_skew_hw)",
