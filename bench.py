@@ -1111,6 +1111,207 @@ def main():
             _shard_d = {"config": "gang_sharded",
                         "error": f"{type(e).__name__}: {e}"}
         detail.append(_shard_d)
+
+        # control-plane digest (engine/shardmap.py): a bounded live
+        # sharded-master drill — two in-process shard masters, one
+        # multiplexing worker.  Admission is probed per shard (NewJob
+        # wall time; p99 = worst probe on the worst shard), then a
+        # bulk owned by the NON-dialed shard is killed mid-flight
+        # (checkpoint_frequency=0: journal-only durability) and a
+        # successor started on the same port — banking shard-failover
+        # recovery seconds and the FinishedWork coalescing yield so
+        # tools/bench_history.py gates the sharded control plane like
+        # any other metric
+        def _control_plane_digest() -> dict:
+            import socket as _socket
+            import struct as _struct
+
+            import cloudpickle as _cp
+
+            from scanner_tpu import Kernel, register_op
+            from scanner_tpu.engine import shardmap as _shmap
+            from scanner_tpu.engine.service import Master, Worker
+
+            def _pk(v: int) -> bytes:
+                return _struct.pack("<q", v)
+
+            def _tot(name: str, method: str = None) -> float:
+                s = registry().snapshot().get(name, {})
+                return sum(
+                    x["value"] for x in s.get("samples", [])
+                    if method is None
+                    or x.get("labels", {}).get("method") == method)
+
+            @register_op(name="BenchCpFast")
+            class BenchCpFast(Kernel):
+                def execute(self, x: bytes) -> bytes:
+                    return _pk(2 * _struct.unpack("<q", x)[0])
+
+            @register_op(name="BenchCpSlow")
+            class BenchCpSlow(Kernel):
+                # slow enough that the bulk outlives the mid-bulk
+                # shard kill
+                def execute(self, x: bytes) -> bytes:
+                    time.sleep(0.15)
+                    return _pk(3 * _struct.unpack("<q", x)[0])
+
+            cdb = os.path.join(root, "cp_db")
+            n_rows = 48
+            os.environ["SCANNER_TPU_CONTROL_SHARDS"] = "2"
+            _shmap.set_num_shards(2)
+            seedc = Client(db_path=cdb)
+            seedc.new_table("cp_src", ["output"],
+                            [[_pk(100 + i)] for i in range(n_rows)])
+            # spec blobs come from FRESH clients so each admission
+            # sees the master-created tables of the previous one
+            # (client-side table-id allocation is single-writer);
+            # each client stays alive until its bulk drains
+            spec_clients: list = []
+
+            def _spec(op: str, out_name: str, **perf_kw) -> bytes:
+                c = Client(db_path=cdb)
+                spec_clients.append(c)
+                col = c.io.Input([NamedStream(c, "cp_src")])
+                col = getattr(c.ops, op)(x=col)
+                node = c.io.Output(col, [NamedStream(c, out_name)])
+                return _cp.dumps({
+                    "outputs": [node],
+                    "perf": PerfParams.manual(2, 2, **perf_kw),
+                    "cache_mode": CacheMode.Overwrite.value})
+
+            ports = []
+            for _ in range(2):
+                with _socket.socket() as s:
+                    s.bind(("localhost", 0))
+                    ports.append(s.getsockname()[1])
+            masters = [Master(db_path=cdb, port=ports[k], shard_id=k,
+                              num_shards=2, no_workers_timeout=60.0)
+                       for k in range(2)]
+            worker = Worker(f"localhost:{ports[0]}", db_path=cdb)
+            successor = None
+            coal_fw0 = _tot("scanner_tpu_rpc_coalesced_total",
+                            "FinishedWork")
+
+            def _drain(m, bulk_id: int, timeout_s: float) -> dict:
+                end = time.time() + timeout_s
+                st: dict = {}
+                while time.time() < end:
+                    st = m._rpc_job_status({"bulk_id": bulk_id})
+                    if st.get("finished"):
+                        return st
+                    time.sleep(0.1)
+                return st
+
+            try:
+                deadline = time.time() + 30
+                while time.time() < deadline \
+                        and len(worker._links) < 2:
+                    time.sleep(0.05)
+                if len(worker._links) < 2:
+                    return {"config": "control_plane",
+                            "error": "worker never linked both shards"}
+                # admission probes, sequential per shard (the serial
+                # admission path is what the p99 judges)
+                tasks_done = 0.0
+                admit: list = []
+                for sid in range(2):
+                    for i in range(3):
+                        blob = _spec("BenchCpFast",
+                                     f"cp_probe_{sid}_{i}")
+                        t0 = time.time()
+                        r = masters[sid]._rpc_new_job(
+                            {"spec": blob,
+                             "token": f"cp-probe-{sid}-{i}"})
+                        admit.append(time.time() - t0)
+                        if "bulk_id" not in r:
+                            return {"config": "control_plane",
+                                    "error": f"admission NACK: {r}"}
+                        st = _drain(masters[sid], r["bulk_id"], 60)
+                        if not st.get("finished"):
+                            return {
+                                "config": "control_plane",
+                                "error": f"probe bulk stuck on shard "
+                                         f"{sid}: {st.get('error')}"}
+                        tasks_done += st.get("tasks_done") or 0
+                # shard failover: the job lands on shard 1 — the
+                # NON-dialed shard, so recovery also proves the
+                # worker's multiplexed link redials the successor
+                blob = _spec("BenchCpSlow", "cp_fo_out",
+                             checkpoint_frequency=0)
+                r = masters[1]._rpc_new_job(
+                    {"spec": blob, "token": "cp-fo"})
+                if "bulk_id" not in r:
+                    return {"config": "control_plane",
+                            "error": f"failover admission NACK: {r}"}
+                bulk_id = r["bulk_id"]
+                end = time.time() + 60
+                done_at_kill = 0
+                while time.time() < end:
+                    st = masters[1]._rpc_job_status(
+                        {"bulk_id": bulk_id})
+                    if (st.get("tasks_done") or 0) >= 4:
+                        done_at_kill = st["tasks_done"]
+                        break
+                    time.sleep(0.05)
+                masters[1].stop()  # abrupt: bulk active, no cleanup
+                kill_at = time.time()
+                for _ in range(20):
+                    try:
+                        successor = Master(
+                            db_path=cdb, port=ports[1], shard_id=1,
+                            num_shards=2, no_workers_timeout=60.0)
+                        break
+                    except Exception:  # noqa: BLE001 — port lingering
+                        time.sleep(0.25)
+                if successor is None:
+                    return {"config": "control_plane",
+                            "error": "successor never bound the port"}
+                st = _drain(successor, bulk_id, 120)
+                recovery = round(time.time() - kill_at, 3) \
+                    if st.get("finished") else None
+                tasks_done += st.get("tasks_done") or 0
+                rows = None
+                vc = Client(db_path=cdb)
+                try:
+                    rows = len(list(
+                        NamedStream(vc, "cp_fo_out").load()))
+                finally:
+                    vc.stop()
+                coal_fw = _tot("scanner_tpu_rpc_coalesced_total",
+                               "FinishedWork") - coal_fw0
+                return {
+                    "config": "control_plane",
+                    "rows_ok": rows == n_rows,
+                    "done_at_kill": done_at_kill,
+                    "per_shard_admission_p99_s": round(max(admit), 4),
+                    "shard_failover_recovery_s": recovery,
+                    "shard_failovers": _tot(
+                        "scanner_tpu_shard_failovers_total"),
+                    "shard_journal_reexec": _tot(
+                        "scanner_tpu_shard_journal_reexec_total"),
+                    "finished_coalesced": coal_fw,
+                    "finished_coalescing_ratio": round(
+                        coal_fw / tasks_done, 4)
+                        if tasks_done else None,
+                }
+            finally:
+                for obj in ([worker] + masters
+                            + ([successor] if successor else [])
+                            + spec_clients + [seedc]):
+                    try:
+                        obj.stop()
+                    except Exception:  # noqa: BLE001 — teardown of an
+                        pass           # already-stopped shard
+                os.environ.pop("SCANNER_TPU_CONTROL_SHARDS", None)
+                _shmap.set_num_shards(1)
+
+        try:
+            _cp_d = _control_plane_digest()
+        except Exception as e:  # noqa: BLE001 — bench must not die on
+            # the control-plane drill
+            _cp_d = {"config": "control_plane",
+                     "error": f"{type(e).__name__}: {e}"}
+        detail.append(_cp_d)
         # stable per-direction baseline keys (ROADMAP "bank per-item
         # baselines for the new directions"): one flat entry with a
         # declared better= direction per metric, so
@@ -1168,6 +1369,12 @@ def main():
                 "gang_sharded_speedup": {
                     "value": _shard_d.get("gang_sharded_speedup"),
                     "better": "higher"},
+                "shard_failover_recovery_s": {
+                    "value": _cp_d.get("shard_failover_recovery_s"),
+                    "better": "lower"},
+                "per_shard_admission_p99_s": {
+                    "value": _cp_d.get("per_shard_admission_p99_s"),
+                    "better": "lower"},
             },
         })
         # health digest (util/health.py): alert transitions fired during

@@ -95,6 +95,20 @@ passes make each one checkable:
          config.default_config() must declare both whenever
          GANG_SHARD_SERIES exists, and a gate without a data plane is
          flagged too (both directions)
+  SC316  sharded control-plane drift (engine/shardmap.py +
+         engine/service.py): shardmap.SHARD_SERIES must match the
+         series the module registers AND the marker-delimited
+         `shard-series:begin/end` table in docs/observability.md
+         (all pairings, both directions); the `[control]` config
+         keys config.default_config() declares must be exactly
+         shardmap.CONFIG_KEYS (both directions); and the
+         shard-routed RPC surface may not drift (extending SC312):
+         every service.SHARD_ROUTED_RPCS method must be classified
+         `idempotent=False` and register its master handler through
+         the generation fence, and every idempotent=False contract
+         must be shard-routed — a mutating RPC missing from the
+         routing tuple would land on the dial-time shard regardless
+         of which master owns the bulk it mutates
 """
 
 from __future__ import annotations
@@ -392,6 +406,11 @@ class ContractPass(AnalysisPass):
                  "gang registrations vs docs gang-shard-series table; "
                  "[gang] sharded/halo_exchange gates vs the data "
                  "plane)",
+        "SC316": "sharded control-plane drift (SHARD_SERIES vs "
+                 "shardmap registrations vs docs shard-series table; "
+                 "[control] keys vs shardmap.CONFIG_KEYS; "
+                 "SHARD_ROUTED_RPCS vs idempotent=False + "
+                 "fence-wrapped master handlers)",
     }
 
     def run(self, project: Project) -> List[Finding]:
@@ -409,6 +428,7 @@ class ContractPass(AnalysisPass):
         out.extend(self._gang_contract(project))
         out.extend(self._clocksync_contract(project))
         out.extend(self._gang_shard_contract(project))
+        out.extend(self._shard_contract(project))
         return out
 
     # -- SC301 / SC302 ---------------------------------------------------
@@ -1571,6 +1591,159 @@ class ContractPass(AnalysisPass):
                     f"config.default_config() declares no `[gang] "
                     f"{k}` — the sharded data plane ships without "
                     "its declared default", cfg_mod.tree))
+        return out
+
+    # -- SC316 -----------------------------------------------------------
+
+    _SHARDMAP_DOC_BLOCK_RE = re.compile(
+        r"<!--\s*shard-series:begin\s*-->(.*?)"
+        r"<!--\s*shard-series:end\s*-->", re.S)
+
+    def _shard_contract(self, project: Project) -> List[Finding]:
+        """Sharded control-plane lints: shardmap.SHARD_SERIES ↔ the
+        series engine/shardmap.py registers ↔ the shard-series marker
+        table in docs/observability.md (all pairings, both
+        directions); `[control]` keys in config.default_config() ↔
+        shardmap.CONFIG_KEYS (both directions); and the shard-routing
+        leg extending SC312 — every service.SHARD_ROUTED_RPCS method
+        must be classified idempotent=False AND fence-wrapped, and
+        every idempotent=False contract must be shard-routed, so a
+        mutating RPC can never land on a master that does not own
+        the bulk it mutates."""
+        out: List[Finding] = []
+        shmod = project.module("engine/shardmap.py")
+        if shmod is None:
+            return out
+        series = _module_tuple(shmod, "SHARD_SERIES")
+        registered = {r.name for r in _metric_registrations(shmod)
+                      if r.name}
+        if series is None:
+            if registered:
+                out.append(shmod.finding(
+                    "SC316",
+                    "shardmap registers series ("
+                    + ", ".join(f"`{n}`" for n in sorted(registered))
+                    + ") but declares no SHARD_SERIES tuple — the "
+                    "SC316 catalog contract cannot see them",
+                    shmod.tree))
+        else:
+            for name in sorted(registered - set(series)):
+                out.append(shmod.finding(
+                    "SC316",
+                    f"series `{name}` is registered in shardmap but "
+                    "missing from SHARD_SERIES — the SC316 catalog "
+                    "contract cannot see it", shmod.tree))
+            for name in sorted(set(series) - registered):
+                out.append(shmod.finding(
+                    "SC316",
+                    f"SHARD_SERIES names `{name}` but shardmap "
+                    "registers no such series", shmod.tree))
+            doc = _read_doc(project, "observability.md")
+            if doc:
+                block = self._SHARDMAP_DOC_BLOCK_RE.search(doc)
+                if block is None:
+                    out.append(shmod.finding(
+                        "SC316",
+                        "shardmap declares SHARD_SERIES but "
+                        "docs/observability.md has no shard-series "
+                        "marker table (<!-- shard-series:begin/end "
+                        "-->)", shmod.tree))
+                else:
+                    base_doc = self._doc_base_series(block.group(1))
+                    for name in sorted(set(series) - base_doc):
+                        out.append(shmod.finding(
+                            "SC316",
+                            f"control-plane shard series `{name}` is "
+                            "missing from the docs/observability.md "
+                            "shard-series table", shmod.tree))
+                    for name in sorted(base_doc - set(series)):
+                        out.append(Finding(
+                            code="SC316",
+                            message="docs/observability.md "
+                                    "shard-series table lists "
+                                    f"`{name}` but SHARD_SERIES has "
+                                    "no such series",
+                            path="docs/observability.md", line=1,
+                            scope="", snippet=name))
+        # [control] keys <-> shardmap.CONFIG_KEYS, both directions
+        schema = _module_tuple(shmod, "CONFIG_KEYS")
+        cfg_mod = None
+        for m in project.modules:
+            if m.relpath.endswith("config.py") \
+                    and _default_config_keys(m):
+                cfg_mod = m
+                break
+        if schema is not None and cfg_mod is not None:
+            control_keys = {k for sec, k in
+                            _default_config_keys(cfg_mod)
+                            if sec == "control"}
+            if control_keys or schema:
+                for k in sorted(control_keys - set(schema)):
+                    out.append(cfg_mod.finding(
+                        "SC316",
+                        f"config key `[control] {k}` is declared but "
+                        "shardmap.CONFIG_KEYS does not accept it",
+                        cfg_mod.tree))
+                for k in sorted(set(schema) - control_keys):
+                    out.append(shmod.finding(
+                        "SC316",
+                        f"shardmap.CONFIG_KEYS accepts `{k}` but "
+                        "config.default_config() declares no "
+                        f"`[control] {k}`", shmod.tree))
+        # shard-routing leg (extends SC312): SHARD_ROUTED_RPCS <->
+        # the idempotent=False, fence-wrapped master surface.  A
+        # mutating RPC must follow the bulk to its owning shard AND
+        # stay behind the generation fence there — routing without
+        # fencing (or vice versa) reopens the stale-master window
+        # sharding was meant to close.
+        smod = project.module("engine/service.py")
+        routed = _module_tuple(smod, "SHARD_ROUTED_RPCS") \
+            if smod is not None else None
+        if smod is None or routed is None:
+            return out
+        contracts = self._contract_idempotency(smod)
+        registered_m = self._master_registrations(smod)
+        if contracts is None or not registered_m:
+            return out
+        for name in routed:
+            if name not in contracts:
+                out.append(smod.finding(
+                    "SC316",
+                    f"SHARD_ROUTED_RPCS routes `{name}` but "
+                    "RPC_CONTRACTS has no such entry — an "
+                    "unclassified method cannot be routed safely",
+                    smod.tree))
+                continue
+            if contracts.get(name) is not False:
+                out.append(smod.finding(
+                    "SC316",
+                    f"SHARD_ROUTED_RPCS routes `{name}` but "
+                    "RPC_CONTRACTS does not classify it "
+                    "idempotent=False — only mutating RPCs follow "
+                    "the bulk to its owning shard", smod.tree))
+            reg = registered_m.get(name)
+            if reg is None:
+                out.append(smod.finding(
+                    "SC316",
+                    f"SHARD_ROUTED_RPCS routes `{name}` but the "
+                    "master service registers no such handler",
+                    smod.tree))
+            elif not reg[0]:
+                out.append(smod.finding(
+                    "SC316",
+                    f"shard-routed RPC `{name}` is registered "
+                    "without the generation-fence wrapper "
+                    "(`self._fenced(...)`) — a superseded shard "
+                    "master would keep accepting this mutation",
+                    reg[1]))
+        for name, idem in sorted(contracts.items()):
+            if idem is False and name not in routed:
+                out.append(smod.finding(
+                    "SC316",
+                    f"RPC `{name}` is classified idempotent=False "
+                    "but is missing from SHARD_ROUTED_RPCS — a "
+                    "mutating RPC pinned to the dial-time shard "
+                    "would bypass bulk ownership", smod.tree))
         return out
 
     # -- SC306 / SC307 ---------------------------------------------------

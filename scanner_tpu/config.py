@@ -162,6 +162,15 @@ def default_config() -> Dict[str, Any]:
             # overrides per process.
             "halo_exchange": True,
         },
+        "control": {
+            # master shards in the horizontally sharded control plane
+            # (engine/shardmap.py, docs/robustness.md §Sharded control
+            # plane): bulks partition across this many masters by
+            # consistent hash on the admission token.  1 (the default)
+            # is the classic single-master cluster, bit-for-bit;
+            # SCANNER_TPU_CONTROL_SHARDS overrides per process.
+            "shards": 1,
+        },
         "faults": {
             # deterministic fault-injection plan (docs/robustness.md for
             # the clause syntax; util/faults.py implements it).  "" (the
@@ -365,6 +374,13 @@ class Config:
         default; SCANNER_TPU_GANG_HALO overrides per process)."""
         return bool(self.config.get("gang", {}).get("halo_exchange",
                                                     True))
+
+    @property
+    def control_shards(self) -> int:
+        """Master shard count for the sharded control plane (the
+        deployment default; SCANNER_TPU_CONTROL_SHARDS overrides per
+        process)."""
+        return int(self.config.get("control", {}).get("shards", 1))
 
     @property
     def faults_plan(self) -> Optional[str]:

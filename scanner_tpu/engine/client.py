@@ -153,6 +153,12 @@ class Client:
                 _gang_cfg.set_sharded(cfg.gang_sharded)
             if not os.environ.get("SCANNER_TPU_GANG_HALO"):
                 _gang_cfg.set_halo(cfg.gang_halo_exchange)
+            # [control] section: how many master shards the control
+            # plane runs ([control] shards); the
+            # SCANNER_TPU_CONTROL_SHARDS env var (read at import) wins
+            from . import shardmap as _shardmap_cfg
+            if not os.environ.get("SCANNER_TPU_CONTROL_SHARDS"):
+                _shardmap_cfg.set_num_shards(cfg.control_shards)
             # [remediation] section: the alert->action controller's
             # deployment defaults; SCANNER_TPU_REMEDIATION (read at
             # import) is the per-process kill switch and wins

@@ -149,17 +149,22 @@ def set_rotate_records(n: int) -> None:
 # ---------------------------------------------------------------------------
 
 def try_claim(backend: StorageBackend, gen: int,
-              note: str = "") -> bool:
+              note: str = "", shard: int = 0) -> bool:
     """Atomically claim one specific generation: True for exactly one
-    concurrent claimant (write_exclusive CAS), False for the rest."""
+    concurrent claimant (write_exclusive CAS), False for the rest.
+    Claims are scoped per control-plane shard (storage/metadata.py
+    shard_prefix) — shards fence independently."""
     payload = md.pack({"generation": gen, "pid": os.getpid(),
-                       "time": time.time(), "note": note})
-    return backend.write_exclusive(md.generation_path(gen), payload)
+                       "time": time.time(), "note": note,
+                       "shard": int(shard)})
+    return backend.write_exclusive(md.generation_path(gen, shard),
+                                   payload)
 
 
-def claimed_generations(backend: StorageBackend) -> List[int]:
+def claimed_generations(backend: StorageBackend,
+                        shard: int = 0) -> List[int]:
     out = []
-    for p in backend.list_prefix(md.generation_prefix()):
+    for p in backend.list_prefix(md.generation_prefix(shard)):
         base = p.rsplit("/", 1)[-1]
         try:
             out.append(int(base.split(".")[0]))
@@ -168,14 +173,15 @@ def claimed_generations(backend: StorageBackend) -> List[int]:
     return sorted(out)
 
 
-def highest_claimed(backend: StorageBackend) -> int:
-    gens = claimed_generations(backend)
+def highest_claimed(backend: StorageBackend, shard: int = 0) -> int:
+    gens = claimed_generations(backend, shard)
     return gens[-1] if gens else 0
 
 
-def claim_generation(backend: StorageBackend, note: str = "") -> int:
+def claim_generation(backend: StorageBackend, note: str = "",
+                     shard: int = 0) -> int:
     """Claim the next free generation (monotonic; a successor always
-    outranks every predecessor on the same db).  The
+    outranks every predecessor on the same db + shard).  The
     SCANNER_TPU_MASTER_GENERATION env var attaches at a forced
     generation WITHOUT claiming — the stale-master chaos lever."""
     forced = os.environ.get("SCANNER_TPU_MASTER_GENERATION")
@@ -185,12 +191,13 @@ def claim_generation(backend: StorageBackend, note: str = "") -> int:
                      "claim; SCANNER_TPU_MASTER_GENERATION)", gen)
         _M_GENERATION.set(gen)
         return gen
-    gen = highest_claimed(backend)
+    gen = highest_claimed(backend, shard)
     while True:
         gen += 1
-        if try_claim(backend, gen, note=note):
+        if try_claim(backend, gen, note=note, shard=shard):
             _M_GENERATION.set(gen)
-            _log.info("claimed master generation %d", gen)
+            _log.info("claimed master generation %d (shard %d)",
+                      gen, shard)
             return gen
         # lost the CAS race for this generation: someone else is also
         # starting up; take the next slot (latest claim outranks)
@@ -297,9 +304,10 @@ class BulkJournal:
     RPC only after it."""
 
     def __init__(self, backend: StorageBackend, generation: int,
-                 rotate: Optional[int] = None):
+                 rotate: Optional[int] = None, shard: int = 0):
         self.backend = backend
         self.generation = generation
+        self.shard = int(shard)
         self.rotate = int(rotate or rotate_records())
         self._lock = threading.Lock()
         self._seg = 0
@@ -319,7 +327,8 @@ class BulkJournal:
         encoded = [encode_record(r) for r in records]
         with self._lock:
             self._buf.extend(encoded)
-            path = md.journal_segment_path(self.generation, self._seg)
+            path = md.journal_segment_path(self.generation, self._seg,
+                                           self.shard)
             # group-commit serialization by design: concurrent
             # appenders queue on this lock and each write carries every
             # record buffered so far; the open segment must be
@@ -355,7 +364,7 @@ class BulkJournal:
     def compact_below(self, seg: int) -> None:
         """Delete sealed segments a checkpoint now covers."""
         for path in self.backend.list_prefix(
-                md.journal_dir(self.generation)):
+                md.journal_dir(self.generation, self.shard)):
             base = path.rsplit("/", 1)[-1]
             try:
                 idx = int(base.split("_")[-1].split(".")[0])
@@ -369,18 +378,19 @@ class BulkJournal:
         generation and rewind to segment 0."""
         with self._lock:
             self.backend.delete_prefix(  # scanner-check: disable=SC202 bulk boundary only (admission/clear), not a hot path
-                md.journal_dir(self.generation))
+                md.journal_dir(self.generation, self.shard))
             self._seg = 0
             self._buf = []
 
 
-def replay(backend: StorageBackend, generation: int
+def replay(backend: StorageBackend, generation: int, shard: int = 0
            ) -> Tuple[List[dict], Dict[str, int]]:
     """Read every surviving record of one generation's journal, in
     order.  A torn tail on the final segment is tolerated (warned); a
     mid-journal corruption stops replay there at ERROR — the prefix is
     still applied, everything after it is unknowable."""
-    paths = sorted(backend.list_prefix(md.journal_dir(generation)))
+    paths = sorted(backend.list_prefix(md.journal_dir(generation,
+                                                      shard)))
     records: List[dict] = []
     stats = {"segments": len(paths), "records": 0, "torn": 0,
              "corrupt": 0}
@@ -439,16 +449,18 @@ def read_control_blob(backend: StorageBackend, path: str,
         return raw
 
 
-def load_bulk_progress(backend: StorageBackend) -> Optional[dict]:
+def load_bulk_progress(backend: StorageBackend,
+                       shard: int = 0) -> Optional[dict]:
     """The newest generation's persisted bulk-progress snapshot
     (crc-verified; legacy unsealed files still load), or None.  A
     tooling/test helper — the master's own recovery path lives in
     engine/service.py."""
     import cloudpickle
 
-    gens = sorted(claimed_generations(backend), reverse=True)
+    gens = sorted(claimed_generations(backend, shard), reverse=True)
     for g in gens + [None]:
-        payload = read_control_blob(backend, md.bulk_progress_path(g),
+        payload = read_control_blob(backend,
+                                    md.bulk_progress_path(g, shard),
                                     what="bulk progress")
         if payload is None:
             continue

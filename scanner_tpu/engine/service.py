@@ -48,6 +48,7 @@ from . import framecache as _framecache
 from . import gang as _gang
 from . import journal as _journal
 from . import rpc
+from . import shardmap as _shardmap
 from .evaluate import TaskEvaluator
 from .executor import _M_TASK_LATENCY, LocalExecutor, TaskItem
 
@@ -96,8 +97,15 @@ RPC_CONTRACTS = {
     "StartedWork":      {"timeout_s": 30.0, "idempotent": False},
     "EvalDone":         {"timeout_s": 30.0, "idempotent": True},
     "FinishedWork":     {"timeout_s": 30.0, "idempotent": False},
+    # coalesced completion path (engine/shardmap.py): many FinishedWork
+    # payloads in one RPC, one journal group-commit — the worker-side
+    # batcher a per-shard fan-out needs to keep RPC volume flat
+    "FinishedWorkBatch": {"timeout_s": 30.0, "idempotent": False},
     "FailedWork":       {"timeout_s": 30.0, "idempotent": False},
     "GetJobStatus":     {"timeout_s": 30.0, "idempotent": True},
+    # the versioned shard map (engine/shardmap.py): served by every
+    # shard so clients/workers can resolve routing from any of them
+    "GetShardMap":      {"timeout_s": 30.0, "idempotent": True},
     "GetMetrics":       {"timeout_s": 30.0, "idempotent": True},
     "GetHealth":        {"timeout_s": 30.0, "idempotent": True},
     "PokeWatchdog":     {"timeout_s": 30.0, "idempotent": True},
@@ -117,6 +125,19 @@ RPC_CONTRACTS = {
     "GangFailed":       {"timeout_s": 30.0, "idempotent": False},
     "Shutdown":         {"timeout_s": PING_TIMEOUT, "idempotent": True},
 }
+
+# Every master RPC a sharded deployment routes per-shard via the shard
+# map AND that mutates control-plane state.  scanner-check SC316 pins
+# this tuple to the RPC_CONTRACTS idempotent=False set and to the
+# `_fenced(...)`-wrapped registrations (extending SC312), both
+# directions: a mutating RPC missing here would dodge the stale-map /
+# generation fence audit, and an entry here that is not registered
+# fenced would let a stale map route a mutation past a failover.
+SHARD_ROUTED_RPCS = (
+    "RegisterWorker", "NewJob", "NextWork", "StartedWork",
+    "FinishedWork", "FinishedWorkBatch", "FailedWork", "PostProfile",
+    "ShipSpans", "ShipMemoryReport", "GangMemberDone", "GangFailed",
+)
 
 # OOM forensic reports retained on the master (newest win): enough for
 # a post-mortem across a worker fleet's pressure event, bounded so a
@@ -559,9 +580,30 @@ class Master:
                  # callback in tests; None = audit-only, the desired
                  # count still lands on the autoscale gauge)
                  autoscale=None,
-                 scale_actuator=None):
+                 scale_actuator=None,
+                 # sharded control plane (engine/shardmap.py): this
+                 # master's shard id and the deployment's shard count
+                 # (None = the [control] shards config default).  All
+                 # durable control state — generation claims,
+                 # checkpoints, journals — scopes under the shard's
+                 # namespace; shard 0 of a 1-shard deployment is the
+                 # classic single master, bit-for-bit.
+                 shard_id: int = 0,
+                 num_shards: Optional[int] = None,
+                 advertise_host: str = "localhost"):
         self.db = Database(make_storage(storage_type, db_path=db_path))
         self.no_workers_timeout = no_workers_timeout
+        self.shard_id = max(0, int(shard_id))
+        self.num_shards = max(1, int(
+            num_shards if num_shards is not None
+            else _shardmap.num_shards()))
+        self._advertise_host = advertise_host
+        # the newest shard-map epoch this master has observed — the
+        # fence `_fenced` NACKs stale-map mutations against; 0 until a
+        # map exists (single-shard deployments never publish one)
+        self._map_epoch = 0
+        self._shard_map: Optional[_shardmap.ShardMap] = None
+        _shardmap.note_identity(self.shard_id, self.num_shards)
         self.enable_watchdog = enable_watchdog
         # master-side span sink (export drained into each bulk's span
         # store): admission/assignment spans are the cross-host glue
@@ -590,10 +632,12 @@ class Master:
         # master generation via storage CAS — every mutating RPC reply
         # is stamped with it, checkpoint/journal paths are scoped by
         # it, and a master that sees a newer claim fences itself.
-        self.generation = _journal.claim_generation(self.db.backend)
+        self.generation = _journal.claim_generation(
+            self.db.backend, shard=self.shard_id)
         self._fence = threading.Event()
         self._journal: Optional[_journal.BulkJournal] = (
-            _journal.BulkJournal(self.db.backend, self.generation)
+            _journal.BulkJournal(self.db.backend, self.generation,
+                                 shard=self.shard_id)
             if _journal.enabled() else None)
         # NewJob admission-token dedupe: token -> bulk_id, bounded by
         # the insertion ring (a retry after an ambiguous timeout — or
@@ -625,8 +669,11 @@ class Master:
             "StartedWork": self._fenced(self._rpc_started_work),
             "EvalDone": self._rpc_eval_done,
             "FinishedWork": self._fenced(self._rpc_finished_work),
+            "FinishedWorkBatch": self._fenced(
+                self._rpc_finished_work_batch),
             "FailedWork": self._fenced(self._rpc_failed_work),
             "GetJobStatus": self._rpc_job_status,
+            "GetShardMap": self._rpc_get_shard_map,
             "GetMetrics": self._rpc_get_metrics,
             "GetHealth": self._rpc_get_health,
             "PokeWatchdog": self._rpc_poke,
@@ -644,6 +691,19 @@ class Master:
         }, port=port, tracer=self.tracer)
         self.port = self._server.port
         self._server.start()
+        # sharded deployments publish this shard's address into the
+        # durable map (epoch bump — the signal every map holder
+        # refreshes on).  A fenced master publishes nothing: its
+        # successor owns the shard's map entry now.
+        self.advertise_address = f"{advertise_host}:{self.port}"
+        if self.num_shards > 1 and not self._fence.is_set():
+            try:
+                self._adopt_shard_map(_shardmap.register_shard(
+                    self.db.backend, self.shard_id,
+                    self.advertise_address, self.num_shards))
+            except Exception:  # noqa: BLE001 — map publish is not
+                # worth failing startup over; the scan loop retries
+                _mlog.exception("shard-map publish failed at startup")
         # /metrics + /healthz + /statusz — strictly opt-in: no listener
         # exists unless metrics_port is given (0 = ephemeral port, see
         # .metrics_server.port)
@@ -697,9 +757,25 @@ class Master:
                 return {"error": "master fenced: generation "
                                  f"{self.generation} superseded",
                         "fenced": True, "generation": self.generation}
+            # the map-epoch fence (engine/shardmap.py): a caller that
+            # routed with an older shard map than this master has seen
+            # is NACKed so it refreshes and re-routes — a stale map can
+            # never push a mutation past a shard failover.  Requests
+            # with no map_epoch stamp (legacy / single-shard callers)
+            # always pass.
+            me = req.get("map_epoch") if isinstance(req, dict) else None
+            if me is not None and int(me) < self._map_epoch:
+                _shardmap.count_stale_map_rejection()
+                return {"error": f"stale shard map (epoch {int(me)} < "
+                                 f"{self._map_epoch})",
+                        "stale_map": True,
+                        "map_epoch": self._map_epoch,
+                        "generation": self.generation}
             reply = fn(req)
             if isinstance(reply, dict):
                 reply.setdefault("generation", self.generation)
+                if self.num_shards > 1:
+                    reply.setdefault("map_epoch", self._map_epoch)
             return reply
         guard.__name__ = getattr(fn, "__name__", "handler")
         return guard
@@ -712,13 +788,54 @@ class Master:
         if self._fence.is_set():
             return True
         try:
-            newest = _journal.highest_claimed(self.db.backend)
+            newest = _journal.highest_claimed(self.db.backend,
+                                              shard=self.shard_id)
         except Exception:  # noqa: BLE001 — a flaky storage poll must
             return False   # not fence a healthy master
         if newest > self.generation:
             self._fence_out(newest)
             return True
         return False
+
+    # -- shard map (engine/shardmap.py) -------------------------------------
+
+    def _adopt_shard_map(self, smap: _shardmap.ShardMap) -> None:
+        self._shard_map = smap
+        self._map_epoch = max(self._map_epoch, smap.epoch)
+        _shardmap.note_map_epoch(self._map_epoch)
+
+    def _refresh_shard_map(self) -> None:
+        """One storage poll for a newer map epoch (scan-loop cadence,
+        next to the generation-fence poll): a peer shard's failover
+        re-publish bumps the epoch, and adopting it here arms the
+        stale-map fence against pre-failover routing."""
+        if self.num_shards <= 1:
+            return
+        try:
+            smap = _shardmap.load(self.db.backend)
+        except Exception:  # noqa: BLE001 — a flaky poll keeps the
+            return         # current map; next tick retries
+        if smap is not None and smap.epoch > self._map_epoch:
+            self._adopt_shard_map(smap)
+
+    def _rpc_get_shard_map(self, req: dict) -> dict:
+        """The versioned shard map, served by every shard: clients and
+        workers resolve routing from any live master."""
+        if self.num_shards > 1 and (
+                self._shard_map is None
+                or len(self._shard_map.shards) < self.num_shards):
+            # startup race: peers registered AFTER this shard adopted
+            # its own publish — re-poll inline (bounded: only while
+            # the map is still missing members) so a resolver dialing
+            # any one shard sees the full membership
+            self._refresh_shard_map()
+        smap = self._shard_map
+        return {"epoch": self._map_epoch,
+                "shard_id": self.shard_id,
+                "num_shards": self.num_shards,
+                "shards": {str(k): v for k, v in
+                           (smap.shards if smap else {}).items()},
+                "generation": self.generation}
 
     def _fence_out(self, newest: int) -> None:
         self._fence.set()
@@ -784,6 +901,25 @@ class Master:
         # as the per-node offset gauges and keeps it for trace rebase.
         t1 = time.time()
         wid = req["worker_id"]
+        if req.get("slim"):
+            # the heartbeat fold (engine/shardmap.py): a multi-shard
+            # worker sends ONE full beat (clock sync, firing alerts,
+            # gang liveness) to the shard whose bulk it is working and
+            # a slim liveness-only beat to every other shard — per-
+            # (worker, shard) RPC volume stays one beat, but the
+            # payload fan-out is coalesced away
+            with self._lock:
+                w = self._workers.get(wid)
+                if w is None or not w.active:
+                    return {"reregister": True, "active_bulk": None,
+                            "generation": self.generation}
+                w.last_seen = time.time()
+                bulk = self._bulk
+                active = bulk.bulk_id \
+                    if bulk and not bulk.finished else None
+            _shardmap.count_coalesced("Heartbeat")
+            return {"reregister": False, "active_bulk": active,
+                    "generation": self.generation, "slim": True}
         recs: List[dict] = []
         with self._lock:
             w = self._workers.get(wid)
@@ -1501,13 +1637,75 @@ class Master:
         return {"ok": False, "revoked": True}
 
     def _rpc_finished_work(self, req: dict) -> dict:
-        key = (req["job_idx"], req["task_idx"])
         recs: List[dict] = []
         with self._lock:
+            reply, need_ckpt, finished_now, bulk = \
+                self._finished_work_locked(req, recs)
+        # write-ahead: the completion is durable in the journal BEFORE
+        # this handler acks — a kill -9 after the ack cannot lose it
+        # (outside the control lock; storage must not stall heartbeats)
+        self._journal_append(recs)
+        if need_ckpt:
+            # periodic metadata checkpoint: a master restart mid-bulk finds
+            # committed-so-far tables in the megafile and resumes from the
+            # persisted done-set.  Written OUTSIDE the control-plane lock —
+            # the Database has its own lock, and stalling heartbeats on a
+            # storage write would let the stale scan deactivate live
+            # workers.
+            self.db.write_megafile()
+            self._persist_bulk_progress(bulk)
+        if finished_now:
+            self._clear_bulk_checkpoint(bulk.bulk_id)
+        return reply
+
+    def _rpc_finished_work_batch(self, req: dict) -> dict:
+        """Coalesced completions (engine/shardmap.py): many FinishedWork
+        payloads in one RPC with ONE journal group-commit — the batch
+        is durable before any item is acked, so the write-ahead
+        contract holds for every item exactly as it does for the
+        singleton path.  Per-item replies ride back positionally so the
+        worker can dispatch revocation/gang-stale outcomes per task."""
+        items = list(req.get("items") or ())
+        recs: List[dict] = []
+        replies: List[dict] = []
+        need_ckpt = finished_now = False
+        bulk = None
+        with self._lock:
+            for item in items:
+                it = dict(item)
+                it.setdefault("bulk_id", req.get("bulk_id"))
+                it.setdefault("worker_id", req.get("worker_id"))
+                if "clock" not in it and req.get("clock"):
+                    it["clock"] = req["clock"]
+                r, ck, fin, b = self._finished_work_locked(it, recs)
+                replies.append(r)
+                need_ckpt = need_ckpt or ck
+                finished_now = finished_now or fin
+                bulk = b if b is not None else bulk
+        self._journal_append(recs)
+        _shardmap.count_coalesced("FinishedWork",
+                                  max(0, len(items) - 1))
+        if need_ckpt and bulk is not None:
+            self.db.write_megafile()
+            self._persist_bulk_progress(bulk)
+        if finished_now and bulk is not None:
+            self._clear_bulk_checkpoint(bulk.bulk_id)
+        return {"ok": all(r.get("ok") for r in replies),
+                "replies": replies}
+
+    def _finished_work_locked(self, req: dict, recs: List[dict]
+                              ) -> Tuple[dict, bool, bool,
+                                         Optional[_BulkJob]]:
+        """One completion applied under self._lock (shared by the
+        singleton and batch handlers).  Returns (reply, need_ckpt,
+        finished_now, bulk); the caller journals `recs` and runs the
+        checkpoint/cleanup I/O outside the lock."""
+        key = (req["job_idx"], req["task_idx"])
+        with self._lock:  # reentrant: both callers already hold it
             self._touch_worker(req.get("worker_id"))
             bulk = self._bulk
             if bulk is None or bulk.bulk_id != req["bulk_id"]:
-                return {"ok": False}
+                return {"ok": False}, False, False, None
             # piggybacked trace spans (the worker drains its export
             # buffer into every FinishedWork, so no second RPC rides
             # the per-task hot path): absorbed before the revocation
@@ -1530,7 +1728,7 @@ class Master:
                     if g is not None:
                         _gang.count_stale_nack("FinishedWork")
                     return {"ok": False, "revoked": True,
-                            "gang_stale": True}
+                            "gang_stale": True}, False, False, bulk
                 # accepted: retire the gang — survivors' late acks are
                 # acknowledged via the retired map, and their held
                 # slots release here
@@ -1553,10 +1751,11 @@ class Master:
             cur = bulk.outstanding.get(key)
             if cur is None or cur[0] != req.get("worker_id") \
                     or cur[2] != req.get("attempt"):
-                return {"ok": False, "revoked": True}
+                return {"ok": False, "revoked": True}, False, False, \
+                    bulk
             self._unassign(bulk, key)
             if key in bulk.done or key[0] in bulk.blacklisted_jobs:
-                return {"ok": True}
+                return {"ok": True}, False, False, bulk
             bulk.done.add(key)
             recs.append({"t": "done", "j": key[0], "k": key[1]})
             bulk.job_done[key[0]] = bulk.job_done.get(key[0], 0) + 1
@@ -1573,23 +1772,7 @@ class Master:
             need_ckpt = (bulk.checkpoint_frequency > 0 and not bulk.finished
                          and len(bulk.done) % bulk.checkpoint_frequency == 0)
             self._maybe_finish_bulk(bulk)
-            finished_now = bulk.finished
-        # write-ahead: the completion is durable in the journal BEFORE
-        # this handler acks — a kill -9 after the ack cannot lose it
-        # (outside the control lock; storage must not stall heartbeats)
-        self._journal_append(recs)
-        if need_ckpt:
-            # periodic metadata checkpoint: a master restart mid-bulk finds
-            # committed-so-far tables in the megafile and resumes from the
-            # persisted done-set.  Written OUTSIDE the control-plane lock —
-            # the Database has its own lock, and stalling heartbeats on a
-            # storage write would let the stale scan deactivate live
-            # workers.
-            self.db.write_megafile()
-            self._persist_bulk_progress(bulk)
-        if finished_now:
-            self._clear_bulk_checkpoint(bulk.bulk_id)
-        return {"ok": True}
+            return {"ok": True}, need_ckpt, bulk.finished, bulk
 
     def _rpc_failed_work(self, req: dict) -> dict:
         key = (req["job_idx"], req["task_idx"])
@@ -1761,6 +1944,12 @@ class Master:
                 # this db and every mutating RPC here is rejected
                 "generation": self.generation,
                 "fenced": self._fence.is_set(),
+                # the Shard panel (docs/robustness.md §Sharded control
+                # plane): which partition this master serves and the
+                # map epoch its stale-map fence sits at
+                "shard": {"shard_id": self.shard_id,
+                          "num_shards": self.num_shards,
+                          "map_epoch": self._map_epoch},
                 # the Health panel: this process's roll-up + firing
                 # alerts (util/health.py; outside the control lock)
                 "health": _health.status_dict(),
@@ -1809,7 +1998,10 @@ class Master:
             finally:
                 c.close()
 
-        if targets:
+        # req["workers"]=False: shard fan-in pulls workers through ONE
+        # shard only (every shard sees the same fleet; duplicating the
+        # worker dials M times would skew the merged counters M-fold)
+        if targets and req.get("workers", True):
             with _fut.ThreadPoolExecutor(
                     max_workers=min(16, len(targets))) as pool:
                 for wid, reply in pool.map(lambda t: pull(*t), targets):
@@ -2352,8 +2544,9 @@ class Master:
             return
         state = self._bulk_checkpoint_state(bulk)
         blob = seal_blob(cloudpickle.dumps(state))
-        self.db.backend.write(md.bulk_checkpoint_path(self.generation),
-                              blob)
+        self.db.backend.write(
+            md.bulk_checkpoint_path(self.generation, self.shard_id),
+            blob)
         if self._journal is not None:
             self._journal.reset()
             self._journal_append([{"t": "admit", "state": state}])
@@ -2420,8 +2613,9 @@ class Master:
             cut = self._journal.cut() if self._journal is not None \
                 else None
         prog["done_runs"] = self._encode_task_set(done)
-        self.db.backend.write(md.bulk_progress_path(self.generation),
-                              seal_blob(cloudpickle.dumps(prog)))
+        self.db.backend.write(
+            md.bulk_progress_path(self.generation, self.shard_id),
+            seal_blob(cloudpickle.dumps(prog)))
         if cut is not None and self._journal is not None:
             self._journal.compact_below(cut)
             # re-seed the admit record: compaction may have deleted the
@@ -2450,13 +2644,15 @@ class Master:
             # same contract as the legacy deletes below (baselined):
             # the admission lock exists to serialize storage-mutating
             # admission + checkpoint cleanup end-to-end
-            self.db.backend.delete(md.bulk_checkpoint_path(self.generation))  # scanner-check: disable=SC202 admission lock serializes checkpoint cleanup by design (see baseline twin)
-            self.db.backend.delete(md.bulk_progress_path(self.generation))  # scanner-check: disable=SC202 admission lock serializes checkpoint cleanup by design (see baseline twin)
+            self.db.backend.delete(md.bulk_checkpoint_path(self.generation, self.shard_id))  # scanner-check: disable=SC202 admission lock serializes checkpoint cleanup by design (see baseline twin)
+            self.db.backend.delete(md.bulk_progress_path(self.generation, self.shard_id))  # scanner-check: disable=SC202 admission lock serializes checkpoint cleanup by design (see baseline twin)
             if self._journal is not None:
                 self._journal.reset()
             # legacy fixed-path state from pre-fencing masters
-            self.db.backend.delete(md.bulk_checkpoint_path())
-            self.db.backend.delete(md.bulk_progress_path())
+            self.db.backend.delete(
+                md.bulk_checkpoint_path(shard=self.shard_id))
+            self.db.backend.delete(
+                md.bulk_progress_path(shard=self.shard_id))
 
     def _load_sealed(self, path: str, what: str) -> Optional[bytes]:
         """Read a (possibly legacy-unsealed) control-plane blob —
@@ -2473,16 +2669,19 @@ class Master:
         fixed path) holding bulk state.  Returns (source_gen-or-None,
         admission_state, journal_records, journal_stats) or None."""
         gens = [g for g in
-                _journal.claimed_generations(self.db.backend)
+                _journal.claimed_generations(self.db.backend,
+                                             shard=self.shard_id)
                 if g < self.generation]
         for g in sorted(gens, reverse=True) + [None]:
             records: List[dict] = []
             jstats: Dict[str, int] = {}
             if g is not None:
-                records, jstats = _journal.replay(self.db.backend, g)
+                records, jstats = _journal.replay(
+                    self.db.backend, g, shard=self.shard_id)
             state = None
             payload = self._load_sealed(
-                md.bulk_checkpoint_path(g), "bulk checkpoint")
+                md.bulk_checkpoint_path(g, self.shard_id),
+                "bulk checkpoint")
             if payload is not None:
                 try:
                     state = cloudpickle.loads(payload)
@@ -2554,10 +2753,13 @@ class Master:
         before this leaves both copies; the next recovery prefers the
         newer one)."""
         if g is None:
-            self.db.backend.delete(md.bulk_checkpoint_path())
-            self.db.backend.delete(md.bulk_progress_path())
+            self.db.backend.delete(
+                md.bulk_checkpoint_path(shard=self.shard_id))
+            self.db.backend.delete(
+                md.bulk_progress_path(shard=self.shard_id))
         else:
-            self.db.backend.delete_prefix(md.generation_dir(g))
+            self.db.backend.delete_prefix(
+                md.generation_dir(g, self.shard_id))
 
     def _recover_bulk(self) -> None:
         """Resume the bulk job a previous master process left behind:
@@ -2612,7 +2814,8 @@ class Master:
             bulk.total_tasks += n
         try:
             prog_payload = self._load_sealed(
-                md.bulk_progress_path(source_gen), "bulk progress")
+                md.bulk_progress_path(source_gen, self.shard_id),
+                "bulk progress")
             prog = cloudpickle.loads(prog_payload) \
                 if prog_payload is not None else None
             if prog is not None and prog.get("bulk_id") == bulk.bulk_id:
@@ -2673,6 +2876,18 @@ class Master:
             if remaining:
                 bulk.queue[j] = deque(remaining)
                 bulk.job_rr.append(j)
+        if self.num_shards > 1:
+            # shard-failover accounting: a journaled (acknowledged)
+            # completion that landed back in the queue would re-execute
+            # work a worker already finished.  Structurally zero —
+            # replay unions into bulk.done before the queue rebuild —
+            # and the master-shard-loss chaos drill asserts it stays so.
+            journaled = {(int(r["j"]), int(r["k"])) for r in records
+                         if r.get("t") == "done"}
+            requeued = {(j, t) for j, q in bulk.queue.items()
+                        for t in q}
+            _shardmap.count_journal_reexec(len(journaled & requeued))
+            _shardmap.count_failover()
         # published under the lock: _recover_bulk normally runs before
         # the RPC server exists, but nothing in its signature promises
         # that — and handler threads read these fields under _lock
@@ -2790,6 +3005,9 @@ class Master:
             fence_tick += 1
             if fence_tick % 4 == 0:
                 self._check_fence()
+                # same cadence: adopt newer shard-map epochs so the
+                # stale-map fence reflects peers' failover re-publishes
+                self._refresh_shard_map()
             recs: List[dict] = []
             with self._lock:
                 # refresh the point-in-time gauges (0.5s resolution is
@@ -2993,6 +3211,49 @@ class Master:
 # Worker
 # ---------------------------------------------------------------------------
 
+class _ShardLink:
+    """One worker's connection to one master shard: its own channel,
+    the worker id THAT shard handed out (ids are per-shard), a
+    generation latch scoped to that shard's namespace, and the
+    freshest heartbeat reply.  The worker multiplexes pulls and
+    reports across its links (docs/robustness.md §Sharded control
+    plane); with one shard no links exist and the legacy single-master
+    fields are the whole story."""
+
+    def __init__(self, shard_id: int, address: str):
+        self.shard_id = int(shard_id)
+        self.address = str(address)
+        self.client = rpc.RpcClient(address, MASTER_SERVICE,
+                                    timeout=10.0)
+        self.worker_id: Optional[int] = None
+        self.gen = _journal.GenerationLatch()
+        self.hb_reply: dict = {}
+        self.hb_reply_at = 0.0
+        self.hb_misses = 0
+
+    def redial(self, address: Optional[str] = None) -> None:
+        """Fresh channel (the wedged-channel pathology — see
+        Worker._heartbeat_loop), optionally at a new address a
+        failover respawn re-published."""
+        if address:
+            self.address = str(address)
+        old, self.client = self.client, rpc.RpcClient(
+            self.address, MASTER_SERVICE, timeout=10.0)
+        old.close()
+
+    def close(self) -> None:
+        try:
+            self.client.close()
+        except Exception:  # noqa: BLE001 — shutdown is best-effort
+            pass
+
+
+# how long completions may pool in the worker-side batcher before a
+# FinishedWorkBatch flush (sharded mode only): short enough that the
+# master's progress view lags by at most ~one heartbeat fraction
+FINISHED_BATCH_WINDOW_S = 0.05
+
+
 class Worker:
     """Executes tasks pulled from the master; one process per node.
 
@@ -3141,8 +3402,49 @@ class Worker:
         self.executor.tracer = self.tracer
         _wlog.info("worker %d registered with master %s (port %d)",
                    self.worker_id, master_address, self.port)
-        # cached per-bulk state
+        # sharded control plane: resolve the shard map from the seed
+        # master and register with every OTHER shard too (each hands
+        # out its own worker id).  The legacy fields (self.master /
+        # worker_id / _gen / _hb_reply) become an alias for whichever
+        # link currently owns this worker's active work — the whole
+        # pull/report plumbing speaks through them unchanged.
+        self._links: Dict[int, _ShardLink] = {}
+        self._active_shard: Optional[int] = None
+        self._map = _shardmap.MapHolder()
+        self._map_beat = 0
+        self._fin_lock = threading.Lock()
+        self._fin_items: List[Tuple[int, dict]] = []
+        if _shardmap.num_shards() > 1:
+            smap_reply = self.master.try_call("GetShardMap",
+                                              timeout=PING_TIMEOUT)
+            if smap_reply and int(smap_reply.get("num_shards", 1)) > 1 \
+                    and smap_reply.get("shards"):
+                seed_sid = int(smap_reply.get("shard_id", 0))
+                seed = _ShardLink(seed_sid, master_address)
+                seed.client.close()
+                seed.client = self.master
+                seed.worker_id = self.worker_id
+                seed.gen = self._gen
+                self._links[seed_sid] = seed
+                self._active_shard = seed_sid
+                self._map.observe(_shardmap.ShardMap(
+                    epoch=int(smap_reply.get("epoch", 0)),
+                    shards={int(k): v for k, v
+                            in smap_reply["shards"].items()},
+                    num_shards=int(smap_reply["num_shards"])))
+                self._sync_links()
+                # completion batcher: pooled FinishedWork flush
+                # (FinishedWorkBatch — one journal group-commit per
+                # flush on the master; see _queue_finished)
+                threading.Thread(target=self._fin_flush_loop,
+                                 name="worker-finbatch",
+                                 daemon=True).start()
+        # cached per-bulk state.  The cache key is (shard, bulk_id):
+        # every shard mints its own bulk ids, so bulk 1 on shard 0 and
+        # bulk 1 on shard 2 are different jobs — a bare-id cache would
+        # silently reuse the wrong spec after a shard switch
         self._bulk_id: Optional[int] = None
+        self._bulk_key: Optional[Tuple[Optional[int], int]] = None
         self._info = None
         self._jobs = None
         self._queue_size: Optional[int] = None
@@ -3194,6 +3496,14 @@ class Worker:
                                    detail=str(self.worker_id))
             except Exception:  # noqa: BLE001 — injected fault: this
                 time.sleep(PING_INTERVAL)  # beat is dropped, loop lives
+                continue
+            if self._links:
+                # sharded control plane: one beat per (worker, shard)
+                # period — the full payload goes to the shard owning
+                # this worker's active work, every other shard gets a
+                # slim liveness-only beat (see Master._rpc_heartbeat)
+                self._beat_shards()
+                time.sleep(PING_INTERVAL)
                 continue
             # short per-call deadline (PING_TIMEOUT, ~2x the ping
             # period) instead of the 30s client default: a hung master
@@ -3264,6 +3574,199 @@ class Worker:
                     self._hb_reply_at = time.time()
             time.sleep(PING_INTERVAL)
 
+    # -- sharded control plane (engine/shardmap.py) --------------------
+
+    def _sync_links(self) -> None:
+        """Reconcile the per-shard links with the newest shard map:
+        dial + register with shards we hold no link to, and redial a
+        link whose shard re-published at a different address (a
+        failover respawn elsewhere)."""
+        smap = self._map.get()
+        if smap is None:
+            return
+        for sid in smap.shard_ids():
+            addr = smap.address_of(sid)
+            link = self._links.get(sid)
+            if link is None:
+                link = _ShardLink(sid, addr)
+                self._links[sid] = link
+            elif link.address != addr:
+                link.redial(addr)
+                link.worker_id = None  # the new process mints fresh ids
+            if link.worker_id is None:
+                reg = link.client.try_call(
+                    "RegisterWorker", address=self.advertise_address,
+                    gang_address=self._gang_address,
+                    timeout=PING_TIMEOUT)
+                if reg and reg.get("worker_id") is not None:
+                    link.gen.observe(reg)
+                    link.worker_id = reg["worker_id"]
+                    if sid == self._active_shard:
+                        self.worker_id = link.worker_id
+
+    def _refresh_map(self) -> None:
+        """Adopt a newer shard map from whichever shard answers — a
+        respawned shard's re-publish (epoch bump) re-points its link
+        here even when the shard we usually ask is the dead one."""
+        reply = None
+        for link in list(self._links.values()):
+            reply = link.client.try_call("GetShardMap",
+                                         timeout=PING_TIMEOUT)
+            if reply and reply.get("shards"):
+                break
+        if not reply or not reply.get("shards"):
+            return
+        smap = _shardmap.ShardMap(
+            epoch=int(reply.get("epoch", 0)),
+            shards={int(k): v for k, v in reply["shards"].items()},
+            num_shards=int(reply.get("num_shards", 1)))
+        if self._map.observe(smap):
+            self._sync_links()
+
+    def _beat_shards(self) -> None:
+        """One heartbeat pass across every shard link.  Exactly one
+        full beat per period — to the shard owning our active work
+        (clock exchange, firing alerts, gang liveness ride it) — and
+        slim liveness-only beats to the rest; the coalescing counter
+        on the master records each slim beat as a saved full payload."""
+        try:
+            firing = _health.firing_rules()
+        except Exception:  # noqa: BLE001 — liveness > health detail
+            firing = []
+        active = self._active_shard
+        for link in list(self._links.values()):
+            if link.worker_id is None:
+                continue
+            kwargs: dict = {"worker_id": link.worker_id,
+                            "timeout": PING_TIMEOUT,
+                            "preempting": self._preempting}
+            if link.shard_id != active:
+                kwargs["slim"] = True
+            else:
+                kwargs["firing"] = firing
+                if _clocksync.enabled():
+                    kwargs["t0"] = time.time()
+                    est = self._clock.estimate()
+                    if est is not None:
+                        kwargs["clock"] = est
+            hb = link.client.try_call("Heartbeat", **kwargs)
+            if hb is not None and "t1" in hb and "t0" in kwargs:
+                self._clock.add_sample(kwargs["t0"], hb["t1"],
+                                       hb["t2"], time.time())
+            if hb is None:
+                # same redial discipline as the single-master loop: 5
+                # consecutive misses = assume a wedged channel; the
+                # map refresh below re-points the address if the
+                # shard's respawn re-published elsewhere
+                link.hb_misses += 1
+                if link.hb_misses % 5 == 0 \
+                        and not self._shutdown.is_set():
+                    _wlog.warning(
+                        "worker: %d heartbeat misses on shard %d — "
+                        "redialing %s", link.hb_misses, link.shard_id,
+                        link.address)
+                    link.redial()
+                continue
+            link.hb_misses = 0
+            if not link.gen.observe(hb):
+                continue  # a superseded shard master's verdicts
+            if hb.get("reregister"):
+                if not self._draining.is_set():
+                    reg = link.client.try_call(
+                        "RegisterWorker",
+                        address=self.advertise_address,
+                        gang_address=self._gang_address,
+                        timeout=PING_TIMEOUT)
+                    if reg and reg.get("worker_id") is not None:
+                        link.worker_id = reg["worker_id"]
+                        if link.shard_id == active:
+                            self.worker_id = link.worker_id
+            else:
+                link.hb_reply = hb
+                link.hb_reply_at = time.time()
+                if link.shard_id == active:
+                    self._hb_reply = hb
+                    self._hb_reply_at = link.hb_reply_at
+        self._map_beat += 1
+        smap = self._map.get()
+        if self._map_beat % 5 == 0 or (
+                smap is not None
+                and len(self._links) < smap.num_shards):
+            self._refresh_map()
+
+    def _bind_link(self, link: _ShardLink) -> None:
+        """Point the legacy single-master fields at one shard's link;
+        the pull/report plumbing (_pull_loop, _gang_loop, span/profile
+        ships) all speak through self.master / self.worker_id and so
+        work unchanged against whichever shard owns the active bulk."""
+        self._active_shard = link.shard_id
+        self._master_address = link.address
+        self.master = link.client
+        self.worker_id = link.worker_id
+        self._gen = link.gen
+        self._hb_reply = link.hb_reply
+        self._hb_reply_at = link.hb_reply_at
+
+    def _switch_active_link(self) -> None:
+        """Between bulks: re-point the pull plumbing at whichever
+        shard currently has work for this worker.  _work_loop only
+        calls this while no pull loop runs, so the rebind never races
+        an in-flight bulk."""
+        cur = self._links.get(self._active_shard) \
+            if self._active_shard is not None else None
+        if cur is not None \
+                and cur.hb_reply.get("active_bulk") is not None:
+            return
+        for link in self._links.values():
+            if link.worker_id is None:
+                continue
+            if link.hb_reply.get("active_bulk") is not None:
+                _wlog.info(
+                    "worker: switching to shard %d (bulk %s, worker "
+                    "id %d there)", link.shard_id,
+                    link.hb_reply.get("active_bulk"), link.worker_id)
+                self._bind_link(link)
+                return
+
+    def _queue_finished(self, bulk_id: int, item: dict) -> None:
+        """Pool a completion for the next FinishedWorkBatch flush
+        (sharded mode): the master journals the whole batch in ONE
+        group-commit before acking, so pooling trades ≤
+        FINISHED_BATCH_WINDOW_S of progress-view lag for an RPC (and
+        fsync) per task.  An unflushed completion lost with the
+        process re-queues via the ordinary assignment timeout — the
+        same contract as a lost FinishedWork RPC."""
+        with self._fin_lock:
+            self._fin_items.append((bulk_id, item))
+
+    def _fin_flush_loop(self) -> None:
+        while not self._shutdown.is_set():
+            time.sleep(FINISHED_BATCH_WINDOW_S)
+            try:
+                self._flush_finished()
+            except Exception:  # noqa: BLE001 — keep the flusher alive
+                _wlog.exception("finished-work batch flush failed")
+        self._flush_finished()  # final drain on shutdown
+
+    def _flush_finished(self) -> None:
+        with self._fin_lock:
+            items, self._fin_items = self._fin_items, []
+        if not items:
+            return
+        by_bulk: Dict[int, List[dict]] = {}
+        for b, item in items:
+            by_bulk.setdefault(b, []).append(item)
+        for b, its in by_bulk.items():
+            if len(its) == 1:
+                self.master.try_call(
+                    "FinishedWork", bulk_id=b,
+                    worker_id=self.worker_id, **its[0])
+            else:
+                self.master.try_call(
+                    "FinishedWorkBatch", bulk_id=b,
+                    worker_id=self.worker_id,
+                    clock=self._clock.estimate(), items=its)
+
     def _rpc_shutdown(self, req: dict) -> dict:
         self._shutdown.set()
         return {"ok": True}
@@ -3306,8 +3809,17 @@ class Worker:
         explicit UnregisterWorker makes the master requeue-check and
         deactivate immediately instead of burning WORKER_STALE_AFTER
         on the stale scan."""
-        self.master.try_call("UnregisterWorker", worker_id=self.worker_id,
-                             timeout=PING_TIMEOUT)
+        if self._links:
+            self._flush_finished()  # pooled completions leave first
+            for link in self._links.values():
+                if link.worker_id is not None:
+                    link.client.try_call("UnregisterWorker",
+                                         worker_id=link.worker_id,
+                                         timeout=PING_TIMEOUT)
+        else:
+            self.master.try_call("UnregisterWorker",
+                                 worker_id=self.worker_id,
+                                 timeout=PING_TIMEOUT)
         _wlog.info("worker %d: drain complete, deregistered",
                    self.worker_id)
         self._shutdown.set()
@@ -3354,6 +3866,8 @@ class Worker:
                 # in-flight tasks finished: deregister and stop
                 self._finish_drain()
                 break
+            if self._links:
+                self._switch_active_link()
             bulk_id = self._hb_reply.get("active_bulk")
             if bulk_id is None:
                 time.sleep(PING_INTERVAL / 4)
@@ -3406,9 +3920,9 @@ class Worker:
     def _post_profile(self, bulk_id: int) -> None:
         """Ship this worker's profile to the master once per bulk job
         (reference: worker profile files, worker.cpp:2067-2138)."""
-        if bulk_id in self._posted_profiles:
+        if (self._active_shard, bulk_id) in self._posted_profiles:
             return
-        self._posted_profiles.add(bulk_id)
+        self._posted_profiles.add((self._active_shard, bulk_id))
         # final span flush: whatever the per-task ships didn't cover
         # (e.g. spans of tasks that failed mid-pipeline)
         self._ship_spans(bulk_id)
@@ -3426,7 +3940,7 @@ class Worker:
                              profile=self.profiler.to_dict())
 
     def _ensure_bulk(self, bulk_id: int) -> None:
-        if self._bulk_id == bulk_id:
+        if self._bulk_key == (self._active_shard, bulk_id):
             return
         raw = self.master.call("GetJob", bulk_id=bulk_id)["spec"]
         spec = cloudpickle.loads(raw)
@@ -3471,6 +3985,7 @@ class Worker:
             self._evaluators = {}
         self._info, self._jobs = info, jobs
         self._bulk_id = bulk_id
+        self._bulk_key = (self._active_shard, bulk_id)
         _wlog.info("worker %d joined bulk %d: %d jobs, pipeline=%d",
                    self.worker_id, bulk_id, len(jobs),
                    self.executor.pipeline_instances)
@@ -3570,11 +4085,17 @@ class Worker:
             # task span closed before on_done fired): the master holds
             # the full chain the moment the completion — which can
             # finish the bulk — lands, with no second per-task RPC
-            self.master.try_call(
-                "FinishedWork", bulk_id=bulk_id, worker_id=self.worker_id,
-                job_idx=w.job.job_idx, task_idx=w.task_idx,
-                attempt=w.attempt, spans=self.tracer.drain_export(),
-                clock=self._clock.estimate())
+            item = dict(job_idx=w.job.job_idx, task_idx=w.task_idx,
+                        attempt=w.attempt,
+                        spans=self.tracer.drain_export(),
+                        clock=self._clock.estimate())
+            if self._links:
+                # sharded mode: pool for the FinishedWorkBatch flush
+                self._queue_finished(bulk_id, item)
+            else:
+                self.master.try_call(
+                    "FinishedWork", bulk_id=bulk_id,
+                    worker_id=self.worker_id, **item)
 
         def on_task_error(w, exc) -> bool:
             _wlog.exception("worker %d: task (%d,%d) failed",
@@ -3783,7 +4304,12 @@ class Worker:
             for te in self._evaluators.values():
                 te.close()
             self._evaluators = {}
-        self.master.close()
+        if self._links:
+            for link in self._links.values():
+                link.close()  # the active link IS self.master
+            self._links = {}
+        else:
+            self.master.close()
 
 
 # ---------------------------------------------------------------------------
@@ -3792,7 +4318,14 @@ class Worker:
 
 class ClusterClient:
     """Submits bulk jobs to a master and polls progress
-    (reference Client.run gRPC path + _start_heartbeat, client.py:324)."""
+    (reference Client.run gRPC path + _start_heartbeat, client.py:324).
+
+    Against a sharded control plane the given address is just the SEED:
+    the client resolves the versioned shard map from it (GetShardMap,
+    lazily, cached), routes each admission to the shard the token
+    hashes to — stamping the map's epoch so a stale map is NACKed
+    instead of silently routing past a failover — and fans
+    metrics/health/status reads in across every shard."""
 
     def __init__(self, master_address: str, db: Database,
                  enable_watchdog: bool = False, poll_interval: float = 0.25,
@@ -3802,6 +4335,14 @@ class ClusterClient:
         self.master = rpc.RpcClient(master_address, MASTER_SERVICE)
         self.poll_interval = poll_interval
         self._last_refresh = time.time()
+        # sharded control plane: the resolved map (None = unsharded,
+        # the overwhelmingly common case), per-shard channels keyed by
+        # shard id, and the shard the last run() admitted to (its
+        # GetJobStatus poll goes there, as does Client.trace's pull)
+        self._smap: Optional[_shardmap.ShardMap] = None
+        self._smap_resolved = False
+        self._shard_clients: Dict[int, rpc.RpcClient] = {}
+        self._last_shard: Optional[int] = None
         # how long GetJobStatus may fail continuously before the client
         # gives up — long enough to ride out a master restart (it recovers
         # the bulk from its checkpoint), short enough that a dead master
@@ -3833,6 +4374,78 @@ class ClusterClient:
             self._master_address, MASTER_SERVICE)
         old.close()
 
+    # -- sharded control plane (engine/shardmap.py) --------------------
+
+    def _resolve_shard_map(self, force: bool = False) \
+            -> Optional[_shardmap.ShardMap]:
+        """The cluster's shard map, or None (unsharded).  Resolved
+        lazily via GetShardMap — every shard serves it; an unsharded
+        master answers num_shards=1, which caches as None — and
+        re-resolved on force (a stale-map NACK, a wedged shard)."""
+        if self._smap_resolved and not force:
+            return self._smap
+        reply = self.master.try_call("GetShardMap", timeout=5.0)
+        if reply is None and self._smap is not None:
+            # the seed shard may be the dead one: any shard serves
+            # the map, so ask the rest before giving up
+            for sid in self._smap.shard_ids():
+                c = self._shard_clients.get(sid)
+                if c is None:
+                    continue
+                reply = c.try_call("GetShardMap", timeout=5.0)
+                if reply:
+                    break
+        if reply is None:
+            return self._smap  # unreachable: keep what we have
+        self._smap_resolved = True
+        if int(reply.get("num_shards", 1) or 1) <= 1 \
+                or not reply.get("shards"):
+            self._smap = None
+        else:
+            smap = _shardmap.ShardMap(
+                epoch=int(reply.get("epoch", 0)),
+                shards={int(k): v
+                        for k, v in reply["shards"].items()},
+                num_shards=int(reply["num_shards"]))
+            if self._smap is None or smap.epoch >= self._smap.epoch:
+                self._smap = smap
+        return self._smap
+
+    def _shard_client(self, sid: Optional[int]) -> rpc.RpcClient:
+        """The channel for one shard (the seed channel doubles as its
+        own shard's); dials on first use, re-dials when the map moved
+        the shard's address (failover respawn)."""
+        smap = self._smap
+        addr = smap.address_of(sid) if (smap and sid is not None) \
+            else None
+        if addr is None or addr == self._master_address:
+            return self.master
+        c = self._shard_clients.get(sid)
+        if c is None or c.address != addr:
+            if c is not None:
+                c.close()
+            c = rpc.RpcClient(addr, MASTER_SERVICE)
+            self._shard_clients[sid] = c
+        return c
+
+    def _redial_shard(self, sid: Optional[int]) -> None:
+        """Fresh channel to one shard (the wedged-channel pathology —
+        see _refresh_channel), re-resolving the map first so a
+        failover respawn's re-published address is what gets dialed."""
+        self._resolve_shard_map(force=True)
+        self._last_refresh = time.time()
+        if sid is None or self._smap is None:
+            self._refresh_channel()
+            return
+        addr = self._smap.address_of(sid)
+        if addr is None or addr == self._master_address:
+            self._refresh_channel()
+            return
+        old = self._shard_clients.pop(sid, None)
+        if old is not None:
+            old.close()
+        self._shard_clients[sid] = rpc.RpcClient(addr, MASTER_SERVICE)
+
     def run(self, outputs, perf: PerfParams, cache_mode: CacheMode,
             show_progress: bool) -> List[Profiler]:
         import uuid
@@ -3856,11 +4469,21 @@ class ClusterClient:
         # token makes the repeat safe.
         admit_deadline = time.time() + self.master_down_timeout
         admit_fails = [0]
+        # sharded routing: the token hashes to its owning shard, and
+        # the admission carries the map epoch it routed with — a
+        # master holding a newer map NACKs it (stale_map) and we
+        # refresh + re-route instead of mutating past a failover
+        smap = self._resolve_shard_map()
+        route = {"shard": smap.shard_for(token) if smap else None}
 
         def _admit() -> dict:
+            cli = self._shard_client(route["shard"])
+            kwargs = {}
+            if self._smap is not None:
+                kwargs["map_epoch"] = self._smap.epoch
             try:
-                return self.master.call("NewJob", spec=spec,
-                                        token=token, timeout=120.0)
+                return cli.call("NewJob", spec=spec, token=token,
+                                timeout=120.0, **kwargs)
             except rpc.RpcError:
                 # the wedged-channel pathology (see _refresh_channel):
                 # a channel whose peer died mid-dial can stay stuck
@@ -3868,7 +4491,13 @@ class ClusterClient:
                 # failed admission attempts, like the status poll does
                 admit_fails[0] += 1
                 if admit_fails[0] % 8 == 0:
-                    self._refresh_channel()
+                    if route["shard"] is not None:
+                        self._redial_shard(route["shard"])
+                        nm = self._smap
+                        if nm is not None:
+                            route["shard"] = nm.shard_for(token)
+                    else:
+                        self._refresh_channel()
                 raise
 
         while True:
@@ -3880,9 +4509,20 @@ class ClusterClient:
                     and time.time() < admit_deadline:
                 time.sleep(float(reply.get("retry_after") or 1.0))
                 continue
+            if reply.get("stale_map") \
+                    and time.time() < admit_deadline:
+                # the map moved underneath this admission (a shard
+                # failed over): refresh, re-route, re-present — the
+                # token dedupes if the first attempt actually landed
+                self._resolve_shard_map(force=True)
+                if self._smap is not None:
+                    route["shard"] = self._smap.shard_for(token)
+                continue
             break
         if "error" in reply:
             raise JobException(reply["error"])
+        self._last_shard = route["shard"]
+        poll = self._shard_client(route["shard"])
         bulk_id = reply["bulk_id"]
         self.last_bulk_id = bulk_id
         last_ok = time.time()
@@ -3891,8 +4531,11 @@ class ClusterClient:
             # try_call: a master restarting mid-bulk (it recovers the job
             # from its checkpoint) must look like slow progress, not a
             # client-visible failure — but a master that stays dead past
-            # master_down_timeout raises instead of hanging forever
-            st = self.master.try_call("GetJobStatus", bulk_id=bulk_id)
+            # master_down_timeout raises instead of hanging forever.
+            # Sharded: the poll goes to the ADMITTING shard — re-looked
+            # up each pass, so a redial's fresh channel is picked up
+            poll = self._shard_client(route["shard"])
+            st = poll.try_call("GetJobStatus", bulk_id=bulk_id)
             if st is None:
                 now = time.time()
                 if now - last_ok > self.master_down_timeout:
@@ -3905,7 +4548,10 @@ class ClusterClient:
                     # a channel whose peer died mid-dial can wedge past
                     # the restart (see rpc.wait_for_server): redial the
                     # restarted/successor master on a FRESH channel
-                    self._refresh_channel()
+                    if route["shard"] is not None:
+                        self._redial_shard(route["shard"])
+                    else:
+                        self._refresh_channel()
                 time.sleep(self.poll_interval)
                 continue
             last_ok = time.time()
@@ -3920,7 +4566,7 @@ class ClusterClient:
                     # resolve=True: a lookup-only probe — an unknown
                     # token answers unknown_token instead of admitting
                     # a fresh bulk this client would then abandon
-                    reply = self.master.try_call(
+                    reply = poll.try_call(
                         "NewJob", spec=spec, token=token, resolve=True,
                         timeout=120.0)
                     if reply and reply.get("dedup") \
@@ -3954,26 +4600,83 @@ class ClusterClient:
                 # workers post profiles right after their last task; give
                 # them a beat, then collect what arrived
                 time.sleep(2 * self.poll_interval)
-                reply = self.master.try_call("GetProfiles",
-                                             bulk_id=bulk_id) or {}
+                reply = poll.try_call("GetProfiles",
+                                      bulk_id=bulk_id) or {}
                 return [Profiler.from_dict(d)
                         for d in reply.get("profiles", [])]
             time.sleep(self.poll_interval)
 
     def metrics(self) -> dict:
         """Cluster-wide merged metrics snapshot (master + every live
-        worker, node-labeled) via the master's GetMetrics RPC."""
-        reply = self.master.call("GetMetrics", timeout=30.0)
-        return reply["snapshot"]
+        worker, node-labeled) via the master's GetMetrics RPC.
+        Sharded: fanned in across every shard — each shard's master
+        samples relabel to shard<k>, and the worker fan-out rides ONE
+        shard only (every shard sees the same fleet; pulling workers M
+        times would skew the merged counters M-fold)."""
+        smap = self._resolve_shard_map()
+        if smap is None:
+            reply = self.master.call("GetMetrics", timeout=30.0)
+            return reply["snapshot"]
+        sids = smap.shard_ids()
+        primary = sids[0] if sids else 0
+        by_node: Dict[str, dict] = {}
+        for sid in sids:
+            reply = self._shard_client(sid).try_call(
+                "GetMetrics", timeout=30.0, workers=(sid == primary))
+            if not reply or "snapshot" not in reply:
+                continue  # a dead shard drops out of the merged view
+            snap = reply["snapshot"]
+            for entry in snap.values():
+                for s in entry.get("samples", []):
+                    lb = s.get("labels") or {}
+                    if lb.get("node") == "master":
+                        s["labels"] = dict(lb, node=f"shard{sid}")
+            by_node[f"shard{sid}"] = snap
+        # inner node labels (shard<k>/worker<i>) win over the outer
+        # key in merge_snapshots, which is exactly what we want here
+        return merge_snapshots(by_node)
 
     def job_status(self, bulk_id: Optional[int] = None) -> dict:
+        """Progress of one bulk.  Sharded: asks the admitting shard
+        first, then the rest — the bulk lives on exactly one shard."""
+        smap = self._resolve_shard_map()
+        if smap is None:
+            return self.master.call("GetJobStatus", bulk_id=bulk_id)
+        order = smap.shard_ids()
+        if self._last_shard in order:
+            order = [self._last_shard] + \
+                [s for s in order if s != self._last_shard]
+        best: Optional[dict] = None
+        for sid in order:
+            st = self._shard_client(sid).try_call("GetJobStatus",
+                                                  bulk_id=bulk_id)
+            if st and "tasks_done" in st:
+                return st
+            if st and best is None:
+                best = st
+        if best is not None:
+            return best
         return self.master.call("GetJobStatus", bulk_id=bulk_id)
 
     def health(self) -> dict:
         """Cluster-wide health roll-up (GetHealth RPC): worst-of status
         across master + every live worker, node-prefixed reason codes,
-        and each node's firing alerts."""
-        return self.master.call("GetHealth", timeout=30.0)
+        and each node's firing alerts.  Sharded: every shard's roll-up
+        folds in (worst-of again, shard<k>-prefixed) — an unreachable
+        shard reports unhealthy rather than silently vanishing."""
+        smap = self._resolve_shard_map()
+        if smap is None:
+            return self.master.call("GetHealth", timeout=30.0)
+        sids = smap.shard_ids()
+        primary = sids[0] if sids else 0
+        nodes: Dict[str, dict] = {}
+        for sid in sids:
+            reply = self._shard_client(sid).try_call(
+                "GetHealth", timeout=30.0, workers=(sid == primary))
+            nodes[f"shard{sid}"] = reply if reply else {
+                "status": "unhealthy",
+                "reasons": ["shard_unreachable"], "firing": []}
+        return _health.merge_status(nodes)
 
     def get_trace(self, bulk_id: Optional[int] = None,
                   raw_clocks: bool = False) -> dict:
@@ -3981,8 +4684,9 @@ class ClusterClient:
         from every node plus the straggler summary (GetTrace RPC).
         Remote spans arrive rebased onto master time per node clock
         offset unless raw_clocks=True."""
-        return self.master.call("GetTrace", bulk_id=bulk_id,
-                                raw_clocks=raw_clocks)
+        # sharded: the trace lives with the bulk, on the admitting shard
+        return self._shard_client(self._last_shard).call(
+            "GetTrace", bulk_id=bulk_id, raw_clocks=raw_clocks)
 
     def memory_report(self) -> dict:
         """Cluster memory forensics (GetMemoryReport RPC): the master's
@@ -4001,20 +4705,36 @@ class ClusterClient:
         — a scanner_trace --verify of the bulk walks every task chain
         to the root without needing this process.  Best-effort."""
         if spans:
-            self.master.try_call("ShipSpans", bulk_id=bulk_id,
-                                 spans=spans)
+            self._shard_client(self._last_shard).try_call(
+                "ShipSpans", bulk_id=bulk_id, spans=spans)
 
     def shutdown_cluster(self, workers: bool = True) -> int:
         """Stop the master — and, by default, every registered worker —
         via the Shutdown RPC (the counterpart of blocking
         start_master/start_worker deployments, whose wait_for_shutdown
-        loops exit on it).  Returns how many workers acknowledged."""
-        reply = self.master.call("Shutdown", workers=workers,
-                                 timeout=30.0)
-        return int(reply.get("workers_notified", 0))
+        loops exit on it).  Returns how many workers acknowledged.
+        Sharded: every shard gets the Shutdown (workers notified once,
+        through the first shard — re-notifying is harmless but slow)."""
+        smap = self._resolve_shard_map()
+        if smap is None:
+            reply = self.master.call("Shutdown", workers=workers,
+                                     timeout=30.0)
+            return int(reply.get("workers_notified", 0))
+        notified = 0
+        notify = workers
+        for sid in smap.shard_ids():
+            reply = self._shard_client(sid).try_call(
+                "Shutdown", workers=notify, timeout=30.0)
+            if reply:
+                notified += int(reply.get("workers_notified", 0))
+                notify = False
+        return notified
 
     def close(self) -> None:
         self._watchdog_stop.set()
+        for c in self._shard_clients.values():
+            c.close()
+        self._shard_clients = {}
         self.master.close()
 
 

@@ -59,54 +59,77 @@ def job_profile_path(job_id: int, node: str) -> str:
     return f"jobs/{job_id}/profile_{node}.trace"
 
 
-def generation_prefix() -> str:
+def shard_prefix(shard: int = 0) -> str:
+    """Control-plane namespace root of one master shard.  Shard 0 is
+    the legacy unprefixed layout byte-for-byte (a pre-sharding db IS a
+    one-shard db); shard k > 0 nests under `jobs/s<k>/` so each
+    shard's generation claims, checkpoints and journals are disjoint —
+    shard failover is single-master recovery scoped to one prefix
+    (engine/shardmap.py)."""
+    return "jobs" if not shard else f"jobs/s{int(shard):02d}"
+
+
+def generation_prefix(shard: int = 0) -> str:
     """Directory of master-generation claim markers (one small blob per
     claimed generation; `write_exclusive` CAS makes each claim atomic —
-    engine/journal.py claim_generation)."""
-    return "jobs/generations"
+    engine/journal.py claim_generation).  Scoped per control-plane
+    shard (see shard_prefix)."""
+    return f"{shard_prefix(shard)}/generations"
 
 
-def generation_path(gen: int) -> str:
-    return f"jobs/generations/{gen:08d}.bin"
+def generation_path(gen: int, shard: int = 0) -> str:
+    return f"{generation_prefix(shard)}/{gen:08d}.bin"
 
 
-def generation_dir(gen: int) -> str:
+def generation_dir(gen: int, shard: int = 0) -> str:
     """Per-generation control-plane state root: checkpoint, progress and
     journal of the master that claimed `gen` live under it, so a fenced
     (superseded) master's late writes can never clobber its successor's
     state — they land in a directory the successor never reads from
     again once recovery migrated the bulk."""
-    return f"jobs/g{gen:08d}"
+    return f"{shard_prefix(shard)}/g{gen:08d}"
 
 
-def bulk_checkpoint_path(gen: Optional[int] = None) -> str:
+def bulk_checkpoint_path(gen: Optional[int] = None,
+                         shard: int = 0) -> str:
     """Active bulk job's admission state (spec blob + task geometry) —
     lets a restarted master resume the job (reference
     recover_and_init_database, master.cpp:1311).  Generation-scoped
     when `gen` is given; the legacy fixed path (pre-fencing masters)
     remains readable for recovery."""
     if gen is None:
-        return "jobs/active_bulk.bin"
-    return f"{generation_dir(gen)}/active_bulk.bin"
+        return f"{shard_prefix(shard)}/active_bulk.bin"
+    return f"{generation_dir(gen, shard)}/active_bulk.bin"
 
 
-def bulk_progress_path(gen: Optional[int] = None) -> str:
+def bulk_progress_path(gen: Optional[int] = None,
+                       shard: int = 0) -> str:
     """Active bulk job's progress (done-set, blacklist, commits), written
     with each periodic checkpoint.  Generation-scoped when `gen` is
     given (see bulk_checkpoint_path)."""
     if gen is None:
-        return "jobs/active_bulk_progress.bin"
-    return f"{generation_dir(gen)}/active_bulk_progress.bin"
+        return f"{shard_prefix(shard)}/active_bulk_progress.bin"
+    return f"{generation_dir(gen, shard)}/active_bulk_progress.bin"
 
 
-def journal_dir(gen: int) -> str:
+def journal_dir(gen: int, shard: int = 0) -> str:
     """Write-ahead bulk-journal segments of one master generation
     (engine/journal.py)."""
-    return f"{generation_dir(gen)}/journal"
+    return f"{generation_dir(gen, shard)}/journal"
 
 
-def journal_segment_path(gen: int, seg: int) -> str:
-    return f"{journal_dir(gen)}/seg_{seg:08d}.bin"
+def journal_segment_path(gen: int, seg: int, shard: int = 0) -> str:
+    return f"{journal_dir(gen, shard)}/seg_{seg:08d}.bin"
+
+
+def shardmap_prefix() -> str:
+    """Versioned shard-map epochs (engine/shardmap.py; one small blob
+    per epoch, CAS-published, highest epoch wins)."""
+    return "jobs/shardmap"
+
+
+def shardmap_path(epoch: int) -> str:
+    return f"{shardmap_prefix()}/e{epoch:08d}.bin"
 
 
 # ---------------------------------------------------------------------------

@@ -514,6 +514,19 @@ NAMED_PLANS = {
     "master-failover":
         "rpc.server.handle:crash:match=FinishedWork:n=4;"
         "rpc.client.call:duplicate:method=NewJob:n=1:times=1",
+    # the sharded-control-plane drill (docs/robustness.md §Sharded
+    # control plane): one of three master shards is SIGKILLed while it
+    # handles a FinishedWork mid-bulk — the fault arms in every shard
+    # process, but only the shard that owns the bulk ever handles
+    # completions, so exactly the bulk-owning shard dies.  chaos_run
+    # respawns that shard (same shard id + port, no plan): the respawn
+    # CAS-claims the next generation IN ITS SHARD'S NAMESPACE, replays
+    # its journal (failover replay > 0, zero re-executed journaled
+    # tasks), re-publishes the shard map at a bumped epoch, and the
+    # bulk completes bit-exact with zero strikes while the SURVIVING
+    # shards' health roll-ups never leave ok/degraded.
+    "master-shard-loss":
+        "rpc.server.handle:crash:match=FinishedWork:n=4",
     # the gang drill (docs/robustness.md §Gang scheduling): the armed
     # worker dies the moment its first gang member enters the
     # cross-host collective (the runner dies with it via pdeathsig) ->

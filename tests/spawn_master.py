@@ -1,7 +1,12 @@
 """Standalone master process for restart-recovery tests (the reference
 master is its own process too; recover_and_init_database master.cpp:1311).
 
-Usage: python spawn_master.py <db_path> <port>
+Usage: python spawn_master.py <db_path> <port> [shard_id num_shards]
+
+The optional shard args spawn one shard of a horizontally sharded
+control plane (docs/robustness.md §Sharded control plane): the process
+claims generations in shard <shard_id>'s namespace and registers its
+address in the durable shard map.
 """
 
 import sys
@@ -11,4 +16,9 @@ from scanner_tpu.engine.service import start_master
 if __name__ == "__main__":
     db_path = sys.argv[1]
     port = int(sys.argv[2])
-    start_master(db_path, port=port, no_workers_timeout=60.0, block=True)
+    kw = {}
+    if len(sys.argv) > 4:
+        kw["shard_id"] = int(sys.argv[3])
+        kw["num_shards"] = int(sys.argv[4])
+    start_master(db_path, port=port, no_workers_timeout=60.0, block=True,
+                 **kw)

@@ -1,0 +1,508 @@
+"""Horizontally sharded control plane: consistent-hash ring, durable
+versioned shard map, map-epoch fencing, worker multiplexing
+(docs/robustness.md §Sharded control plane; engine/shardmap.py).
+
+Layers:
+  * ring units — stable (non-salted) hashing, balance across shards,
+    and the load-movement property: removing a dead shard's points
+    moves ONLY the keys that shard owned;
+  * durable-map units — CAS merge-retry registration (concurrent
+    registrants all survive), epoch pruning, MapHolder adoption;
+  * in-process master units — the map-epoch fence NACKing mutations
+    routed with a stale map (and passing current/legacy ones);
+  * in-process multiplexing — one worker linked to three shard
+    masters drains bulks admitted on DIFFERENT shards;
+  * the spawned 3-shard failover e2e (slow) — SIGKILL the bulk-owning
+    shard mid-load, respawn it, zero journaled re-execution, bit-exact
+    output, surviving shards untouched.
+"""
+
+import os
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import cloudpickle
+import pytest
+
+from scanner_tpu import (CacheMode, Client, Kernel, NamedStream,
+                         PerfParams, register_op)
+from scanner_tpu.engine import shardmap
+from scanner_tpu.engine.service import (MASTER_SERVICE, ClusterClient,
+                                        Master, Worker)
+from scanner_tpu.storage.backend import MemoryStorage, PosixStorage
+from scanner_tpu.util import faults
+from scanner_tpu.util import metrics as _mx
+
+# test kernels travel to worker subprocesses inside the job spec
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+pytestmark = pytest.mark.chaos
+
+N_ROWS = 24
+
+
+def _pk(v: int) -> bytes:
+    return struct.pack("<q", v)
+
+
+@register_op(name="ShardDouble")
+class ShardDouble(Kernel):
+    def execute(self, x: bytes) -> bytes:
+        return _pk(2 * struct.unpack("<q", x)[0])
+
+
+EXPECT = [_pk(2 * (100 + i)) for i in range(N_ROWS)]
+
+
+def _counter(name: str, **labels) -> float:
+    entry = _mx.registry().snapshot().get(name, {})
+    for s in entry.get("samples", []):
+        if s["labels"] == labels:
+            return s["value"]
+    return 0.0
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture
+def _three_shards(monkeypatch):
+    """Arm the process-global shard count the Worker/Client side keys
+    multiplexing off.  The env var is set too so a Client constructed
+    inside the test does not clobber it back to the config default."""
+    monkeypatch.setenv("SCANNER_TPU_CONTROL_SHARDS", "3")
+    shardmap.set_num_shards(3)
+    yield
+    shardmap.set_num_shards(1)
+
+
+# ---------------------------------------------------------------------------
+# ring units
+# ---------------------------------------------------------------------------
+
+def test_stable_hash_is_process_stable():
+    """The ring digest must agree across processes: md5-derived, never
+    Python's per-process-salted hash()."""
+    import hashlib
+    for key in ("job-token-1", "s01/bulk/7", ""):
+        want = int.from_bytes(
+            hashlib.md5(key.encode()).digest()[:8], "big")
+        assert shardmap.stable_hash(key) == want
+    # and deterministic across calls, obviously
+    assert shardmap.stable_hash("x") == shardmap.stable_hash("x")
+
+
+def test_ring_balance_within_tolerance():
+    smap = shardmap.ShardMap(epoch=1, shards={0: "a", 1: "b", 2: "c"})
+    counts = {0: 0, 1: 0, 2: 0}
+    n = 3000
+    for i in range(n):
+        counts[smap.shard_for(f"token-{i}")] += 1
+    # VNODES=64 points/shard: every shard within [15%, 55%] of keys —
+    # loose enough to never flake, tight enough to catch a broken ring
+    for sid, c in counts.items():
+        assert 0.15 * n < c < 0.55 * n, (sid, counts)
+
+
+def test_shard_death_moves_only_dead_shards_keys():
+    """THE consistent-hash property the failover design leans on:
+    dropping shard 1's ring points re-routes shard 1's keys and
+    nobody else's — surviving shards keep every bulk they own."""
+    full = shardmap.ShardMap(epoch=1,
+                             shards={0: "a", 1: "b", 2: "c"},
+                             num_shards=3)
+    survivor = shardmap.ShardMap(epoch=2,
+                                 shards={0: "a", 2: "c"},
+                                 num_shards=3)
+    moved = kept = orphaned = 0
+    for i in range(2000):
+        key = f"token-{i}"
+        before, after = full.shard_for(key), survivor.shard_for(key)
+        if before == 1:
+            orphaned += 1
+            assert after in (0, 2)
+        else:
+            kept += 1
+            assert after == before, \
+                f"{key} moved {before}->{after} though shard " \
+                f"{before} survived"
+        moved += before != after
+    assert orphaned > 0 and kept > 0
+    assert moved == orphaned  # exactly the dead shard's keys moved
+
+
+def test_shard_map_roundtrip_and_empty_routing():
+    smap = shardmap.ShardMap(epoch=7, shards={0: "h0:1", 2: "h2:3"},
+                             num_shards=3)
+    back = shardmap.ShardMap.from_dict(smap.to_dict())
+    assert back.epoch == 7 and back.num_shards == 3
+    assert back.shards == {0: "h0:1", 2: "h2:3"}
+    assert back.shard_ids() == [0, 2]
+    assert back.address_of(2) == "h2:3"
+    assert back.address_of(1) is None
+    # an empty map (unsharded db) routes everything to the legacy
+    # master, shard 0
+    assert shardmap.ShardMap().shard_for("anything") == 0
+
+
+# ---------------------------------------------------------------------------
+# durable-map units
+# ---------------------------------------------------------------------------
+
+def test_register_shard_merges_and_bumps_epoch():
+    s = MemoryStorage()
+    assert shardmap.load(s) is None
+    m1 = shardmap.register_shard(s, 0, "h0:1", num_shards=3)
+    m2 = shardmap.register_shard(s, 1, "h1:1", num_shards=3)
+    m3 = shardmap.register_shard(s, 2, "h2:1", num_shards=3)
+    assert (m1.epoch, m2.epoch, m3.epoch) == (1, 2, 3)
+    cur = shardmap.load(s)
+    assert cur.epoch == 3
+    assert cur.shards == {0: "h0:1", 1: "h1:1", 2: "h2:1"}
+    # a respawned shard re-registering a NEW address is an epoch bump
+    # that keeps every peer's entry (the failover re-publish)
+    m4 = shardmap.register_shard(s, 1, "h1:9", num_shards=3)
+    assert m4.epoch == 4
+    assert shardmap.load(s).shards == \
+        {0: "h0:1", 1: "h1:9", 2: "h2:1"}
+
+
+def test_register_shard_concurrent_racers_all_survive():
+    """The CAS merge-retry loop: N shards registering at once all end
+    up in the final map (losers re-load and re-merge)."""
+    s = MemoryStorage()
+    barrier = threading.Barrier(4)
+
+    def racer(sid):
+        barrier.wait()
+        shardmap.register_shard(s, sid, f"h{sid}:1", num_shards=4)
+
+    threads = [threading.Thread(target=racer, args=(sid,))
+               for sid in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    cur = shardmap.load(s)
+    assert cur.shards == {sid: f"h{sid}:1" for sid in range(4)}
+    assert cur.epoch >= 4  # every registration took its own epoch
+
+
+def test_old_epochs_pruned():
+    from scanner_tpu.storage import metadata as smd
+    s = MemoryStorage()
+    for _ in range(shardmap.KEEP_EPOCHS + 4):
+        shardmap.register_shard(s, 0, "h0:1", num_shards=1)
+    left = s.list_prefix(smd.shardmap_prefix())
+    assert len(left) <= shardmap.KEEP_EPOCHS
+    # the newest epoch is among the survivors
+    assert any(f"e{shardmap.KEEP_EPOCHS + 4:08d}" in p for p in left)
+
+
+def test_map_holder_adopts_strictly_newer():
+    h = shardmap.MapHolder()
+    assert h.get() is None and h.epoch() == 0
+    assert h.observe(shardmap.ShardMap(epoch=3, shards={0: "a"}))
+    assert h.epoch() == 3
+    assert not h.observe(shardmap.ShardMap(epoch=3, shards={0: "b"}))
+    assert not h.observe(shardmap.ShardMap(epoch=2, shards={0: "b"}))
+    assert h.get().shards == {0: "a"}  # stale observe did not regress
+    assert not h.observe(None)
+    assert h.observe(shardmap.ShardMap(epoch=4, shards={0: "b"}))
+    assert h.get().shards == {0: "b"}
+
+
+# ---------------------------------------------------------------------------
+# in-process master units: the map-epoch fence
+# ---------------------------------------------------------------------------
+
+def _seed_db(tmp_path, table="sh_src"):
+    db_path = str(tmp_path / "db")
+    sc = Client(db_path=db_path)
+    sc.new_table(table, ["output"],
+                 [[_pk(100 + i)] for i in range(N_ROWS)])
+    return sc, db_path
+
+
+def _spec_blob(sc, out_name, src="sh_src", **perf_kw):
+    col = sc.io.Input([NamedStream(sc, src)])
+    col = sc.ops.ShardDouble(x=col)
+    out = NamedStream(sc, out_name)
+    node = sc.io.Output(col, [out])
+    return cloudpickle.dumps({
+        "outputs": [node],
+        "perf": PerfParams.manual(2, 2, **perf_kw),
+        "cache_mode": CacheMode.Overwrite.value})
+
+
+def test_map_epoch_fence_nacks_stale_map(tmp_path, _three_shards):
+    """A mutation stamped with an older map epoch than the serving
+    master's is NACKed with stale_map (the caller must refresh and
+    re-route); the current epoch and unstamped legacy requests pass."""
+    sc, db_path = _seed_db(tmp_path)
+    m = Master(db_path=db_path, no_workers_timeout=60.0,
+               shard_id=0, num_shards=3)
+    try:
+        # a peer shard failed over: its re-publish bumped the epoch
+        # and this master adopted the newer map
+        m._adopt_shard_map(shardmap.ShardMap(
+            epoch=m._map_epoch + 5,
+            shards={0: f"localhost:{m.port}", 1: "h1:1", 2: "h2:1"},
+            num_shards=3))
+        newer = m._map_epoch
+        base = _counter("scanner_tpu_shard_stale_map_rejections_total")
+        wrapped = m._fenced(m._rpc_new_job)
+        spec = _spec_blob(sc, "sh_fence_out")
+
+        stale = wrapped({"spec": spec, "token": "tok-stale",
+                         "map_epoch": newer - 1})
+        assert stale.get("stale_map") and "error" in stale
+        assert stale["map_epoch"] == newer  # the fence tells the
+        assert "bulk_id" not in stale       # caller what to catch up to
+        assert _counter(
+            "scanner_tpu_shard_stale_map_rejections_total") == base + 1
+
+        # the CURRENT epoch passes, and live replies are stamped with
+        # the epoch so callers can latch it
+        ok = wrapped({"spec": spec, "token": "tok-live",
+                      "map_epoch": newer})
+        assert "bulk_id" in ok and not ok.get("stale_map")
+        assert ok["map_epoch"] == newer
+        # an unstamped request (legacy / single-shard caller) passes
+        dup = wrapped({"spec": spec, "token": "tok-live"})
+        assert dup == {"bulk_id": ok["bulk_id"], "dedup": True,
+                       "generation": m.generation, "map_epoch": newer}
+    finally:
+        m.stop()
+        sc.stop()
+
+
+def test_get_shard_map_served_and_refreshed(tmp_path, _three_shards):
+    """Every shard serves the full versioned map; a peer's later
+    registration is visible through any one shard (the startup-race
+    inline refresh)."""
+    sc, db_path = _seed_db(tmp_path)
+    m0 = Master(db_path=db_path, no_workers_timeout=60.0,
+                shard_id=0, num_shards=3)
+    try:
+        r = m0._rpc_get_shard_map({})
+        assert r["shard_id"] == 0 and r["num_shards"] == 3
+        assert "0" in r["shards"]
+        # peers register AFTER shard 0 adopted its own publish
+        backend = PosixStorage(db_path)
+        shardmap.register_shard(backend, 1, "h1:1", num_shards=3)
+        shardmap.register_shard(backend, 2, "h2:1", num_shards=3)
+        r2 = m0._rpc_get_shard_map({})
+        assert set(r2["shards"]) == {"0", "1", "2"}
+        assert r2["epoch"] > r["epoch"]
+    finally:
+        m0.stop()
+        sc.stop()
+
+
+# ---------------------------------------------------------------------------
+# in-process multiplexing: one worker, three shard masters
+# ---------------------------------------------------------------------------
+
+def test_worker_multiplexes_and_drains_all_owning_shards(
+        tmp_path, _three_shards):
+    """One worker linked to three shard masters drains bulks admitted
+    on two DIFFERENT shards: heartbeats reach every shard (slim on
+    non-active ones), the pull plumbing rebinds to whichever shard has
+    work, and both outputs commit bit-exact."""
+    sc, db_path = _seed_db(tmp_path)
+    masters = [Master(db_path=db_path, no_workers_timeout=120.0,
+                      shard_id=k, num_shards=3) for k in range(3)]
+    worker = None
+    try:
+        worker = Worker(f"localhost:{masters[0].port}", db_path=db_path)
+        deadline = time.time() + 30
+        while time.time() < deadline and len(worker._links) < 3:
+            time.sleep(0.1)
+        assert sorted(worker._links) == [0, 1, 2], \
+            "worker never linked every shard"
+
+        # admit one bulk on shard 1, then (after it drains) one on
+        # shard 2 — bypassing the client's hash routing so the shard
+        # choice is deterministic.  Sequential admission: table-id
+        # allocation is single-writer, the multiplexing under test is
+        # the worker REBINDING its pull plumbing between owning shards.
+        done = {}
+        for sid, out_name, token in ((1, "sh_mux_out1", "mux-1"),
+                                     (2, "sh_mux_out2", "mux-2")):
+            r = masters[sid]._rpc_new_job(
+                {"spec": _spec_blob(sc, out_name), "token": token})
+            assert "bulk_id" in r, r
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                st = masters[sid]._rpc_job_status(
+                    {"bulk_id": r["bulk_id"]})
+                if st.get("finished"):
+                    done[sid] = True
+                    break
+                time.sleep(0.25)
+        assert done == {1: True, 2: True}, f"bulks not drained: {done}"
+        # the worker's active link followed the work to shard 2
+        assert worker._active_shard == 2
+        # a fresh client: the seed client's cached metadata predates
+        # the master-side output-table creation
+        sc2 = Client(db_path=db_path)
+        try:
+            assert [bytes(r) for r in
+                    NamedStream(sc2, "sh_mux_out1").load()] == EXPECT
+            assert [bytes(r) for r in
+                    NamedStream(sc2, "sh_mux_out2").load()] == EXPECT
+        finally:
+            sc2.stop()
+        # the worker registered with (and beat) every shard it pulled
+        # from — non-active shards got slim beats, which is the
+        # coalescing the Heartbeat counter tracks
+        for sid in (1, 2):
+            with masters[sid]._lock:
+                assert masters[sid]._workers, \
+                    f"shard {sid} never saw the worker"
+    finally:
+        if worker is not None:
+            worker.stop()
+        for m in masters:
+            m.stop()
+        sc.stop()
+
+
+# ---------------------------------------------------------------------------
+# the spawned 3-shard failover e2e (slow)
+# ---------------------------------------------------------------------------
+
+def _spawn_env(extra=None):
+    from scanner_tpu.util.jaxenv import cpu_only_env
+    env = cpu_only_env()
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep \
+        + env.get("PYTHONPATH", "")
+    env.pop("SCANNER_TPU_FAULTS", None)
+    env.pop("SCANNER_TPU_MASTER_GENERATION", None)
+    env["SCANNER_TPU_CONTROL_SHARDS"] = "3"
+    env.update(extra or {})
+    return env
+
+
+@pytest.mark.slow
+def test_three_shard_failover_spawned(tmp_path, _three_shards):
+    """The sharded headline, in miniature: three spawned shard
+    masters, one in-process worker, a bulk under load with
+    checkpoint_frequency=0, and the bulk-owning shard SIGKILL-crashed
+    mid-FinishedWork (only the owner handles FinishedWork, so exactly
+    it dies).  Its respawn CAS-claims the next generation in the SHARD
+    namespace, replays the journal, and finishes the bulk: bit-exact
+    output, failover counted, zero journaled re-execution, zero
+    strikes, surviving shards never restarted."""
+    import socket
+
+    db_path = str(tmp_path / "db")
+    seed = Client(db_path=db_path)
+    seed.new_table("sh_src", ["output"],
+                   [[_pk(100 + i)] for i in range(N_ROWS)])
+    seed.stop()
+
+    ports = []
+    for _ in range(3):
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            ports.append(s.getsockname()[1])
+    spawn = os.path.join(os.path.dirname(__file__), "spawn_master.py")
+
+    def spawn_shard(sid, extra=None):
+        return subprocess.Popen(
+            [sys.executable, spawn, db_path, str(ports[sid]),
+             str(sid), "3"],
+            env=_spawn_env(extra),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    # the crash plan arms in every shard process, but only the shard
+    # that owns the bulk ever handles FinishedWork — exactly it dies
+    fault = {"SCANNER_TPU_FAULTS":
+             "rpc.server.handle:crash:match=FinishedWork:n=4"}
+    procs = {sid: spawn_shard(sid, extra=fault) for sid in range(3)}
+    state = {}
+    stop = threading.Event()
+
+    def watcher():
+        while not stop.is_set():
+            for sid, p in list(procs.items()):
+                rc = p.poll()
+                if rc is not None and sid not in state:
+                    state[sid] = rc
+                    if rc == faults.CRASH_EXIT_CODE:
+                        time.sleep(0.5)
+                        procs[sid] = spawn_shard(sid)  # no fault plan
+            time.sleep(0.1)
+
+    wt = threading.Thread(target=watcher, daemon=True)
+    wt.start()
+
+    from scanner_tpu.engine.rpc import wait_for_server
+    for sid in range(3):
+        wait_for_server(f"localhost:{ports[sid]}", MASTER_SERVICE,
+                        timeout=60.0)
+    addr0 = f"localhost:{ports[0]}"
+
+    sc = None
+    worker = None
+    try:
+        sc = Client(db_path=db_path, master=addr0)
+        worker = Worker(addr0, db_path=db_path)
+        col = sc.io.Input([NamedStream(sc, "sh_src")])
+        col = sc.ops.ShardDouble(x=col)
+        out = NamedStream(sc, "sh_failover_out")
+        sc.run(sc.io.Output(col, [out]),
+               PerfParams.manual(2, 2, checkpoint_frequency=0),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+
+        assert [bytes(r) for r in out.load()] == EXPECT
+        assert out.committed()
+        crashed = [sid for sid, rc in state.items()
+                   if rc == faults.CRASH_EXIT_CODE]
+        assert len(crashed) == 1, \
+            f"expected exactly one shard crash, got {state}"
+
+        # cluster-wide evidence via the shard fan-in: the respawn
+        # replayed the journal, counted a failover, re-executed zero
+        # journaled tasks, struck nobody
+        cc = ClusterClient(addr0, None)
+        try:
+            snap = cc.metrics()
+
+            def _tot(name):
+                return sum(s.get("value", 0) for s in
+                           snap.get(name, {}).get("samples", []))
+
+            assert _tot("scanner_tpu_journal_replayed_records_total") \
+                > 0
+            assert _tot("scanner_tpu_shard_failovers_total") >= 1
+            assert _tot("scanner_tpu_shard_journal_reexec_total") == 0
+            assert _tot("scanner_tpu_blacklist_strikes_total") == 0
+            # worst-of health fold across every shard: no survivor
+            # rolled up unhealthy
+            assert cc.health()["status"] != "unhealthy"
+        finally:
+            cc.close()
+        # the two surviving shards were never restarted
+        assert all(rc == faults.CRASH_EXIT_CODE
+                   for rc in state.values()), state
+    finally:
+        stop.set()
+        wt.join(timeout=5)
+        if worker is not None:
+            worker.stop()
+        if sc is not None:
+            sc.stop()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait()

@@ -1328,6 +1328,210 @@ def test_gang_shard_gate_without_plane_flagged(tmp_path):
                for m in msgs)
 
 
+_SHARDMAP_FIX_SERIES = ("scanner_tpu_shard_map_epoch",
+                        "scanner_tpu_shard_failovers_total")
+
+
+def _shardmap_repo(tmp_path,
+                   declared=_SHARDMAP_FIX_SERIES,
+                   registered=None,
+                   doc_series=None,
+                   schema_keys=("shards",),
+                   cfg_keys=("shards",),
+                   routed=("Mut",),
+                   wrap_mut=True,
+                   with_markers=True,
+                   with_tuple=True):
+    """Synthetic mini-repo for the SC316 sharded control-plane
+    lints: a shardmap module with its series catalog + [control]
+    schema, and a master service whose SHARD_ROUTED_RPCS tuple must
+    agree with the idempotent=False, fence-wrapped surface."""
+    if registered is None:
+        registered = declared
+    if doc_series is None:
+        doc_series = declared
+    _write(tmp_path, "setup.py", "# root marker\n")
+    regs = "\n        ".join(
+        f'_S{i} = _mx.registry().counter("{n}", "help text", '
+        f'labels=["role"])' for i, n in enumerate(registered))
+    decl = (f"SHARD_SERIES = ("
+            + ", ".join(f'"{n}"' for n in declared) + ",)"
+            if with_tuple else "")
+    schema = ", ".join(f'"{k}"' for k in schema_keys)
+    _write(tmp_path, "pkg/engine/shardmap.py", f"""
+        from ..util import metrics as _mx
+
+        {regs}
+
+        {decl}
+
+        CONFIG_KEYS = ({schema},)
+    """)
+    _write(tmp_path, "pkg/util/metrics.py", """
+        def registry():
+            return None
+    """)
+    mut = "self._fenced(self._rpc_mut)" if wrap_mut \
+        else "self._rpc_mut"
+    routed_decl = "SHARD_ROUTED_RPCS = (" \
+        + "".join(f'"{r}", ' for r in routed) + ")"
+    _write(tmp_path, "pkg/engine/service.py", f"""
+        MASTER_SERVICE = "svc.Master"
+
+        RPC_CONTRACTS = {{
+            "Mut": {{"timeout_s": 1.0, "idempotent": False}},
+            "Read": {{"timeout_s": 1.0, "idempotent": True}},
+        }}
+
+        {routed_decl}
+
+        class RpcServer:
+            def __init__(self, name, methods, port=0):
+                pass
+
+        class Master:
+            def __init__(self):
+                self._server = RpcServer(MASTER_SERVICE, {{
+                    "Mut": {mut},
+                    "Read": self._rpc_read,
+                }})
+
+            def _fenced(self, fn):
+                return fn
+
+            def _rpc_mut(self, req):
+                return {{}}
+
+            def _rpc_read(self, req):
+                return {{}}
+
+        def client(c):
+            c.call("Mut")
+            c.call("Read")
+    """)
+    cfg = ", ".join(f'"{k}": 1' for k in cfg_keys)
+    _write(tmp_path, "pkg/config.py", f"""
+        def default_config():
+            return {{"control": {{{cfg}}}}}
+    """)
+    rows = "\n".join(f"| `{n}` | counter | `role` | x |"
+                     for n in doc_series)
+    stable = (f"<!-- shard-series:begin -->\n"
+              f"| Series | Type | Labels | Meaning |\n|---|---|---|"
+              f"---|\n{rows}\n<!-- shard-series:end -->\n"
+              if with_markers else rows)
+    all_series = sorted(set(declared) | set(registered)
+                        | set(doc_series))
+    _write(tmp_path, "docs/observability.md", f"""
+        Catalog (every fixture series mentioned so SC301 stays
+        quiet): {" ".join(f"`{n}`" for n in all_series)}
+
+        {stable}
+    """)
+    ckeys = " ".join(f"`{k}`" for k in sorted(set(schema_keys)
+                                              | set(cfg_keys)))
+    _write(tmp_path, "docs/guide.md", f"""
+        Keys mentioned so SC304 stays quiet: {ckeys}
+    """)
+    return tmp_path
+
+
+def test_shardmap_clean_fixture_is_quiet(tmp_path):
+    _shardmap_repo(tmp_path)
+    _, findings = _analyze(tmp_path, "pkg")
+    assert [f for f in findings if f.code == "SC316"] == []
+
+
+def test_shardmap_series_all_pairings_both_directions(tmp_path):
+    _shardmap_repo(
+        tmp_path,
+        declared=("scanner_tpu_shard_map_epoch",
+                  "scanner_tpu_shard_phantom_total"),
+        registered=("scanner_tpu_shard_map_epoch",
+                    "scanner_tpu_shard_unlisted_total"),
+        doc_series=("scanner_tpu_shard_map_epoch",
+                    "scanner_tpu_shard_ghost_total"))
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC316"]
+    assert any("scanner_tpu_shard_unlisted_total" in m
+               and "missing from SHARD_SERIES" in m for m in msgs)
+    assert any("scanner_tpu_shard_phantom_total" in m
+               and "registers no such series" in m for m in msgs)
+    assert any("scanner_tpu_shard_phantom_total" in m
+               and "missing from the" in m for m in msgs)
+    assert any("scanner_tpu_shard_ghost_total" in m
+               and "no such series" in m for m in msgs)
+    assert not any("`scanner_tpu_shard_map_epoch`" in m
+                   for m in msgs)
+
+
+def test_shardmap_missing_marker_table(tmp_path):
+    _shardmap_repo(tmp_path, with_markers=False)
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC316"]
+    assert any("shard-series" in m and "marker table" in m
+               for m in msgs)
+
+
+def test_shardmap_missing_tuple_flagged(tmp_path):
+    _shardmap_repo(tmp_path, with_tuple=False)
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC316"]
+    assert any("declares no SHARD_SERIES tuple" in m for m in msgs)
+
+
+def test_shardmap_control_config_keys_both_directions(tmp_path):
+    _shardmap_repo(tmp_path,
+                   schema_keys=("shards", "schema_only"),
+                   cfg_keys=("shards", "cfg_only"))
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC316"]
+    assert any("[control] cfg_only" in m and "does not accept" in m
+               for m in msgs)
+    assert any("`schema_only`" in m and "declares no" in m
+               for m in msgs)
+    assert not any("`shards`" in m for m in msgs)
+
+
+def test_shardmap_routed_rpc_must_be_mutating(tmp_path):
+    """Routing an idempotent read through bulk-ownership dispatch is
+    flagged — only mutating RPCs follow the bulk to its shard."""
+    _shardmap_repo(tmp_path, routed=("Mut", "Read"))
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC316"]
+    assert any("`Read`" in m and "idempotent=False" in m
+               for m in msgs)
+    assert not any("`Mut`" in m for m in msgs)
+
+
+def test_shardmap_mutating_rpc_must_be_routed(tmp_path):
+    """An idempotent=False contract missing from SHARD_ROUTED_RPCS
+    would pin mutations to the dial-time shard."""
+    _shardmap_repo(tmp_path, routed=())
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC316"]
+    assert any("`Mut`" in m and "missing from SHARD_ROUTED_RPCS" in m
+               for m in msgs)
+    assert not any("`Read`" in m for m in msgs)
+
+
+def test_shardmap_routed_rpc_must_stay_fenced(tmp_path):
+    """A shard-routed handler outside the generation fence reopens
+    the stale-master window (the SC312 extension leg)."""
+    _shardmap_repo(tmp_path, wrap_mut=False)
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC316"]
+    assert any("`Mut`" in m and "without the generation-fence" in m
+               for m in msgs)
+
+
+def test_shardmap_routed_phantom_method_flagged(tmp_path):
+    _shardmap_repo(tmp_path, routed=("Mut", "Ghost"))
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC316"]
+    assert any("`Ghost`" in m and "no such entry" in m for m in msgs)
+
+
 def test_contract_rpc_contracts_table_both_directions(tmp_path):
     _write(tmp_path, "setup.py", "# root\n")
     _write(tmp_path, "pkg/rpcmod.py", """

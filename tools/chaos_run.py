@@ -148,15 +148,36 @@ def main() -> int:
                                  *argv], env=e)
 
     import socket
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        port = s.getsockname()[1]
+
+    def _free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            return s.getsockname()[1]
+
+    # the sharded-control-plane drill: three master shards instead of
+    # one (docs/robustness.md §Sharded control plane).  The plan arms
+    # in EVERY shard, but only the shard owning the bulk handles
+    # FinishedWork — so exactly that shard dies, and the respawn (no
+    # plan) fails the partition over in its shard namespace.
+    shard_loss = args.plan == "master-shard-loss"
+    num_shards = 3 if shard_loss else 1
+    if shard_loss:
+        env["SCANNER_TPU_CONTROL_SHARDS"] = str(num_shards)
+    shard_ports = [_free_port() for _ in range(num_shards)]
+    port = shard_ports[0]
     addr = f"localhost:{port}"
 
     procs = []
-    master = spawn("spawn_master.py", [db_path, str(port)],
-                   plan=spec if master_side else None)
-    procs.append(master)
+    shard_masters = {}
+    for sid, p in enumerate(shard_ports):
+        argv = [db_path, str(p)]
+        if shard_loss:
+            argv += [str(sid), str(num_shards)]
+        m = spawn("spawn_master.py", argv,
+                  plan=spec if master_side else None)
+        shard_masters[sid] = m
+        procs.append(m)
+    master = shard_masters[0]
     for i in range(args.workers):
         # the FIRST worker carries a worker-side plan; siblings stay
         # healthy so reassigned work has somewhere to go
@@ -164,7 +185,28 @@ def main() -> int:
                            plan=spec if worker_side and i == 0 else None))
 
     respawned = {}
-    if master_side:
+    if master_side and shard_loss:
+        # per-shard crash watch: whichever shard the fault kills is
+        # respawned under the SAME shard id + port, with no plan —
+        # the respawn CAS-claims its shard's next generation and
+        # replays its journal (shard failover)
+        def watch_shard(sid: int):
+            rc_ = shard_masters[sid].wait()
+            if rc_ != faults.CRASH_EXIT_CODE:
+                return
+            respawned["rc"] = rc_
+            respawned["shard"] = sid
+            print(f"shard {sid} died (exit {rc_}); respawning")
+            time.sleep(0.5)
+            m2 = spawn("spawn_master.py",
+                       [db_path, str(shard_ports[sid]), str(sid),
+                        str(num_shards)])
+            shard_masters[sid] = m2
+            procs.append(m2)
+        for sid in shard_masters:
+            threading.Thread(target=watch_shard, args=(sid,),
+                             daemon=True).start()
+    elif master_side:
         def respawn_master():
             respawned["rc"] = master.wait()
             print(f"master died (exit {respawned['rc']}); respawning")
@@ -177,6 +219,10 @@ def main() -> int:
     from scanner_tpu.engine.rpc import wait_for_server
     from scanner_tpu.engine.service import MASTER_SERVICE
     wait_for_server(addr, MASTER_SERVICE, timeout=60.0)
+    for p in shard_ports[1:]:
+        # every shard must serve before the client resolves the map,
+        # or the drill's routing would collapse onto the seed shard
+        wait_for_server(f"localhost:{p}", MASTER_SERVICE, timeout=60.0)
     sc = Client(db_path=db_path, master=addr)
     # wait for every worker to register (subprocess import time
     # dominates); a worker-side plan can only fire on a joined worker
@@ -228,12 +274,33 @@ def main() -> int:
             faults.install(spec)
         print("== faulted run ==")
         got = run("chaos_faulted", task_timeout=args.task_timeout,
-                  checkpoint_frequency=0 if failover else 1)
+                  checkpoint_frequency=0 if (failover or shard_loss)
+                  else 1)
         # read the rule counters BEFORE clear() empties the registry —
         # client-side fires exist nowhere else (sc.metrics() aggregates
         # master+workers, not this process)
         local_fired = faults.fired()
         faults.clear()
+        if shard_loss:
+            # the plan is still ARMED in every surviving shard (each
+            # process carries its own fire budget), so a clean bulk
+            # that happened to hash onto an armed shard would crash it
+            # too: replace the survivors with unarmed processes first.
+            # (The victim's respawn is already unarmed.)
+            time.sleep(1.0)  # let the crash watcher finish its respawn
+            for sid, m_ in list(shard_masters.items()):
+                if sid == respawned.get("shard"):
+                    continue
+                m_.kill()
+                m_.wait()
+                m2 = spawn("spawn_master.py",
+                           [db_path, str(shard_ports[sid]), str(sid),
+                            str(num_shards)])
+                shard_masters[sid] = m2
+                procs.append(m2)
+            for p_ in shard_ports:
+                wait_for_server(f"localhost:{p_}", MASTER_SERVICE,
+                                timeout=60.0)
         print("== clean run ==")
         golden = run("chaos_clean", task_timeout=args.task_timeout)
 
@@ -296,6 +363,42 @@ def main() -> int:
             extra_ok = bool(aborted >= 1 and reforms >= 1
                             and epoch >= 2 and strikes == 0
                             and fold_bad == 0)
+        if shard_loss:
+            # shard-loss evidence (ISSUE acceptance): the killed
+            # shard's respawn replayed its journal (failover replay >
+            # 0) with ZERO journaled completions re-queued, no worker
+            # ate a blacklist strike, and no shard's health roll-up is
+            # left unhealthy (the survivors never were; the victim's
+            # respawn recovered)
+            def _tot(name):
+                return sum(s.get("value", 0) for s in
+                           snap.get(name, {}).get("samples", []))
+
+            replayed = _tot("scanner_tpu_journal_replayed_records_total")
+            failovers = _tot("scanner_tpu_shard_failovers_total")
+            reexec = _tot("scanner_tpu_shard_journal_reexec_total")
+            strikes = _tot("scanner_tpu_blacklist_strikes_total")
+            from scanner_tpu.engine.rpc import RpcClient
+            statuses = {}
+            for sid, p_ in enumerate(shard_ports):
+                probe = RpcClient(f"localhost:{p_}", MASTER_SERVICE,
+                                  timeout=10.0)
+                try:
+                    h = probe.try_call("GetHealth", workers=False,
+                                       timeout=10.0)
+                finally:
+                    probe.close()
+                statuses[sid] = (h or {}).get("status")
+            print(f"shard-loss: killed-shard={respawned.get('shard')} "
+                  f"journal-replayed={int(replayed)} "
+                  f"failovers={int(failovers)} reexec={int(reexec)} "
+                  f"strikes={int(strikes)} shard-health={statuses}")
+            extra_ok = bool(
+                replayed > 0 and failovers >= 1 and reexec == 0
+                and strikes == 0
+                and respawned.get("rc") == faults.CRASH_EXIT_CODE
+                and all(st is not None and st != "unhealthy"
+                        for st in statuses.values()))
         if failover:
             # failover-specific evidence: the successor replayed the
             # journal, zero blacklist strikes anywhere, and a

@@ -56,6 +56,76 @@ def _nodes(snap: dict):
     return sorted(seen)
 
 
+# -- sharded control plane fan-in -------------------------------------------
+
+def _resolve_shards(client):
+    """{shard_id: address} from GetShardMap on the dialed master, or
+    None for a single-master cluster (docs/robustness.md §Sharded
+    control plane).  Every shard serves the full versioned map, so the
+    --master address may name any live shard."""
+    reply = client.try_call("GetShardMap", retries=1)
+    if not reply or int(reply.get("num_shards", 1) or 1) <= 1:
+        return None
+    shards = {int(k): v for k, v in (reply.get("shards") or {}).items()}
+    return shards or None
+
+
+def _poll_sharded(shard_clients: dict):
+    """Fan GetMetrics/GetJobStatus/GetHealth across every shard.
+
+    Mirrors ClusterClient's fan-in: each shard's master samples relabel
+    to shard<k> before merging (per-shard control-plane series stay
+    distinguishable in the NODE table), workers ride the lowest live
+    shard only (every shard sees the same fleet — M pulls would skew
+    the merged counters M-fold), and health folds worst-of via
+    health.merge_status so one degraded shard degrades the roll-up.
+
+    Returns (merged_snapshot | None, status, health, shard_rows) —
+    snapshot None when no shard answered at all.
+    """
+    from scanner_tpu.util.health import merge_status
+    from scanner_tpu.util.metrics import merge_snapshots
+
+    sids = sorted(shard_clients)
+    primary = sids[0]
+    by_node, rows, status, healths = {}, [], None, {}
+    for sid in sids:
+        c = shard_clients[sid]
+        node = f"shard{sid}"
+        reply = c.try_call("GetMetrics", retries=1, timeout=30.0,
+                           workers=(sid == primary))
+        row = {"shard": sid, "addr": c.address, "up": reply is not None}
+        if reply and "snapshot" in reply:
+            snap = reply["snapshot"]
+            for entry in snap.values():
+                for s in entry.get("samples", []):
+                    lb = s.get("labels") or {}
+                    if lb.get("node") == "master":
+                        s["labels"] = dict(lb, node=node)
+            by_node[node] = snap
+            row["map_epoch"] = _gauge(
+                snap, "scanner_tpu_shard_map_epoch", node)
+            row["failovers"] = _sum_counter(
+                snap, "scanner_tpu_shard_failovers_total", node)
+            row["stale_map_rejections"] = _sum_counter(
+                snap, "scanner_tpu_shard_stale_map_rejections_total", node)
+            row["rpcs_coalesced"] = _sum_counter(
+                snap, "scanner_tpu_rpc_coalesced_total", node)
+        # the bulk lives on exactly one shard: first shard that knows a
+        # live bulk wins (the rest answer "no active bulk")
+        st = c.try_call("GetJobStatus", bulk_id=None, retries=0)
+        if status is None and st and "tasks_done" in st:
+            status = st
+        h = c.try_call("GetHealth", retries=0, workers=(sid == primary))
+        healths[node] = h if h else {
+            "status": "unhealthy", "reasons": ["shard_unreachable"],
+            "firing": []}
+        rows.append(row)
+    health = merge_status(healths)
+    return (merge_snapshots(by_node) if by_node else None,
+            status, health, rows)
+
+
 NODE_COUNTERS = {
     "decode_f": "scanner_tpu_decoded_frames_total",
     "eval_r": "scanner_tpu_op_rows_total",
@@ -197,7 +267,7 @@ def _rate(cur: dict, prev: dict, key: str, now: float) -> float:
 # -- rendering --------------------------------------------------------------
 
 def render(status: dict, cur: dict, prev: dict, master: str,
-           health: dict = None) -> str:
+           health: dict = None, shards: list = None) -> str:
     now = cur["t"]
     lines = [f"scanner-top  master={master}  "
              f"{time.strftime('%H:%M:%S', time.localtime(now))}"]
@@ -226,6 +296,26 @@ def render(status: dict, cur: dict, prev: dict, master: str,
                              + (" [blacklisted]" if d.get("blacklisted")
                                 else "")
                              for j, d in shown) if shown else ""))
+    # per-shard control-plane columns (docs/robustness.md §Sharded
+    # control plane): one row per master shard — map epoch divergence,
+    # failover replays, stale-map NACKs and RPC coalescing per shard.
+    # A dead shard renders UP=NO instead of silently vanishing.
+    if shards:
+        lines.append("")
+        lines.append(f"{'SHARD':>5} {'ADDR':20} {'UP':>3} {'EPOCH':>6} "
+                     f"{'FAILOVER':>9} {'STALEMAP':>9} {'COALESCED':>10}")
+        for r in shards:
+            if r.get("up"):
+                lines.append(
+                    f"{r['shard']:>5} {str(r.get('addr', '?')):20} "
+                    f"{'yes':>3} {r.get('map_epoch', 0):>6.0f} "
+                    f"{r.get('failovers', 0):>9.0f} "
+                    f"{r.get('stale_map_rejections', 0):>9.0f} "
+                    f"{r.get('rpcs_coalesced', 0):>10.0f}")
+            else:
+                lines.append(
+                    f"{r['shard']:>5} {str(r.get('addr', '?')):20} "
+                    f"{'NO':>3} {'-':>6} {'-':>9} {'-':>9} {'-':>10}")
     lines.append("")
     hdr = (f"{'NODE':10} {'DECODE f/s':>10} {'EVAL r/s':>9} "
            f"{'H2D MB/s':>9} {'D2H MB/s':>9} {'EVALQ':>6} {'SAVEQ':>6} "
@@ -352,7 +442,7 @@ def render(status: dict, cur: dict, prev: dict, master: str,
 
 
 def json_doc(status: dict, cur: dict, master: str,
-             health: dict = None) -> dict:
+             health: dict = None, shards: list = None) -> dict:
     """The --json document: everything --once renders, machine-readable
     (scripts used to scrape the human table).  Per-node counter totals
     since process start plus the per-device utilization/memory maps."""
@@ -402,6 +492,10 @@ def json_doc(status: dict, cur: dict, master: str,
         }
     return {"time": cur["t"], "master": master, "status": status,
             "health": health, "nodes": nodes,
+            # sharded control plane: one entry per master shard with
+            # map epoch / failover / stale-map / coalescing columns
+            # (None for a single-master cluster)
+            "shards": shards,
             # per-gang straggler attribution (also inside
             # status.stragglers.gangs; surfaced top-level so scripts
             # need not know the straggler summary's shape)
@@ -430,29 +524,49 @@ def main(argv=None) -> int:
     from scanner_tpu.engine.service import MASTER_SERVICE
 
     client = RpcClient(args.master, MASTER_SERVICE, timeout=10.0)
+    # sharded control plane: resolve the versioned shard map from the
+    # dialed master (any shard serves it) and dial every shard — the
+    # poll loop then fans in instead of assuming one master
+    shard_addrs = _resolve_shards(client)
+    shard_clients = {}
+    if shard_addrs:
+        for sid, addr in sorted(shard_addrs.items()):
+            shard_clients[sid] = client if addr == client.address \
+                else RpcClient(addr, MASTER_SERVICE, timeout=10.0)
     prev = None
     try:
         while True:
-            reply = client.try_call("GetMetrics", retries=1)
-            if reply is None:
-                print(f"scanner-top: master {args.master} unreachable",
-                      file=sys.stderr)
-                return 2
-            status = client.try_call("GetJobStatus", bulk_id=None,
-                                     retries=1)
+            shard_rows = None
+            if shard_clients:
+                snap, status, health, shard_rows = \
+                    _poll_sharded(shard_clients)
+                if snap is None:
+                    print(f"scanner-top: no shard of {args.master} "
+                          f"reachable", file=sys.stderr)
+                    return 2
+            else:
+                reply = client.try_call("GetMetrics", retries=1)
+                if reply is None:
+                    print(f"scanner-top: master {args.master} "
+                          f"unreachable", file=sys.stderr)
+                    return 2
+                snap = reply["snapshot"]
+                status = client.try_call("GetJobStatus", bulk_id=None,
+                                         retries=1)
+                # cluster-wide health roll-up + firing alerts
+                # (GetHealth); best-effort like the status poll
+                health = client.try_call("GetHealth", retries=0)
             if status is not None and "error" in status \
                     and "tasks_done" not in status:
                 status = None
-            # cluster-wide health roll-up + firing alerts (GetHealth);
-            # best-effort like the status poll
-            health = client.try_call("GetHealth", retries=0)
-            cur = digest(reply["snapshot"])
+            cur = digest(snap)
             if args.json:
                 import json as _json
                 print(_json.dumps(json_doc(status, cur, args.master,
-                                           health)))
+                                           health, shard_rows)))
                 return 0
-            frame = render(status, cur, prev, args.master, health)
+            frame = render(status, cur, prev, args.master, health,
+                           shard_rows)
             if args.once:
                 print(frame)
                 return 0
@@ -464,6 +578,9 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         return 0
     finally:
+        for c in shard_clients.values():
+            if c is not client:
+                c.close()
         client.close()
 
 
