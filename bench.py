@@ -1409,8 +1409,10 @@ def main():
             from scanner_tpu.analysis.static import (
                 analyze, load_baseline, split_findings)
             _root = os.path.dirname(os.path.abspath(__file__))
+            _sc_t0 = time.perf_counter()
             _proj, _found = analyze(
                 [os.path.join(_root, "scanner_tpu")], root=_root)
+            _sc_s = round(time.perf_counter() - _sc_t0, 3)
             _res = split_findings(_proj, _found, load_baseline(
                 os.path.join(_root, "tools",
                              "scanner_check_baseline.json")))
@@ -1424,7 +1426,16 @@ def main():
                 "baselined": len(_res.baselined),
                 "inline_suppressed": len(_res.inline_suppressed),
                 "files_analyzed": len(_proj.modules),
+                "scanner_check_seconds": _sc_s,
             })
+            # direction-gated wall clock for the full four-family run
+            # over ONE shared Project — the analyzer's perf budget is
+            # banked and regression-gated like any serving metric
+            # (tools/bench_history.py --write-baselines)
+            for _d in detail:
+                if _d.get("config") == "baseline_metrics":
+                    _d["metrics"]["scanner_check_seconds"] = {
+                        "value": _sc_s, "better": "lower"}
         except Exception as e:  # noqa: BLE001 — bench must not die on lint
             detail.append({"config": "static_analysis",
                            "error": f"{type(e).__name__}: {e}"})

@@ -1,11 +1,14 @@
 """Repo-native static analysis (scanner-check).
 
-Three pass families over the scanner_tpu source:
+Four pass families over the scanner_tpu source:
 
   * tracer.py      — SC101–SC105: tracer safety + shape-stable dispatch
   * concurrency.py — SC201–SC203: lock order, blocking-under-lock,
                      unguarded shared writes
   * contracts.py   — SC301–SC307: metric/env/config/fault/RPC contracts
+  * durability.py  — SC401–SC406: write-ahead/fencing data-flow and
+                     journal round-trip discipline, plus anchoring of
+                     the analysis.model protocol model to RPC_CONTRACTS
 
 Run via `python tools/scanner_check.py`, the `scanner-check` console
 script, or programmatically::
@@ -18,19 +21,22 @@ not inline-suppressed or baselined with a justification.  Docs:
 docs/static-analysis.md.
 """
 
-from .core import (AnalysisPass, BaselineError, Finding, ModuleInfo,
-                   Project, find_repo_root, load_baseline,
-                   split_findings, write_baseline)
+from .core import (AnalysisPass, BaselineError, CallGraph, Finding,
+                   ModuleInfo, PathSimulator, Project, find_repo_root,
+                   load_baseline, split_findings, write_baseline)
 from .tracer import TracerSafetyPass
 from .concurrency import ConcurrencyPass
 from .contracts import ContractPass
-from .cli import (DEFAULT_BASELINE, all_passes, analyze, main,
-                  run_analysis)
+from .durability import DurabilityPass
+from .cli import (DEFAULT_BASELINE, all_passes, analyze, changed_paths,
+                  main, run_analysis)
 
 __all__ = [
-    "AnalysisPass", "BaselineError", "Finding", "ModuleInfo", "Project",
+    "AnalysisPass", "BaselineError", "CallGraph", "Finding",
+    "ModuleInfo", "PathSimulator", "Project",
     "TracerSafetyPass", "ConcurrencyPass", "ContractPass",
+    "DurabilityPass",
     "find_repo_root", "load_baseline", "split_findings",
-    "write_baseline", "all_passes", "analyze", "run_analysis", "main",
-    "DEFAULT_BASELINE",
+    "write_baseline", "all_passes", "analyze", "changed_paths",
+    "run_analysis", "main", "DEFAULT_BASELINE",
 ]

@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 from typing import List, Optional, Sequence
 
@@ -25,30 +26,86 @@ from .core import (BaselineError, Finding, Project, find_repo_root,
 from .tracer import TracerSafetyPass
 from .concurrency import ConcurrencyPass
 from .contracts import ContractPass
+from .durability import DurabilityPass
 
 DEFAULT_BASELINE = os.path.join("tools", "scanner_check_baseline.json")
 
+# modules --changed always re-analyzes alongside the touched set: the
+# cross-module passes (SC31x fence routing, SC404 journal round-trip,
+# SC406 model anchoring) read these for context, so a restricted run
+# reports the same findings for a touched module as a full run would
+_CHANGED_COMPANIONS = (
+    "scanner_tpu/engine/service.py",
+    "scanner_tpu/engine/journal.py",
+    "scanner_tpu/engine/shardmap.py",
+    "scanner_tpu/engine/gang.py",
+    "scanner_tpu/engine/controller.py",
+    "scanner_tpu/engine/config.py",
+    "scanner_tpu/analysis/model/protocol.py",
+)
 
-def all_passes():
-    return [TracerSafetyPass(), ConcurrencyPass(), ContractPass()]
+
+def all_passes(select: Optional[Sequence[str]] = None):
+    """Every pass family — or, with `select` code prefixes, only the
+    families owning a matching code (the shared-Project speed path:
+    `--select SC2` must not pay for the tracer or contract walks)."""
+    passes = [TracerSafetyPass(), ConcurrencyPass(), ContractPass(),
+              DurabilityPass()]
+    if select:
+        passes = [p for p in passes
+                  if any(code.startswith(s)
+                         for code in p.codes for s in select)]
+    return passes
 
 
 def analyze(paths: Sequence[str], root: Optional[str] = None,
             select: Optional[Sequence[str]] = None
             ) -> "tuple[Project, List[Finding]]":
     """THE run protocol, shared by the CLI, bench.py, and the tests:
-    build the Project, seed findings with parse errors, run every pass,
-    optionally filter to code prefixes, sort.  Returns the project too
-    (split_findings needs it for inline-suppression lookup)."""
+    build ONE Project shared by every pass family, seed findings with
+    parse errors, run the (select-filtered) passes, sort."""
     project = Project(paths, root=root)
     findings: List[Finding] = list(project.parse_errors)
-    for p in all_passes():
+    for p in all_passes(select):
         findings.extend(p.run(project))
     if select:
         findings = [f for f in findings
                     if any(f.code.startswith(s) for s in select)]
     findings.sort(key=lambda f: (f.path, f.line, f.code))
     return project, findings
+
+
+def changed_paths(root: str) -> Optional[List[str]]:
+    """Analysis targets for --changed: the working tree's touched
+    scanner_tpu/*.py files (vs HEAD, plus untracked) together with the
+    cross-module companion set.  Returns None when the analyzer itself
+    (scanner_tpu/analysis/ or tools/) is among the changes — those
+    affect every finding, so the caller falls back to a full run."""
+    def git(*args: str) -> List[str]:
+        try:
+            res = subprocess.run(
+                ["git", *args], cwd=root, capture_output=True,
+                text=True, timeout=30, check=True)
+        except Exception:  # noqa: BLE001 — no git ⇒ full run
+            return []
+        return [ln.strip() for ln in res.stdout.splitlines()
+                if ln.strip()]
+
+    changed = set(git("diff", "--name-only", "HEAD"))
+    changed |= set(git("ls-files", "--others", "--exclude-standard"))
+    if not changed and not os.path.isdir(os.path.join(root, ".git")):
+        return None  # not a checkout — nothing to scope by
+    touched = [c for c in changed
+               if c.endswith(".py") and c.startswith("scanner_tpu/")]
+    if any(c.startswith("scanner_tpu/analysis/") for c in touched) \
+            or any(c.startswith("tools/") for c in changed):
+        return None
+    if not touched:
+        return []
+    targets = dict.fromkeys(list(touched) + [
+        c for c in _CHANGED_COMPANIONS
+        if os.path.exists(os.path.join(root, c))])
+    return [os.path.join(root, c) for c in targets]
 
 
 def run_analysis(paths: Sequence[str], root: Optional[str] = None,
@@ -85,6 +142,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     metavar="CODE",
                     help="only run/report codes with this prefix "
                          "(repeatable): --select SC2 --select SC301")
+    ap.add_argument("--changed", action="store_true",
+                    help="analyze only modules touched vs git (plus "
+                         "the cross-module companion set); falls back "
+                         "to a full run when the analyzer itself "
+                         "changed")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output")
     ap.add_argument("--list-codes", action="store_true",
@@ -106,6 +168,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             os.path.dirname(os.path.abspath(__file__)))
         paths = [os.path.join(root, "scanner_tpu")]
 
+    restricted = False
+    if args.changed:
+        if args.write_baseline:
+            print("scanner-check: --write-baseline cannot be combined "
+                  "with --changed (a restricted run would erase "
+                  "baseline entries outside it)", file=sys.stderr)
+            return 2
+        scoped = changed_paths(root)
+        if scoped is not None:
+            if not scoped:
+                print("scanner-check: --changed: no scanner_tpu "
+                      "modules touched")
+                return 0
+            paths = scoped
+            restricted = True
+
     if args.write_baseline and args.select:
         # a selected subset cannot see the other codes' findings, so a
         # rewrite would silently drop their (justified) baseline entries
@@ -123,9 +201,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     project, findings = analyze(paths, root=root, select=args.select)
     res = split_findings(project, findings, baseline)
-    if args.select:
-        # a selected run can't see the other codes' findings, so their
-        # baseline entries would all look stale — don't claim they are
+    if args.select or restricted:
+        # a selected/--changed run can't see the other codes'/files'
+        # findings, so their baseline entries would all look stale —
+        # don't claim they are
         res.stale_baseline = []
 
     if args.write_baseline:

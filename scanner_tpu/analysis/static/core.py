@@ -34,6 +34,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 __all__ = [
     "Finding", "ModuleInfo", "Project", "AnalysisPass",
+    "CallGraph", "PathSimulator",
     "load_baseline", "write_baseline", "BaselineError",
     "split_findings", "find_repo_root",
 ]
@@ -254,6 +255,199 @@ class Project:
         return self._aux_sources
 
 
+class CallGraph:
+    """Self-method call graph of one class — the interprocedural layer
+    under the SC4xx durability passes.  The earlier passes resolve
+    `self._helper()` exactly one level; this closes the relation so a
+    pass can ask "which methods can *transitively* reach X" (a journal
+    flush, a fence poll, a durable-state mutation) without re-walking
+    the class per query.
+
+    Edges include bare `self.method` references (not just calls): a
+    method handed to `threading.Thread(target=self._loop)` is reachable
+    from the spawning method for every safety question these passes
+    ask."""
+
+    def __init__(self, mod: "ModuleInfo", cls: ast.ClassDef):
+        self.mod = mod
+        self.cls = cls
+        self.methods: Dict[str, ast.FunctionDef] = {}
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods.setdefault(stmt.name, stmt)
+        self.callees: Dict[str, Set[str]] = {}
+        names = set(self.methods)
+        for name, fn in self.methods.items():
+            refs: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Attribute) \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id == "self" \
+                        and node.attr in names:
+                    refs.add(node.attr)
+            self.callees[name] = refs
+        self._closure: Dict[str, Set[str]] = {}
+
+    def transitive_callees(self, name: str) -> Set[str]:
+        """Every method reachable from `name` via self-references
+        (`name` itself excluded unless it is recursive)."""
+        cached = self._closure.get(name)
+        if cached is not None:
+            return cached
+        seen: Set[str] = set()
+        stack = list(self.callees.get(name, ()))
+        while stack:
+            m = stack.pop()
+            if m in seen:
+                continue
+            seen.add(m)
+            stack.extend(self.callees.get(m, ()))
+        self._closure[name] = seen
+        return seen
+
+    def reaches(self, name: str, targets: Iterable[str]) -> bool:
+        """Can `name` reach any of `targets` (directly or
+        transitively)?"""
+        t = set(targets)
+        return bool(t & (self.transitive_callees(name) | {name}))
+
+    def reaching(self, targets: Iterable[str]) -> Set[str]:
+        """Reverse closure: every method that can reach any of
+        `targets` (the targets themselves included when defined
+        here)."""
+        t = set(targets)
+        return {m for m in self.methods
+                if m in t or t & self.transitive_callees(m)}
+
+
+class PathSimulator:
+    """Per-path abstract interpretation over one function body — the
+    path-sensitivity layer under SC401 (write-ahead discipline).
+
+    Subclasses choose a state lattice (any immutable value) and
+    override `initial`/`join`/`transfer`; the walker handles control
+    flow so passes don't re-implement it:
+
+      * `if` — union of both arms;
+      * loops — fixpoint (body zero or more times);
+      * `try` — handlers entered from *any prefix* of the body
+        (an exception can strike between any two statements);
+      * `finally` — applied before any `return` inside the `try`
+        escapes (a handler that journals in `finally` commits before
+        its ack leaves the function);
+      * `return`/`raise` — terminate the path (`on_return` fires for
+        returns, after enclosing `finally` bodies are applied).
+
+    `on_end` fires with the state at the implicit fall-off-the-end
+    return."""
+
+    _FIXPOINT_LIMIT = 16
+
+    def initial(self):
+        return frozenset()
+
+    def join(self, a, b):
+        return a | b
+
+    def transfer(self, state, stmt: ast.stmt):
+        return state
+
+    def on_return(self, state, node: ast.AST) -> None:
+        pass
+
+    def on_end(self, state, node: ast.AST) -> None:
+        pass
+
+    def run(self, fn: ast.FunctionDef) -> None:
+        self._finally_stack: List[List[ast.stmt]] = []
+        end = self._block(fn.body, self.initial())
+        if end is not None:
+            self.on_end(end, fn)
+
+    # -- walker ----------------------------------------------------------
+
+    def _block(self, stmts: Sequence[ast.stmt], state):
+        """Returns the fall-through state, or None when every path
+        terminated (return/raise/continue/break)."""
+        for stmt in stmts:
+            if state is None:
+                break
+            state = self._stmt(stmt, state)
+        return state
+
+    def _join_opt(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return self.join(a, b)
+
+    def _stmt(self, stmt: ast.stmt, state):
+        if isinstance(stmt, ast.Return):
+            st = self.transfer(state, stmt)
+            for finalbody in reversed(self._finally_stack):
+                out = self._block(finalbody, st)
+                if out is not None:
+                    st = out
+            self.on_return(st, stmt)
+            return None
+        if isinstance(stmt, ast.Raise):
+            self.transfer(state, stmt)
+            return None
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            return None
+        if isinstance(stmt, ast.If):
+            return self._join_opt(self._block(stmt.body, state),
+                                  self._block(stmt.orelse, state))
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            cur = state
+            for _ in range(self._FIXPOINT_LIMIT):
+                out = self._block(stmt.body, cur)
+                nxt = cur if out is None else self.join(cur, out)
+                if nxt == cur:
+                    break
+                cur = nxt
+            return self._join_opt(cur, self._block(stmt.orelse, cur)
+                                  if stmt.orelse else cur)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._block(stmt.body, self.transfer(state, stmt))
+        if isinstance(stmt, ast.Try):
+            handler_entry = state
+            cur = state
+            for s in stmt.body:
+                if cur is None:
+                    break
+                if stmt.finalbody:
+                    self._finally_stack.append(stmt.finalbody)
+                try:
+                    cur = self._stmt(s, cur)
+                finally:
+                    if stmt.finalbody:
+                        self._finally_stack.pop()
+                if cur is not None:
+                    handler_entry = self.join(handler_entry, cur)
+            out = None
+            if cur is not None:
+                out = self._block(stmt.orelse, cur) \
+                    if stmt.orelse else cur
+            for h in stmt.handlers:
+                out = self._join_opt(out,
+                                     self._block(h.body, handler_entry))
+            if stmt.finalbody:
+                if out is None:
+                    # every path inside returned/raised — the finally
+                    # still runs (returns already flowed through it via
+                    # the stack), but nothing falls through
+                    self._block(stmt.finalbody, handler_entry)
+                    return None
+                out = self._block(stmt.finalbody, out)
+            return out
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return state
+        return self.transfer(state, stmt)
+
+
 class AnalysisPass:
     """Base class: subclasses set `name`, document their `codes`, and
     implement run().  Finding codes are the stable public surface —
@@ -294,6 +488,11 @@ def load_baseline(path: str) -> Dict[str, dict]:
             raise BaselineError(
                 f"{path}: entry {fp} lacks a justification — every "
                 "baselined finding needs a one-line reason")
+        if fp in out:
+            raise BaselineError(
+                f"{path}: duplicate fingerprint {fp} — one entry per "
+                "accepted finding (merge the duplicates; a copy-paste "
+                "here silently double-counts an exception)")
         out[fp] = e
     return out
 

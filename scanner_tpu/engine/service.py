@@ -887,7 +887,12 @@ class Master:
             w = self._workers.get(wid)
             if w is not None and w.active:
                 w.active = False
-                self._requeue_worker_tasks(wid, recs=recs)
+                # deactivation is volatile liveness, but the requeue
+                # counts transient failures (strike/blacklist
+                # escalation — replayed durable state): a superseded
+                # master must not keep reshaping it (SC402)
+                if not self._fence.is_set():
+                    self._requeue_worker_tasks(wid, recs=recs)
                 _M_DRAINS.inc()
                 _mlog.info("worker %d deregistered (drain)", wid)
         self._journal_append(recs)
@@ -939,8 +944,13 @@ class Master:
                 _mlog.warning(
                     "worker %d advertised preemption: assignment "
                     "fenced, drain in progress", wid)
+                # the abort mutates durable gang state (journaled):
+                # a fenced master marks the worker preempting (volatile
+                # assignment fence) but leaves gang scheduling to the
+                # successor that owns the bulk now (SC402)
                 cur = self._bulk
-                if cur is not None and not cur.finished:
+                if cur is not None and not cur.finished \
+                        and not self._fence.is_set():
                     for g in list(cur.gangs.values()):
                         if wid in g.members:
                             self._abort_gang_locked(cur, g, "preempted",
@@ -1101,7 +1111,7 @@ class Master:
             # complete — and journal — a task that reset would then
             # delete.  A master crash mid-bulk resumes from here.
             if not bulk.finished:
-                self._persist_bulk_checkpoint(bulk)
+                self._persist_bulk_checkpoint(bulk)  # scanner-check: disable=SC405 admission lock (not the control-plane lock) serializes admission storage end-to-end by design — heartbeats never wait on it
             with self._lock:
                 self._bulk = bulk
                 self._no_worker_since = time.time()

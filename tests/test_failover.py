@@ -423,6 +423,45 @@ def test_worker_nacks_stale_assignment(tmp_path):
         master.stop()
 
 
+def test_fenced_master_unregister_skips_requeue(tmp_path):
+    """Regression (scanner-check SC402): a superseded master receiving
+    UnregisterWorker still deactivates the worker — volatile liveness,
+    every master may observe its own drain — but must NOT requeue its
+    tasks: the requeue path escalates through transient-failure counts
+    and gang aborts (journaled durable state the successor owns now)."""
+    sc, db_path = _seed_db(tmp_path)
+    master = Master(db_path=db_path, no_workers_timeout=60.0)
+    try:
+        w0 = master._rpc_register_worker({"address": ""})["worker_id"]
+        w1 = master._rpc_register_worker({"address": ""})["worker_id"]
+        bid = master._rpc_new_job({"spec": _spec_blob(sc, "fo_fence_rq"),
+                                   "token": "tok-F"})["bulk_id"]
+        for wid in (w0, w1):
+            r = master._rpc_next_work({"worker_id": wid,
+                                       "bulk_id": bid})
+            assert r["status"] == "task", r
+        master._fence.set()
+        assert master._rpc_unregister_worker({"worker_id": w0})["ok"]
+        with master._lock:
+            bulk = master._bulk
+            assert not master._workers[w0].active
+            assert any(o[0] == w0
+                       for o in bulk.outstanding.values()), \
+                "fenced master requeued a departing worker's tasks " \
+                "(durable scheduling mutation past the fence)"
+        # the live twin: with the fence down the requeue happens
+        master._fence.clear()
+        assert master._rpc_unregister_worker({"worker_id": w1})["ok"]
+        with master._lock:
+            bulk = master._bulk
+            assert not master._workers[w1].active
+            assert not any(o[0] == w1
+                           for o in bulk.outstanding.values())
+    finally:
+        master.stop()
+        sc.stop()
+
+
 def test_duplicate_delivery_fault_mode():
     """The rpc.client.call duplicate mode delivers the request twice;
     method=/peer= selectors scope it."""

@@ -351,6 +351,37 @@ def test_gang_failed_aborts_and_reforms_at_higher_epoch(tmp_path):
         sc.stop()
 
 
+def test_fenced_master_preemption_keeps_gang(tmp_path):
+    """Regression (scanner-check SC402): a superseded master that
+    hears a preemption notice marks the worker preempting — volatile
+    assignment fence, safe on any master — but must NOT abort its
+    gangs: the epoch bump is journaled durable state the successor
+    owns now."""
+    sc, db_path = _seed_db(tmp_path)
+    m = Master(db_path=db_path, no_workers_timeout=60.0)
+    try:
+        w0, w1 = _register(m, 2)
+        bid = m._rpc_new_job({"spec": _spec_blob(sc, "g_fence_pre"),
+                              "token": "t"})["bulk_id"]
+        roles = _form(m, bid, [w0, w1])
+        r = roles[w0]
+        aborted0 = _counter("scanner_tpu_gang_aborted_total",
+                            reason="preempted")
+        m._fence.set()
+        m._rpc_heartbeat({"worker_id": w1, "preempting": True})
+        with m._lock:
+            assert m._workers[w1].preempting
+            assert m._bulk.gangs, \
+                "fenced master aborted a gang (durable epoch bump " \
+                "past the fence)"
+            assert m._bulk.gang_epoch == r["epoch"]
+        assert _counter("scanner_tpu_gang_aborted_total",
+                        reason="preempted") == aborted0
+    finally:
+        m.stop()
+        sc.stop()
+
+
 def test_preemption_notice_aborts_member_gang(tmp_path):
     sc, db_path = _seed_db(tmp_path)
     m = Master(db_path=db_path, no_workers_timeout=60.0)

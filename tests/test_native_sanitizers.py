@@ -1,0 +1,53 @@
+"""Tier-1 sanitizer gate for the native decode library.
+
+`make asan` in cpp/ rebuilds the scvid harness under AddressSanitizer
+and runs every native check — the same harness `make test` runs, but
+with heap/stack overruns fatal instead of silent (the unaligned-width
+decode overrun fixed in PR 9 is exactly the class ASAN catches at the
+write, not at the crash three frames later).  UBSAN/TSAN ride the same
+Makefile (`make ubsan` / `make tsan`) but are left to the slow lane:
+one sanitizer in tier-1 keeps the flags from rotting without tripling
+the native build time.
+
+Skips (does not fail) when the toolchain or the libav dev headers are
+absent — CI images without g++ still run the Python tier-1 suite.
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+CPP_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "cpp")
+
+
+def _have_toolchain():
+    cxx = os.environ.get("CXX", "g++")
+    if shutil.which(cxx) is None or shutil.which("make") is None:
+        return False
+    # libav dev headers: probe the preprocessor rather than pkg-config
+    # (the image installs headers without .pc files)
+    probe = subprocess.run(
+        [cxx, "-E", "-x", "c++", "-", "-o", os.devnull],
+        input="#include <libavformat/avformat.h>\n",
+        capture_output=True, text=True, cwd=CPP_DIR, timeout=60)
+    return probe.returncode == 0
+
+
+@pytest.mark.skipif(not os.path.isdir(CPP_DIR),
+                    reason="cpp/ not present in this checkout")
+def test_asan_harness_builds_and_passes():
+    if not _have_toolchain():
+        pytest.skip("no C++ toolchain / libav headers — native "
+                    "sanitizer gate needs g++, make, libavformat-dev")
+    res = subprocess.run(
+        ["make", "asan"], cwd=CPP_DIR, capture_output=True,
+        text=True, timeout=600,
+        env={**os.environ, "ASAN_OPTIONS": "abort_on_error=1"})
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, f"make asan failed:\n{out[-4000:]}"
+    assert "all native checks passed" in out, out[-4000:]
+    assert "AddressSanitizer" not in out, (
+        "ASAN reported an error:\n" + out[-4000:])

@@ -1742,3 +1742,605 @@ def test_run_analysis_select():
     findings = run_analysis([os.path.join(REPO, "scanner_tpu")],
                             root=REPO, select=["SC2"])
     assert all(f.code.startswith("SC2") for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# family 4: durability & fencing (SC401-SC406)
+# ---------------------------------------------------------------------------
+
+def _sc4(findings):
+    return sorted(f.code for f in findings if f.code.startswith("SC4"))
+
+
+DUR_WRITE_AHEAD_BAD = """
+    import threading
+
+    MASTER_SERVICE = "scanner-master"
+    RECORD_TYPES = ("done",)
+
+    class RpcServer:
+        def __init__(self, name, methods, port=0):
+            pass
+
+    class Master:
+        def __init__(self):
+            self._fence = threading.Event()
+            self.done = set()
+
+        def _fenced(self, h):
+            return h
+
+        def _journal_append(self, recs):
+            if self._fence.is_set():
+                return
+
+        def _apply(self, rec):
+            t = rec.get("t")
+            if t == "done":
+                self.done.add(rec["task"])
+
+        def _rpc_finish(self, req):
+            recs = []
+            recs.append({"t": "done", "task": req["task"]})
+            self.done.add(req["task"])
+            if req.get("fast"):
+                return {"ok": True}
+            self._journal_append(recs)
+            return {"ok": True}
+
+        def serve(self):
+            return RpcServer(MASTER_SERVICE, {
+                "FinishedWork": self._fenced(self._rpc_finish),
+            })
+"""
+
+DUR_WRITE_AHEAD_CLEAN = DUR_WRITE_AHEAD_BAD.replace(
+    """\
+        def _rpc_finish(self, req):
+            recs = []
+            recs.append({"t": "done", "task": req["task"]})
+            self.done.add(req["task"])
+            if req.get("fast"):
+                return {"ok": True}
+            self._journal_append(recs)
+            return {"ok": True}
+""",
+    """\
+        def _rpc_finish(self, req):
+            recs = []
+            try:
+                recs.append({"t": "done", "task": req["task"]})
+                self.done.add(req["task"])
+                if req.get("fast"):
+                    return {"ok": True, "fast": True}
+                return {"ok": True}
+            finally:
+                self._journal_append(recs)
+""")
+
+
+def test_write_ahead_dirty_ack_flagged(tmp_path):
+    _write(tmp_path, "m.py", DUR_WRITE_AHEAD_BAD)
+    _, findings = _analyze(tmp_path)
+    sc401 = [f for f in findings if f.code == "SC401"]
+    assert len(sc401) == 1
+    assert "_rpc_finish" in sc401[0].message
+    assert "FinishedWork" in sc401[0].message
+
+
+def test_write_ahead_finally_commit_is_clean(tmp_path):
+    """The journal-in-finally idiom: every return flows through the
+    enclosing finally's group-commit first, so no path acks dirty."""
+    _write(tmp_path, "m.py", DUR_WRITE_AHEAD_CLEAN)
+    _, findings = _analyze(tmp_path)
+    assert _sc4(findings) == []
+
+
+def test_write_ahead_inline_suppression(tmp_path):
+    _write(tmp_path, "m.py", DUR_WRITE_AHEAD_BAD.replace(
+        "return {\"ok\": True}\n            self._journal_append",
+        "return {\"ok\": True}  "
+        "# scanner-check: disable=SC401 volatile-only fast path\n"
+        "            self._journal_append"))
+    proj, findings = _analyze(tmp_path)
+    res = split_findings(proj, findings)
+    assert not [f for f in res.unsuppressed if f.code == "SC401"]
+    assert [f.code for f in res.inline_suppressed] == ["SC401"]
+
+
+DUR_FENCE_BAD = """
+    import threading
+
+    MASTER_SERVICE = "scanner-master"
+    RECORD_TYPES = ("strike",)
+
+    class RpcServer:
+        def __init__(self, name, methods, port=0):
+            pass
+
+    class Master:
+        def __init__(self):
+            self.transient_failures = {}
+
+        def _journal_append(self, recs):
+            pass
+
+        def _apply(self, rec):
+            t = rec.get("t")
+            if t == "strike":
+                self.transient_failures.pop(rec["w"], None)
+
+        def _rpc_unreg(self, req):
+            recs = self._requeue(req["worker"])
+            self._journal_append(recs)
+            return {"ok": True}
+
+        def _requeue(self, wid):
+            self.transient_failures.update({wid: 1})
+            return [{"t": "strike", "w": wid}]
+
+        def serve(self):
+            return RpcServer(MASTER_SERVICE, {
+                "UnregisterWorker": self._rpc_unreg,
+            })
+"""
+
+# the real fix's idiom: the unfenced handler consults the fence before
+# reaching the durable mutation, so it participates in the protocol
+DUR_FENCE_CLEAN = DUR_FENCE_BAD.replace(
+    """\
+        def _rpc_unreg(self, req):
+            recs = self._requeue(req["worker"])
+""",
+    """\
+        def _rpc_unreg(self, req):
+            if self._fence.is_set():
+                return {"ok": True}
+            recs = self._requeue(req["worker"])
+""")
+
+
+def test_fence_unfenced_handler_mutation_flagged(tmp_path):
+    _write(tmp_path, "m.py", DUR_FENCE_BAD)
+    _, findings = _analyze(tmp_path)
+    sc402 = [f for f in findings if f.code == "SC402"]
+    assert len(sc402) == 1
+    assert "_requeue" in sc402[0].message
+    assert "UnregisterWorker" in sc402[0].message
+
+
+def test_fence_consulting_handler_is_clean(tmp_path):
+    _write(tmp_path, "m.py", DUR_FENCE_CLEAN)
+    _, findings = _analyze(tmp_path)
+    assert _sc4(findings) == []
+
+
+def test_fence_background_thread_target_flagged(tmp_path):
+    """Thread(target=self.X) is an entry point the fence audit follows,
+    same as an unfenced handler."""
+    _write(tmp_path, "m.py", DUR_FENCE_BAD.replace(
+        """\
+        def serve(self):
+""",
+        """\
+        def start(self):
+            threading.Thread(target=self._scan, daemon=True).start()
+
+        def _scan(self):
+            self._requeue(0)
+
+        def serve(self):
+"""))
+    _, findings = _analyze(tmp_path)
+    msgs = [f.message for f in findings if f.code == "SC402"]
+    assert any("background thread `_scan`" in m for m in msgs)
+
+
+DUR_STALE_BAD = """
+    class ShardState:
+        def __init__(self):
+            self.committed_jobs = set()
+            self.map_epoch = 0
+
+        def apply_equality(self, msg):
+            e = msg.get("map_epoch")
+            if e == self.map_epoch:
+                self.committed_jobs.add(msg["job"])
+            return True
+
+        def apply_blind(self, msg):
+            self.map_epoch = msg["map_epoch"]
+            self.committed_jobs.add(msg["job"])
+"""
+
+DUR_STALE_CLEAN = """
+    class ShardState:
+        def __init__(self):
+            self.committed_jobs = set()
+            self.map_epoch = 0
+
+        def apply_monotone(self, msg):
+            e = msg.get("map_epoch")
+            if e <= self.map_epoch:
+                return False
+            self.map_epoch = e
+            self.committed_jobs.add(msg["job"])
+            return True
+
+        def apply_cas(self, msg):
+            if not try_claim(msg["epoch"]):
+                return False
+            self.committed_jobs.add(msg["job"])
+            return True
+
+        def apply_delegated(self, msg):
+            self._validate(msg)
+            self.committed_jobs.add(msg["job"])
+
+        def apply_latch(self, msg):
+            self.map_epoch = max(self.map_epoch, msg["map_epoch"])
+
+        def _validate(self, msg):
+            return True
+"""
+
+
+def test_staleness_equality_check_flagged(tmp_path):
+    _write(tmp_path, "m.py", DUR_STALE_BAD)
+    _, findings = _analyze(tmp_path)
+    msgs = [f.message for f in findings if f.code == "SC403"]
+    assert len(msgs) == 2
+    assert any("apply_equality" in m and "equality" in m for m in msgs)
+    assert any("apply_blind" in m and "without any" in m for m in msgs)
+
+
+def test_staleness_monotone_cas_delegation_clean(tmp_path):
+    """Monotone compares, CAS claims, max()-latches, and passing the
+    stamped message to a validator all count as discipline."""
+    _write(tmp_path, "m.py", DUR_STALE_CLEAN)
+    _, findings = _analyze(tmp_path)
+    assert _sc4(findings) == []
+
+
+def test_staleness_non_mutating_reader_exempt(tmp_path):
+    """A pure reader may compare epochs however it likes (the gang
+    liveness probe uses exact-epoch equality legitimately)."""
+    _write(tmp_path, "m.py", """
+        def peek(self, msg, live):
+            return msg.get("epoch") == live
+    """)
+    _, findings = _analyze(tmp_path)
+    assert _sc4(findings) == []
+
+
+DUR_JOURNAL_BAD = """
+    RECORD_TYPES = ("done", "strike")
+
+    def _journal_append(recs):
+        pass
+
+    def writer(recs):
+        recs.append({"t": "done"})
+        recs.append({"t": "orphan"})
+
+    def replay(rec):
+        t = rec.get("t")
+        if t == "done":
+            return 1
+        if t == "ghost":
+            return 2
+        return 0
+"""
+
+DUR_JOURNAL_CLEAN = """
+    RECORD_TYPES = ("done", "strike")
+
+    def _journal_append(recs):
+        pass
+
+    def writer(recs):
+        recs.append({"t": "done"})
+        recs.append({"t": "strike"})
+
+    def replay(rec):
+        t = rec.get("t")
+        if t in ("done", "strike"):
+            return 1
+        return 0
+"""
+
+
+def test_journal_round_trip_all_directions(tmp_path):
+    _write(tmp_path, "j.py", DUR_JOURNAL_BAD)
+    _, findings = _analyze(tmp_path)
+    msgs = [f.message for f in findings if f.code == "SC404"]
+    assert any("`orphan`" in m and "no" in m and "replay" in m
+               for m in msgs)
+    assert any("`orphan`" in m and "RECORD_TYPES" in m for m in msgs)
+    assert any("`ghost`" in m and "nothing" in m for m in msgs)
+    assert any("`strike`" in m and "declares" in m for m in msgs)
+
+
+def test_journal_round_trip_clean(tmp_path):
+    """Membership (`t in (...)`) arms count as replay coverage."""
+    _write(tmp_path, "j.py", DUR_JOURNAL_CLEAN)
+    _, findings = _analyze(tmp_path)
+    assert _sc4(findings) == []
+
+
+DUR_LOCK_BAD = """
+    import threading
+
+    MASTER_SERVICE = "scanner-master"
+    RECORD_TYPES = ("done",)
+
+    class RpcServer:
+        def __init__(self, name, methods, port=0):
+            pass
+
+    class Master:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._fence = threading.Event()
+
+        def _journal_append(self, recs):
+            if self._fence.is_set():
+                return
+
+        def _apply(self, rec):
+            if rec.get("t") == "done":
+                return 1
+
+        def _rpc_get(self, req):
+            return {}
+
+        def flush_locked(self):
+            with self._lock:
+                self._journal_append([{"t": "done"}])
+
+        def wait_locked(self):
+            with self._lock:
+                self._collective_digest_sum()
+
+        def indirect(self):
+            with self._lock:
+                self._maybe_commit()
+
+        def _maybe_commit(self):
+            self._journal_append([])
+
+        def serve(self):
+            return RpcServer(MASTER_SERVICE, {
+                "GetJob": self._rpc_get,
+            })
+"""
+
+DUR_LOCK_CLEAN = DUR_LOCK_BAD.replace(
+    """\
+        def flush_locked(self):
+            with self._lock:
+                self._journal_append([{"t": "done"}])
+
+        def wait_locked(self):
+            with self._lock:
+                self._collective_digest_sum()
+
+        def indirect(self):
+            with self._lock:
+                self._maybe_commit()
+""",
+    """\
+        def flush_locked(self):
+            recs = [{"t": "done"}]
+            with self._lock:
+                staged = list(recs)
+            self._journal_append(staged)
+
+        def wait_locked(self):
+            self._collective_digest_sum()
+
+        def indirect(self):
+            with self._lock:
+                pass
+            self._maybe_commit()
+""")
+
+
+def test_lock_across_commit_flagged(tmp_path):
+    _write(tmp_path, "m.py", DUR_LOCK_BAD)
+    _, findings = _analyze(tmp_path)
+    msgs = [f.message for f in findings if f.code == "SC405"]
+    assert len(msgs) == 3
+    assert any("group-commit while holding" in m for m in msgs)
+    assert any("collective wait" in m for m in msgs)
+    assert any("_maybe_commit" in m and "transitively" in m
+               for m in msgs)
+
+
+def test_lock_released_before_commit_clean(tmp_path):
+    _write(tmp_path, "m.py", DUR_LOCK_CLEAN)
+    _, findings = _analyze(tmp_path)
+    assert _sc4(findings) == []
+
+
+def _sc406_repo(tmp_path, anchors, transitions, contracts=True):
+    _write(tmp_path, "setup.py", "# root\n")
+    if contracts:
+        _write(tmp_path, "pkg/service.py", """
+            RPC_CONTRACTS = {
+                "FinishedWork": {"timeout_s": 1.0, "idempotent": False},
+                "Ping": {"timeout_s": 1.0, "idempotent": True},
+            }
+        """)
+    body = "RPC_ANCHORS = {\n"
+    for k, v in anchors.items():
+        body += f'    "{k}": "{v}",\n'
+    body += "}\n\n"
+    for t in transitions:
+        body += f"def t_{t}(s):\n    return s\n\n"
+    _write(tmp_path, "pkg/analysis/model/protocol.py", body)
+    return _analyze(tmp_path, "pkg")[1]
+
+
+def test_model_anchoring_clean(tmp_path):
+    findings = _sc406_repo(tmp_path,
+                           {"finished_work": "FinishedWork"},
+                           ["finished_work"])
+    assert [f for f in findings if f.code == "SC406"] == []
+
+
+def test_model_anchor_without_transition_flagged(tmp_path):
+    findings = _sc406_repo(tmp_path,
+                           {"finished_work": "FinishedWork",
+                            "ghost": "Ping"},
+                           ["finished_work"])
+    msgs = [f.message for f in findings if f.code == "SC406"]
+    assert any("`ghost`" in m and "t_ghost" in m for m in msgs)
+
+
+def test_model_anchor_without_contract_flagged(tmp_path):
+    findings = _sc406_repo(tmp_path,
+                           {"finished_work": "FinishedWork",
+                            "extra": "NoSuchRpc"},
+                           ["finished_work", "extra"])
+    msgs = [f.message for f in findings if f.code == "SC406"]
+    assert any("NoSuchRpc" in m and "no RPC_CONTRACTS entry" in m
+               for m in msgs)
+
+
+def test_model_missing_nonidempotent_rpc_flagged(tmp_path):
+    """Drift the OTHER direction: an idempotent=False contract with no
+    anchoring transition blinds the explorer to a mutating RPC."""
+    findings = _sc406_repo(tmp_path,
+                           {"ping": "Ping"},
+                           ["ping"])
+    msgs = [f.message for f in findings if f.code == "SC406"]
+    assert any("FinishedWork" in m and "idempotent=False" in m
+               for m in msgs)
+
+
+def test_model_package_without_anchors_flagged(tmp_path):
+    _write(tmp_path, "setup.py", "# root\n")
+    _write(tmp_path, "pkg/service.py", """
+        RPC_CONTRACTS = {
+            "FinishedWork": {"timeout_s": 1.0, "idempotent": False},
+        }
+    """)
+    _write(tmp_path, "pkg/analysis/model/explorer.py", "x = 1\n")
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC406"]
+    assert any("no RPC_ANCHORS" in m for m in msgs)
+
+
+def test_real_model_anchoring_is_live():
+    """The shipped analysis/model/protocol.py stays pinned to the
+    shipped RPC_CONTRACTS: SC406 must fire if either side drifts."""
+    from scanner_tpu.analysis.model import RPC_ANCHORS
+    from scanner_tpu.engine.service import RPC_CONTRACTS
+    non_idem = {r for r, c in RPC_CONTRACTS.items()
+                if c.get("idempotent") is False}
+    anchored = set(RPC_ANCHORS.values())
+    assert non_idem <= anchored
+    assert anchored <= set(RPC_CONTRACTS)
+    # and the analyzer agrees (zero SC406 over the real tree)
+    findings = run_analysis([os.path.join(REPO, "scanner_tpu")],
+                            root=REPO, select=["SC406"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# baseline hygiene: duplicate fingerprints
+# ---------------------------------------------------------------------------
+
+def test_baseline_rejects_duplicate_fingerprints(tmp_path):
+    """A copy-pasted baseline entry silently double-counts an accepted
+    exception — the loader must refuse the file outright."""
+    _write(tmp_path, "s.py", SLEEPY)
+    proj, findings = _analyze(tmp_path)
+    res = split_findings(proj, findings)
+    bl_path = str(tmp_path / "baseline.json")
+    write_baseline(bl_path, res.unsuppressed)
+    doc = json.load(open(bl_path))
+    doc["entries"][0]["justification"] = "legit entry"
+    doc["entries"].append(dict(doc["entries"][0]))
+    json.dump(doc, open(bl_path, "w"))
+    with pytest.raises(BaselineError) as ei:
+        load_baseline(bl_path)
+    assert "duplicate fingerprint" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# --changed: restricted runs agree with full runs
+# ---------------------------------------------------------------------------
+
+def _git(cwd, *args):
+    subprocess.run(["git", *args], cwd=cwd, check=True,
+                   capture_output=True, text=True, timeout=60)
+
+
+def _changed_repo(tmp_path):
+    _write(tmp_path, "setup.py", "# root\n")
+    _write(tmp_path, "scanner_tpu/__init__.py", "")
+    _write(tmp_path, "scanner_tpu/mod.py", "x = 1\n")
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-q", "--allow-empty", "-m", "seed")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-q", "-m", "clean tree")
+    return tmp_path
+
+
+def _run_check(root, *extra):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "scanner_check.py"),
+         "--root", str(root), str(root / "scanner_tpu"),
+         "--no-baseline", "--json", *extra],
+        capture_output=True, text=True, env=env, timeout=120)
+
+
+def test_changed_agrees_with_full_run(tmp_path):
+    """--changed over a dirty checkout reports exactly the findings a
+    full run reports for the touched modules."""
+    root = _changed_repo(tmp_path)
+    _write(root, "scanner_tpu/mod.py", SLEEPY)
+    full = json.loads(_run_check(root).stdout)
+    restricted = json.loads(_run_check(root, "--changed").stdout)
+    assert restricted["counts"] == full["counts"] == {"SC202": 1}
+    strip = [(f["code"], f["path"], f["fingerprint"])
+             for f in full["findings"]]
+    strip_r = [(f["code"], f["path"], f["fingerprint"])
+               for f in restricted["findings"]]
+    assert strip == strip_r
+
+
+def test_changed_clean_tree_is_noop(tmp_path):
+    root = _changed_repo(tmp_path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "scanner_check.py"),
+         "--root", str(root), str(root / "scanner_tpu"),
+         "--no-baseline", "--changed"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 0
+    assert "no scanner_tpu modules touched" in r.stdout
+
+
+def test_changed_refuses_write_baseline(tmp_path):
+    root = _changed_repo(tmp_path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "scanner_check.py"),
+         "--root", str(root), "--changed", "--write-baseline"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 2 and "erase" in r.stderr
+
+
+def test_changed_paths_fall_back_when_analyzer_touched(tmp_path):
+    """A change under scanner_tpu/analysis/ affects every finding, so
+    the restriction must dissolve into a full run."""
+    from scanner_tpu.analysis.static import changed_paths
+    root = _changed_repo(tmp_path)
+    _write(root, "scanner_tpu/analysis/static/extra.py", "y = 2\n")
+    assert changed_paths(str(root)) is None
