@@ -1112,6 +1112,129 @@ def main():
                         "error": f"{type(e).__name__}: {e}"}
         detail.append(_shard_d)
 
+        # whole-pipeline fusion digest (graph/fusion.py, PERF.md §8):
+        # the golden Resize->Blur->Histogram->HistDiff pipeline run
+        # staged (SCANNER_TPU_FUSION semantics, fusion.set_enabled off)
+        # then fused over the same clip.  Banked: the per-mode measured
+        # op seconds (sum over members vs the one chain row), the
+        # executables each mode minted, the intermediate HBM bytes the
+        # fused program never materialized, and the direction-gated
+        # fused_chain_speedup = staged op-seconds / fused chain-seconds
+        def _fusion_digest() -> dict:
+            from scanner_tpu.graph import fusion as _fusion
+
+            members = ("Resize", "Blur", "Histogram", "HistDiff")
+            # HistDiff (windowed, non-head) stays staged; the planner
+            # forms the 3-member chain
+            cid = "+".join(members[:3])
+
+            def _by_op(name: str) -> dict:
+                out: dict = {}
+                for s in registry().snapshot().get(
+                        name, {}).get("samples", []):
+                    k = s["labels"].get("op", "_")
+                    out[k] = out.get(k, 0.0) + s["value"]
+                return out
+
+            fdb = os.path.join(root, "fusion_db")
+            n_rows = 96
+            fvid = os.path.join(root, "fusion.mp4")
+            scv.synthesize_video(fvid, num_frames=n_rows, width=W,
+                                 height=H, fps=24, keyint=24)
+            fc5 = Client(db_path=fdb)
+            fc5.ingest_videos([("fz_vid", fvid)])
+            keys = (cid,) + members
+
+            def _run_mode(mode: str, on: bool) -> dict:
+                prev = _fusion.enabled()
+                _fusion.set_enabled(on)
+                try:
+                    s0 = _by_op("scanner_tpu_op_seconds_total")
+                    r0 = _by_op("scanner_tpu_op_recompiles_total")
+                    col = fc5.io.Input(
+                        [NamedVideoStream(fc5, "fz_vid")])
+                    col = fc5.ops.Resize(frame=col, width=[W // 2],
+                                         height=[H // 2])
+                    col = fc5.ops.Blur(frame=col, kernel_size=3,
+                                       sigma=1.1)
+                    col = fc5.ops.Histogram(frame=col)
+                    col = fc5.ops.HistDiff(frame=col)
+                    out = NamedStream(fc5, f"fz_{mode}")
+                    w0 = time.time()
+                    fc5.run(fc5.io.Output(col, [out]),
+                            PerfParams.manual(8, 16),
+                            cache_mode=CacheMode.Overwrite,
+                            show_progress=False)
+                    wall = time.time() - w0
+                    rows = len(list(out.load()))
+                    s1 = _by_op("scanner_tpu_op_seconds_total")
+                    r1 = _by_op("scanner_tpu_op_recompiles_total")
+                    return {
+                        "mode": mode,
+                        "rows_ok": rows == n_rows,
+                        "wall_s": round(wall, 3),
+                        "op_seconds": round(
+                            sum(s1.get(k, 0.0) - s0.get(k, 0.0)
+                                for k in keys), 4),
+                        "executables_minted": int(
+                            sum(r1.get(k, 0) - r0.get(k, 0)
+                                for k in keys)),
+                    }
+                finally:
+                    _fusion.set_enabled(prev)
+
+            try:
+                # cold pass per mode mints the executables; the banked
+                # speedup comes from a second, warm pass so one-off
+                # trace/compile time doesn't swamp the steady-state A/B
+                staged = _run_mode("staged", on=False)
+                fused = _run_mode("fused", on=True)
+                staged_w = _run_mode("staged_warm", on=False)
+                fused_w = _run_mode("fused_warm", on=True)
+                speedup = None
+                if staged_w["op_seconds"] and fused_w["op_seconds"]:
+                    speedup = round(staged_w["op_seconds"]
+                                    / fused_w["op_seconds"], 3)
+                snap_f = registry().snapshot()
+                saved = sum(
+                    s["value"] for s in snap_f.get(
+                        "scanner_tpu_fusion_intermediate_bytes_saved_"
+                        "total", {}).get("samples", [])
+                    if s["labels"].get("chain") == cid)
+                chains = {
+                    s["labels"]["chain"]: s["value"]
+                    for s in snap_f.get(
+                        "scanner_tpu_fusion_chains_planned",
+                        {}).get("samples", [])}
+                return {
+                    "config": "fusion",
+                    "rows_ok": (staged["rows_ok"] and fused["rows_ok"]
+                                and staged_w["rows_ok"]
+                                and fused_w["rows_ok"]),
+                    "error": None,
+                    "chain": cid,
+                    "chains_planned": chains,
+                    "staged": staged,
+                    "fused": fused,
+                    "staged_warm": staged_w,
+                    "fused_warm": fused_w,
+                    "fused_chain_speedup": speedup,
+                    "executables_avoided":
+                        staged["executables_minted"]
+                        - fused["executables_minted"],
+                    "intermediate_bytes_saved": saved,
+                }
+            finally:
+                fc5.stop()
+
+        try:
+            _fz_d = _fusion_digest()
+        except Exception as e:  # noqa: BLE001 — bench must not die on
+            # the fusion A/B
+            _fz_d = {"config": "fusion",
+                     "error": f"{type(e).__name__}: {e}"}
+        detail.append(_fz_d)
+
         # control-plane digest (engine/shardmap.py): a bounded live
         # sharded-master drill — two in-process shard masters, one
         # multiplexing worker.  Admission is probed per shard (NewJob
@@ -1368,6 +1491,9 @@ def main():
                     "better": "lower"},
                 "gang_sharded_speedup": {
                     "value": _shard_d.get("gang_sharded_speedup"),
+                    "better": "higher"},
+                "fused_chain_speedup": {
+                    "value": _fz_d.get("fused_chain_speedup"),
                     "better": "higher"},
                 "shard_failover_recovery_s": {
                     "value": _cp_d.get("shard_failover_recovery_s"),

@@ -109,6 +109,17 @@ passes make each one checkable:
          must be shard-routed — a mutating RPC missing from the
          routing tuple would land on the dial-time shard regardless
          of which master owns the bulk it mutates
+  SC317  whole-pipeline fusion drift (graph/fusion.py):
+         fusion.FUSION_SERIES must match the series the module
+         registers AND the marker-delimited
+         `fusion-series:begin/end` table in docs/observability.md
+         (all pairings, both directions); the `[perf] fusion_*`
+         config keys config.default_config() declares must be
+         exactly fusion.CONFIG_KEYS (both directions); and a kernel
+         class overriding `execute_traced` (declaring itself
+         trace-composable for fusion) without a `cost()` hook is
+         flagged (extends SC309) — the planner's fusability gate
+         keys on cost(), so such a kernel silently never fuses
 """
 
 from __future__ import annotations
@@ -411,6 +422,10 @@ class ContractPass(AnalysisPass):
                  "[control] keys vs shardmap.CONFIG_KEYS; "
                  "SHARD_ROUTED_RPCS vs idempotent=False + "
                  "fence-wrapped master handlers)",
+        "SC317": "whole-pipeline fusion drift (FUSION_SERIES vs "
+                 "fusion registrations vs docs fusion-series table; "
+                 "[perf] fusion_* keys vs fusion.CONFIG_KEYS; "
+                 "execute_traced overrides without a cost() hook)",
     }
 
     def run(self, project: Project) -> List[Finding]:
@@ -429,6 +444,7 @@ class ContractPass(AnalysisPass):
         out.extend(self._clocksync_contract(project))
         out.extend(self._gang_shard_contract(project))
         out.extend(self._shard_contract(project))
+        out.extend(self._fusion_contract(project))
         return out
 
     # -- SC301 / SC302 ---------------------------------------------------
@@ -1744,6 +1760,116 @@ class ContractPass(AnalysisPass):
                     "but is missing from SHARD_ROUTED_RPCS — a "
                     "mutating RPC pinned to the dial-time shard "
                     "would bypass bulk ownership", smod.tree))
+        return out
+
+    # -- SC317 -----------------------------------------------------------
+
+    _FUSION_DOC_BLOCK_RE = re.compile(
+        r"<!--\s*fusion-series:begin\s*-->(.*?)"
+        r"<!--\s*fusion-series:end\s*-->", re.S)
+
+    def _fusion_contract(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        fmod = project.module("graph/fusion.py")
+        if fmod is None:
+            return out
+        declared = _module_tuple(fmod, "FUSION_SERIES")
+        if declared is not None:
+            declared_set = set(declared)
+            registered = {r.name for r in _metric_registrations(fmod)
+                          if r.name}
+            for name in sorted(registered - declared_set):
+                out.append(fmod.finding(
+                    "SC317",
+                    f"series `{name}` is registered in fusion but "
+                    "missing from FUSION_SERIES — the SC317 catalog "
+                    "contract cannot see it", fmod.tree))
+            for name in sorted(declared_set - registered):
+                out.append(fmod.finding(
+                    "SC317",
+                    f"FUSION_SERIES names `{name}` but fusion "
+                    "registers no such series", fmod.tree))
+            doc = _read_doc(project, "observability.md")
+            if doc:
+                block = self._FUSION_DOC_BLOCK_RE.search(doc)
+                if block is None:
+                    out.append(fmod.finding(
+                        "SC317",
+                        "fusion declares FUSION_SERIES but "
+                        "docs/observability.md has no fusion-series "
+                        "marker table (<!-- fusion-series:begin/end "
+                        "-->)", fmod.tree))
+                else:
+                    doc_names = {n for n in
+                                 _SERIES_RE.findall(block.group(1))}
+                    base_doc = set()
+                    for n in doc_names:
+                        for suf in _EXPOSITION_SUFFIXES:
+                            if n.endswith(suf) \
+                                    and n[:-len(suf)] in doc_names:
+                                break
+                        else:
+                            base_doc.add(n)
+                    for name in sorted(declared_set - base_doc):
+                        out.append(fmod.finding(
+                            "SC317",
+                            f"fusion series `{name}` is missing from "
+                            "the docs/observability.md fusion-series "
+                            "table", fmod.tree))
+                    for name in sorted(base_doc - declared_set):
+                        out.append(Finding(
+                            code="SC317",
+                            message=f"docs/observability.md "
+                                    f"fusion-series table lists "
+                                    f"`{name}` but fusion's "
+                                    "FUSION_SERIES has no such series",
+                            path="docs/observability.md", line=1,
+                            scope="", snippet=name))
+        # [perf] fusion_* config keys <-> fusion.CONFIG_KEYS, both
+        # directions (the SC310 frame_cache_* pattern)
+        schema = _module_tuple(fmod, "CONFIG_KEYS")
+        cfg_mod = None
+        for m in project.modules:
+            if m.relpath.endswith("config.py") \
+                    and _default_config_keys(m):
+                cfg_mod = m
+                break
+        if schema is not None and cfg_mod is not None:
+            perf_keys = {k for sec, k in _default_config_keys(cfg_mod)
+                         if sec == "perf" and k.startswith("fusion")}
+            if perf_keys or schema:
+                for k in sorted(perf_keys - set(schema)):
+                    out.append(cfg_mod.finding(
+                        "SC317",
+                        f"config key `[perf] {k}` is declared but "
+                        "fusion.CONFIG_KEYS does not accept it",
+                        cfg_mod.tree))
+                for k in sorted(set(schema) - perf_keys):
+                    out.append(fmod.finding(
+                        "SC317",
+                        f"fusion.CONFIG_KEYS accepts `{k}` but "
+                        "config.default_config() declares no "
+                        f"`[perf] {k}`", fmod.tree))
+        # extends SC309: an `execute_traced` override advertises the
+        # kernel as trace-composable, but the planner's fusability gate
+        # keys on cost() — without it the kernel silently never fuses
+        for mod in project.modules:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                names = {b.name for b in node.body
+                         if isinstance(b, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef))}
+                if "execute_traced" in names and "execute" in names \
+                        and "cost" not in names:
+                    out.append(mod.finding(
+                        "SC317",
+                        f"kernel `{node.name}` overrides "
+                        "execute_traced (fusion trace hook) but "
+                        "declares no cost() descriptor — the planner's "
+                        "fusability gate keys on cost(), so this "
+                        "kernel can never fuse; declare one or drop "
+                        "the override", node))
         return out
 
     # -- SC306 / SC307 ---------------------------------------------------

@@ -58,6 +58,16 @@ def default_config() -> Dict[str, Any]:
             # smallest keyframe-interval multiple >= 32 so pages map
             # onto GOP-decodable units.
             "frame_cache_page_frames": 0,
+            # whole-pipeline XLA fusion (graph/fusion.py): chains of
+            # consecutive fusable device ops compile into ONE jitted
+            # program per bucket, so op-boundary intermediates never
+            # materialize in HBM.  On by default; SCANNER_TPU_FUSION=0
+            # overrides per process (the staged-path A/B lever).
+            "fusion_enabled": True,
+            # minimum chain length the fusion planner will fuse (a
+            # singleton IS the staged path; raise to bound planner
+            # aggressiveness).
+            "fusion_min_chain": 2,
         },
         "memory": {
             # memory observability (util/memstats.py): per-device HBM
@@ -261,6 +271,20 @@ class Config:
         """Frames per frame-cache page (0 = keyframe-aligned auto)."""
         return int(self.config.get("perf", {}).get(
             "frame_cache_page_frames", 0))
+
+    @property
+    def fusion_enabled(self) -> bool:
+        """Whole-pipeline XLA fusion of device op chains (the
+        deployment default; SCANNER_TPU_FUSION overrides per
+        process)."""
+        return bool(self.config.get("perf", {}).get("fusion_enabled",
+                                                    True))
+
+    @property
+    def fusion_min_chain(self) -> int:
+        """Minimum member count the fusion planner fuses (>= 2)."""
+        return int(self.config.get("perf", {}).get("fusion_min_chain",
+                                                   2))
 
     @property
     def memstats_enabled(self) -> bool:

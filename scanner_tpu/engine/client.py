@@ -125,6 +125,12 @@ class Client:
                 set_enabled(cfg.frame_cache_enabled)
             set_capacity_mb(cfg.frame_cache_mb)
             set_page_frames(cfg.frame_cache_page_frames)
+            # [perf] fusion_*: whole-pipeline XLA fusion defaults; the
+            # SCANNER_TPU_FUSION env var (read at import) wins when set
+            from ..graph import fusion as _fusion_cfg
+            if not os.environ.get("SCANNER_TPU_FUSION"):
+                _fusion_cfg.set_enabled(cfg.fusion_enabled)
+            _fusion_cfg.set_min_chain(cfg.fusion_min_chain)
             # [alerts] section: health/SLO engine default + user rules;
             # the SCANNER_TPU_HEALTH env var (read at import) wins
             from ..util import health as _health_cfg

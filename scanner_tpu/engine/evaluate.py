@@ -40,6 +40,7 @@ import numpy as np
 from ..common import (DeviceType, GraphException, JobException, NullElement,
                       ScannerException, SliceList)
 from ..graph import analysis as A
+from ..graph import fusion as _fusion
 from ..graph import ops as O
 from ..util import coststats as _cs
 from ..util import memstats as _ms
@@ -349,12 +350,11 @@ class KernelInstance:
             self.kernel.setup_with_resources()
             self._did_setup = True
 
-    def bind_stream(self, job_idx: int, slice_group: int) -> None:
-        """Call new_stream when the (job, slice group) changes
-        (reference evaluate_worker.cpp:640-707 per-slice arg rebinding)."""
-        key = (job_idx, slice_group)
-        if key == self._cur_stream:
-            return
+    def stream_args(self, job_idx: int, slice_group: int) -> dict:
+        """The per-stream kwargs new_stream would receive for this
+        (job, slice group) — also the trace-affecting part of a fused
+        chain's program key (e.g. Resize bakes width/height into the
+        jitted body at trace time)."""
         args = {}
         for name, per_stream in self.node.job_args.items():
             if name not in self.spec.stream_arg_names:
@@ -363,7 +363,15 @@ class KernelInstance:
             if isinstance(v, SliceList):
                 v = v[slice_group]
             args[name] = v
-        self.kernel.new_stream(**args)
+        return args
+
+    def bind_stream(self, job_idx: int, slice_group: int) -> None:
+        """Call new_stream when the (job, slice group) changes
+        (reference evaluate_worker.cpp:640-707 per-slice arg rebinding)."""
+        key = (job_idx, slice_group)
+        if key == self._cur_stream:
+            return
+        self.kernel.new_stream(**self.stream_args(job_idx, slice_group))
         self.kernel.reset()
         self._cur_stream = key
         self._last_row = None
@@ -475,6 +483,317 @@ class KernelInstance:
         self.kernel.close()
 
 
+# shared fused-chain programs, keyed on everything that affects the
+# trace: member op identity, init args, stream-bound args, and window
+# layout.  Evaluators are constructed per task on the non-pipelined
+# path, so a per-instance jax.jit closure would recompile the chain
+# every task while staged members amortize through their module-level
+# @jax.jit impls — this cache gives chains the same amortization.
+# Entries own FROZEN kernel objects built from the node spec (never
+# the live evaluator's kernels: those rebind stream args, and a
+# later retrace through a mutated kernel would poison the entry).
+_CHAIN_PROGRAMS: Dict[Tuple, Any] = {}
+_CHAIN_PROGRAMS_LOCK = threading.Lock()
+
+
+def _build_chain_program(nodes: List[O.OpNode],
+                         stream_args: List[dict],
+                         windows: List[int]):
+    """One jitted callable for a chain: cache-owned kernels constructed
+    from the canonical factories, stream-bound once, composed
+    head->tail inside a single trace."""
+    import jax
+    kernels = []
+    for node, sargs in zip(nodes, stream_args):
+        factory = O.registry.canonical_factory(node.spec)
+        cfg = O.KernelConfig(device=node.effective_device(),
+                             args=dict(node.init_args), devices=[])
+        k = factory(cfg, **node.init_args)
+        k.fetch_resources()
+        k.setup_with_resources()
+        if sargs:
+            k.new_stream(**sargs)
+        k.reset()
+        kernels.append(k)
+
+    def chain_fn(y):
+        for k, win in zip(kernels, windows):
+            if win:
+                y = y.reshape((y.shape[0] // win, win)
+                              + tuple(y.shape[1:]))
+            y = k.execute_traced(y)
+        return y
+
+    return jax.jit(chain_fn)
+
+
+class FusedKernelInstance:
+    """One planned fusion chain (graph/fusion.py) compiled as a SINGLE
+    jitted program: the member kernels' `execute_traced` bodies compose
+    inside one trace, so XLA fuses across op boundaries and member
+    intermediates never materialize in HBM (they only exist as values
+    inside the fused executable).  The chain dispatches at its TAIL
+    node with ONE bucket ladder for the whole chain — per (device,
+    shape, dtype) signature the chain mints ONE executable where the
+    staged path minted len(chain).
+
+    Mirrors KernelInstance's warm-up/call-lock protocol so the
+    evaluator's precompile thread, ensure_warm handshake, and the
+    recompile_storm rewarm path treat chains and single kernels
+    uniformly.  All attribution (recompile proxy, pad rows, compile
+    ledger, op rows/seconds, roofline) keys on the stable chain id
+    `"a+b+c"` — member names joined head to tail."""
+
+    def __init__(self, chain: "_fusion.FusionChain",
+                 members: List[KernelInstance]):
+        self.chain = chain
+        self.members = members
+        self.chain_id = chain.chain_id
+        self.member_names = chain.member_names
+        self.head = members[0]
+        self.tail = members[-1]
+        # all members share this evaluator instance's assigned chip
+        # (the planner only fuses same-effective-device TPU runs, and
+        # the evaluator pins every TPU kernel to its own chip)
+        self.device = self.tail.device
+        self.dev_label = self.tail.dev_label
+        # per member, head->tail: window length (0 = no window axis,
+        # matching _example_args' convention for stencil == [0])
+        self.windows = chain.windows()
+        self.stencils = [np.asarray(s, np.int64) for s in chain.stencils()]
+        self.width = chain.width()
+        self._jit = None
+        # current stream-bound args per member (set by bind_stream);
+        # part of the shared-program key — a stream rebind that changes
+        # them must map to a different compiled program
+        self._stream_args: Optional[List[dict]] = None
+        self._shape_sigs: set = set()
+        # (shape, dtype) -> (chain CostDescriptor | None, bytes of
+        # member intermediates the fusion avoided materializing)
+        self._cost_cache: Dict[Tuple, Tuple] = {}
+        self._warm_lock = threading.Lock()
+        self._warm_state = "idle"
+        self._warm_done = threading.Event()
+        self._call_lock = threading.Lock()
+
+    # -- the fused program ---------------------------------------------
+
+    def _chain_fn(self, y):
+        """The whole chain as one traceable function: (k * width, ...)
+        composed-window gather of the head's input in, the tail's raw
+        traced result out.  Each windowed member folds its own window
+        axis out of the composed leading dimension — the composed
+        gather (compose_positions) laid positions out with the HEAD's
+        window innermost, so the progressive reshape walks the nesting
+        exactly."""
+        for ki, win in zip(self.members, self.windows):
+            if win:
+                y = y.reshape((y.shape[0] // win, win)
+                              + tuple(y.shape[1:]))
+            y = ki.kernel.execute_traced(y)
+        return y
+
+    def _fn(self):
+        if self._jit is not None:
+            return self._jit
+        if self._stream_args is not None:
+            key = tuple(
+                (ki.spec.name,
+                 f"{type(ki.kernel).__module__}."
+                 f"{type(ki.kernel).__qualname__}",
+                 repr(sorted(ki.node.init_args.items())),
+                 repr(sorted(sargs.items())), win)
+                for ki, sargs, win in zip(self.members,
+                                          self._stream_args,
+                                          self.windows))
+            with _CHAIN_PROGRAMS_LOCK:
+                fn = _CHAIN_PROGRAMS.get(key)
+            if fn is None:
+                try:
+                    fn = _build_chain_program(
+                        [ki.node for ki in self.members],
+                        self._stream_args, self.windows)
+                except Exception:  # noqa: BLE001 — fall back per instance
+                    _log.debug("shared program build failed for chain "
+                               "%s", self.chain_id, exc_info=True)
+                    fn = None
+                if fn is not None:
+                    with _CHAIN_PROGRAMS_LOCK:
+                        fn = _CHAIN_PROGRAMS.setdefault(key, fn)
+            if fn is not None:
+                self._jit = fn
+                return fn
+        import jax
+        self._jit = jax.jit(self._chain_fn)
+        return self._jit
+
+    def execute(self, arr):
+        """One fused call: jitted chain body, then the tail's host-side
+        finish() outside the trace (the staged path's post-jit tail)."""
+        return self.tail.kernel.finish(self._fn()(arr))
+
+    def bind_stream(self, job_idx: int, slice_group: int) -> None:
+        sargs = []
+        for ki in self.members:
+            ki.bind_stream(job_idx, slice_group)
+            sargs.append(ki.stream_args(job_idx, slice_group))
+        if sargs != self._stream_args:
+            self._stream_args = sargs
+            self._jit = None
+
+    def compose_positions(self, rows: np.ndarray, max_in: int) -> np.ndarray:
+        """Head-input read positions for tail compute rows `rows`: the
+        member stencils composed tail-first, REPEAT_EDGE-clamped at
+        EVERY level — exactly the staged pipeline's transitive backward
+        dilation (graph/analysis.py derive_task_streams), so the fused
+        gather reads precisely the rows the staged members would have.
+        Returns a flat (len(rows) * width,) position array."""
+        pos = np.asarray(rows, np.int64)
+        for sten, win in zip(reversed(self.stencils),
+                             reversed(self.windows)):
+            if win:
+                pos = np.clip(pos[:, None] + sten[None, :], 0,
+                              max_in - 1).reshape(-1)
+        return pos
+
+    # -- chain cost model ----------------------------------------------
+
+    def cost_for(self, shape, dtype):
+        """Analytical chain descriptor for a head-input signature:
+        member costs summed via stepwise shape inference
+        (jax.eval_shape walks the chain without running it), with
+        bytes_in/bytes_out taken at the chain BOUNDARY — the fused
+        program touches HBM only there.  Also returns the member
+        intermediate bytes fusion avoided (every non-tail output +
+        every non-head input stays on-chip).  Cached per signature;
+        (None, 0.0) when any member lacks a cost model."""
+        key = (tuple(shape), str(dtype))
+        hit = self._cost_cache.get(key)
+        if hit is not None:
+            return hit
+        desc, saved = None, 0.0
+        try:
+            import jax
+            aval = jax.ShapeDtypeStruct(tuple(shape), dtype)
+            descs = []
+            last = len(self.members) - 1
+            for i, (ki, win) in enumerate(zip(self.members, self.windows)):
+                shp = tuple(aval.shape)
+                if win:
+                    shp = (shp[0] // win, win) + shp[1:]
+                    aval = jax.ShapeDtypeStruct(shp, aval.dtype)
+                d = ki.kernel.cost([shp])
+                if isinstance(d, dict):
+                    d = _cs.CostDescriptor(**d)
+                descs.append(d)
+                if i < last:
+                    aval = jax.eval_shape(ki.kernel.execute_traced, aval)
+            if descs and all(d is not None for d in descs):
+                desc = _cs.CostDescriptor(
+                    flops=sum(float(d.flops or 0.0) for d in descs),
+                    bytes_in=descs[0].bytes_in,
+                    bytes_out=descs[-1].bytes_out,
+                    source="hook")
+                saved = (sum(float(d.bytes_out or 0.0)
+                             for d in descs[:-1])
+                         + sum(float(d.bytes_in or 0.0)
+                               for d in descs[1:]))
+        except Exception:  # noqa: BLE001 — cost attribution is optional
+            _log.debug("chain cost model failed for %s", self.chain_id,
+                       exc_info=True)
+        self._cost_cache[key] = (desc, saved)
+        return desc, saved
+
+    # -- chain batch cap / warm-up -------------------------------------
+
+    def cap_for(self, wp: Optional[int]) -> int:
+        """Per-call batch cap for the CHAIN: walking tail->head, member
+        i runs at (tail rows x its downstream window expansion) rows
+        per call, so its own cap (the same work-packet derivation as
+        _run_kernel) divides down by that expansion.  The chain takes
+        the tightest bound — no member ever sees a call larger than it
+        would have accepted staged."""
+        cap = None
+        exp = 1
+        for ki, win in zip(reversed(self.members),
+                           reversed(self.windows)):
+            n = ki.node
+            if n.batch is None and wp:
+                mcap = max(1, min(n.effective_batch(), int(wp)))
+            else:
+                mcap = max(1, n.effective_batch())
+            c = max(1, mcap // max(1, exp))
+            cap = c if cap is None else min(cap, c)
+            exp *= max(win, 1)
+        return max(1, cap if cap is not None else 1)
+
+    def warmable(self) -> bool:
+        """Generic warm-up synthesizes head frames at source geometry:
+        needs a frame head input reachable from Input through builtins
+        only (same eligibility as single-kernel warm-up)."""
+        n = self.head.node
+        return bool(n.spec.input_columns
+                    and n.spec.input_columns[0][1]
+                    and _source_geometry_inputs(n))
+
+    def precompile(self, ladder: Sequence[int], h: int, w: int) -> None:
+        """Compile the fused program at every chain-ladder bucket (one
+        ladder for the WHOLE chain — this is the warm-up the staged
+        path would have run once per member)."""
+        with self._warm_lock:
+            if self._warm_state != "pending":
+                return
+            self._warm_state = "running"
+        t0 = time.time()
+        try:
+            # bind job-0 stream args first: members like Resize get
+            # their geometry from new_stream, and an unbound warm-up
+            # would compile a degenerate (e.g. 0x0-output) program the
+            # real calls never use.  The real dispatch rebinds only if
+            # its (job, slice group) differs, so the warmed executable
+            # survives into the first call.
+            try:
+                self.bind_stream(0, 0)
+            except Exception:  # noqa: BLE001 — warm-up is best-effort
+                _log.debug("warm-up stream bind failed for chain %s",
+                           self.chain_id, exc_info=True)
+            for b in ladder:
+                arr = np.zeros((b * self.width, h, w, 3), np.uint8)
+                if self.device is not None:
+                    import jax
+                    arr = jax.device_put(arr, self.device)
+                    _ms.track_array(arr, "warmup", device=self.dev_label)
+                try:
+                    with self._call_lock, \
+                            _cs.observe_compiles(self.chain_id,
+                                                 self.dev_label, b,
+                                                 f"warmup:b{b}",
+                                                 members=self.member_names):
+                        self.execute(arr)
+                except Exception:  # noqa: BLE001 — warm-up is best-effort
+                    _log.debug("precompile of chain %s at batch %d "
+                               "failed", self.chain_id, b, exc_info=True)
+                    return
+            _M_OP_PRECOMPILE.labels(op=self.chain_id,
+                                    device=self.dev_label).set(
+                time.time() - t0)
+        finally:
+            with self._warm_lock:
+                self._warm_state = "done"
+            self._warm_done.set()
+
+    def ensure_warm(self) -> None:
+        """Same handshake as KernelInstance.ensure_warm."""
+        with self._warm_lock:
+            if self._warm_state == "pending":
+                self._warm_state = "done"
+                self._warm_done.set()
+                return
+            if self._warm_state != "running":
+                return
+        self._warm_done.wait()
+
+
 # every live TaskEvaluator, weakly held: the recompile_storm
 # remediation playbook (engine/controller.py) re-warms bucket ladders
 # process-wide through rewarm_all() without owning evaluator lifetimes
@@ -530,6 +849,20 @@ class TaskEvaluator:
                 self.kernels[n.id] = ki
         for ki in self.kernels.values():
             ki.setup(fetch=not skip_fetch_resources)
+        # whole-pipeline fusion (graph/fusion.py): maximal runs of
+        # fusable consecutive device ops execute as ONE jitted program.
+        # Non-tail members never dispatch (or materialize an output
+        # column) on their own — the tail node runs the whole chain.
+        self.chains: Dict[int, "_fusion.FusionChain"] = {}
+        self.fused: Dict[int, FusedKernelInstance] = {}
+        self._chain_member_ids: set = set()
+        if _fusion.enabled():
+            for ch in _fusion.plan_chains(info):
+                self.chains[ch.tail.id] = ch
+                self.fused[ch.tail.id] = FusedKernelInstance(
+                    ch, [self.kernels[m.id] for m in ch.members])
+                for m in ch.members[:-1]:
+                    self._chain_member_ids.add(m.id)
         # bucket-ladder warm-up: compile every device op's ladder shapes
         # on a background thread so the compiles overlap the first
         # task's decode instead of stalling its evaluation.  `precompile`
@@ -551,13 +884,15 @@ class TaskEvaluator:
         _LIVE_EVALUATORS.add(self)
 
     def _warm_targets(self, precompile: Tuple[int, int, int]
-                      ) -> List[Tuple["KernelInstance", List[int]]]:
+                      ) -> List[Tuple[Any, List[int]]]:
         """The warm-up-eligible kernels and their ladders (shared by
         the constructor warm-up and rewarm)."""
         _h, _w, wp = precompile
-        targets: List[Tuple[KernelInstance, List[int]]] = []
+        targets: List[Tuple[Any, List[int]]] = []
         for ki in self.kernels.values():
             n = ki.node
+            if n.id in self._chain_member_ids or n.id in self.chains:
+                continue  # fused members warm as one chain, below
             if n.effective_device() != DeviceType.TPU \
                     or n.effective_batch() <= 1 \
                     or ki.spec.is_stateful or ki.spec.variadic \
@@ -569,6 +904,11 @@ class TaskEvaluator:
             else:
                 cap = max(1, n.effective_batch())
             targets.append((ki, bucket_ladder(cap)))
+        # fused chains warm their ONE chain ladder (precompile is
+        # polymorphic over KernelInstance / FusedKernelInstance)
+        for fki in self.fused.values():
+            if fki.warmable():
+                targets.append((fki, bucket_ladder(fki.cap_for(wp))))
         return targets
 
     def _spawn_warm(self, targets, precompile) -> None:
@@ -597,7 +937,7 @@ class TaskEvaluator:
         if hint is None or not _precompile_enabled() \
                 or not _bucketing_enabled():
             return 0
-        claimed: List[Tuple[KernelInstance, List[int]]] = []
+        claimed: List[Tuple[Any, List[int]]] = []
         for ki, ladder in self._warm_targets(hint):
             with ki._warm_lock:
                 if ki._warm_state in ("idle", "done"):
@@ -632,6 +972,10 @@ class TaskEvaluator:
         self.last_peak_columns = 0
 
         for n in self.info.ops:
+            if n.id in self._chain_member_ids:
+                # fused into a chain: the tail node dispatches the whole
+                # chain, this member never materializes an output column
+                continue
             ts = plan.streams[n.id]
             if n.name == O.INPUT_OP:
                 store[(n.id, "output")] = source_batches[n.id]
@@ -645,12 +989,24 @@ class TaskEvaluator:
                 src = n.input_columns()[0]
                 results[n.id] = store[(src.op.id, src.column)].take_rows(
                     ts.valid_output_rows)
+            elif n.id in self.chains:
+                outs = self._run_fused(n, jr, plan, store)
+                for col, b in outs.items():
+                    store[(n.id, col)] = b
             else:
                 outs = self._run_kernel(n, jr, plan, store)
                 for col, b in outs.items():
                     store[(n.id, col)] = b
             self.last_peak_columns = max(self.last_peak_columns, len(store))
-            for c in n.input_columns():
+            if n.id in self.chains:
+                # the whole chain's input edges are consumed here: the
+                # head's (and every member's) reads happen at tail time,
+                # and member columns themselves were never stored
+                cons_cols = [c for m in self.chains[n.id].members
+                             for c in m.input_columns()]
+            else:
+                cons_cols = n.input_columns()
+            for c in cons_cols:
                 pid = c.op.id
                 remaining[pid] -= 1
                 if remaining[pid] == 0:
@@ -1093,6 +1449,251 @@ class TaskEvaluator:
                 missing = sorted(valid_set - got)
                 raise JobException(
                     f"{n.name}: missing output rows {missing[:5]}...")
+        return outputs
+
+    # -- fused chains ---------------------------------------------------
+
+    def _run_fused(self, n: O.OpNode, jr: A.JobRows, plan: A.TaskPlan,
+                   store) -> Dict[str, ColumnBatch]:
+        """Dispatch one fused chain at its tail node `n`: gather the
+        composed stencil window from the HEAD member's input column,
+        run the single jitted chain program through the chain's bucket
+        ladder, and emit only the tail's outputs — member intermediates
+        never materialize.  Chain-level row semantics reproduce the
+        staged path exactly: REPEAT_EDGE padding at every member level
+        (compose_positions), null propagation over the composed window
+        (a tail row is null iff ANY transitively-read input row is
+        null), bucketed tail-chunk padding, nulls-last assembly."""
+        chain = self.chains[n.id]
+        fki = self.fused[n.id]
+        ts = plan.streams[n.id]
+        fki.bind_stream(plan.job_idx, plan.slice_group)
+
+        head = chain.head
+        in_col = head.input_columns()[0]
+        in_b = store[(in_col.op.id, in_col.column)]
+        g = plan.slice_group if self.info.slice_level[n.id] > 0 else 0
+        max_in = jr.rows[in_col.op.id][g]
+
+        # one chain-wide batch cap (see FusedKernelInstance.cap_for)
+        wp = int(getattr(jr, "work_packet_size", 0) or 0)
+        batch = fki.cap_for(wp)
+        use_buckets = _bucketing_enabled()
+        ladder = bucket_ladder(batch) if use_buckets else None
+
+        # device staging: ONE host->device move for the head column —
+        # the only HBM traffic the whole chain pays on the input side
+        if _device_staging_enabled() and isinstance(in_b.data, np.ndarray) \
+                and in_b.data.dtype != object:
+            in_b = in_b.to_device(fki.device)
+        if in_b.convert is not None:
+            in_b = in_b.converted()
+        store[(in_col.op.id, in_col.column)] = in_b
+
+        compute = np.asarray(ts.compute_rows, np.int64)
+        out_cols = [c for c, _ in n.spec.output_columns]
+        valid_out = np.asarray(ts.valid_output_rows, np.int64)
+        valid_set = set(valid_out.tolist())
+
+        # composed window positions per tail compute row (REPEAT_EDGE
+        # at every member level = the staged transitive dilation)
+        width = fki.width
+        win_rows = fki.compose_positions(compute, max_in).reshape(
+            len(compute), width)
+        col_pos = in_b.positions(win_rows.reshape(-1)).reshape(
+            win_rows.shape)
+
+        # null propagation across the whole chain in one step
+        null_in = np.zeros(len(compute), bool)
+        if in_b.nulls is not None:
+            null_in |= in_b.nulls[col_pos].any(axis=1)
+        mask_nulls = use_buckets and (in_b.nulls is None
+                                      or is_array_data(in_b.data))
+
+        out_parts: Dict[str, List[ColumnBatch]] = {c: [] for c in out_cols}
+
+        def emit(col: str, rows: np.ndarray, data, per_row: bool) -> None:
+            keep = np.isin(rows, valid_out)
+            if not keep.any():
+                return
+            if per_row:
+                kept = [d for d, k in zip(data, keep) if k]
+                out_parts[col].append(
+                    ColumnBatch.from_elements(rows[keep], kept))
+            else:
+                if keep.all():
+                    out_parts[col].append(ColumnBatch(rows, data))
+                else:
+                    idx = np.flatnonzero(keep)
+                    out_parts[col].append(
+                        ColumnBatch(rows[keep], data[idx]))
+
+        def emit_result(rows: np.ndarray, res) -> None:
+            if len(out_cols) == 1:
+                cols_res = (res,)
+            elif isinstance(res, tuple) and len(res) == len(out_cols):
+                cols_res = res
+            elif (isinstance(res, list) and len(res) == len(rows)
+                  and all(isinstance(r, tuple) and len(r) == len(out_cols)
+                          for r in res)):
+                cols_res = tuple(list(col) for col in zip(*res))
+            else:
+                raise JobException(
+                    f"{fki.chain_id}: expected {len(out_cols)}-tuple "
+                    f"output")
+            for col, r in zip(out_cols, cols_res):
+                if is_array_data(r) and len(r) == len(rows):
+                    emit(col, rows, r, per_row=False)
+                else:
+                    if r is None or len(r) != len(rows):
+                        raise JobException(
+                            f"{fki.chain_id}: fused chain returned "
+                            f"{0 if r is None else len(r)} results "
+                            f"for {len(rows)} inputs")
+                    emit(col, rows, list(r), per_row=True)
+
+        null_out_rows: List[int] = []
+
+        def null_rows(rows: np.ndarray) -> None:
+            keep = np.isin(rows, valid_out)
+            if keep.any():
+                null_out_rows.extend(rows[keep].tolist())
+
+        def call_data(sel: np.ndarray):
+            """The head-input gather for compute positions `sel`: a
+            (k * width, ...) array in composed-window order (the chain
+            body re-folds the window axes member by member)."""
+            p = col_pos[sel].reshape(-1)
+            if is_array_data(in_b.data):
+                return in_b.data[p]
+            # object column: stack per-row host data into one array
+            return np.stack([np.asarray(in_b.data[int(j)]) for j in p])
+
+        fki.ensure_warm()
+        # chains are always batched TPU dispatch by construction
+        track_cost = _cs.enabled()
+        run_secs = run_flops = run_bytes = 0.0
+        t0 = time.time()
+        try:
+            with self.profiler.span("evaluate:" + fki.chain_id,
+                                    rows=len(compute)):
+                i = 0
+                while i < len(compute):
+                    j = min(i + batch, len(compute))
+                    sel = np.arange(i, j)
+                    dead = sel[null_in[sel]]
+                    if len(dead):
+                        null_rows(compute[dead])
+                    if mask_nulls and len(dead) < len(sel):
+                        live = sel
+                    else:
+                        live = sel[~null_in[sel]]
+                    if not len(live):
+                        i = j
+                        continue
+                    exec_sel, pad = live, 0
+                    if use_buckets:
+                        pad = bucket_for(len(live), ladder) - len(live)
+                        if pad:
+                            exec_sel = np.concatenate(
+                                [live, np.repeat(live[-1:], pad)])
+                            _M_OP_PAD_ROWS.labels(
+                                op=fki.chain_id,
+                                device=fki.dev_label).inc(pad)
+                    arr = call_data(exec_sel)
+                    sig = (fki.dev_label, tuple(arr.shape),
+                           str(arr.dtype))
+                    new_sig = sig not in fki._shape_sigs
+                    if new_sig:
+                        fki._shape_sigs.add(sig)
+                        _M_OP_RECOMPILES.labels(
+                            op=fki.chain_id,
+                            device=fki.dev_label).inc()
+                        _tracing.add_event("xla.recompile",
+                                           op=fki.chain_id,
+                                           device=fki.dev_label)
+                    t_call = time.time()
+                    if new_sig and track_cost:
+                        # fresh signature: ONE ledger entry for the
+                        # whole chain, members recorded for attribution
+                        with fki._call_lock, _cs.observe_compiles(
+                                fki.chain_id, fki.dev_label,
+                                len(exec_sel), repr(sig[1:]),
+                                members=fki.member_names):
+                            res = fki.execute(arr)
+                        res = _cs.block_until_ready(res)
+                    else:
+                        with fki._call_lock:
+                            res = fki.execute(arr)
+                    if track_cost and not new_sig:
+                        res = _cs.block_until_ready(res)
+                        call_s = time.time() - t_call
+                        desc, saved = fki.cost_for(arr.shape, arr.dtype)
+                        cls = _cs.record_op_call(
+                            fki.chain_id, fki.dev_label,
+                            len(exec_sel), len(live), call_s, desc)
+                        if cls is not None:
+                            _fusion.chain_metrics_for(
+                                fki.chain_id, fki.dev_label,
+                                len(exec_sel), cls, saved)
+                        if desc is not None:
+                            run_secs += call_s
+                            run_flops += desc.flops or 0.0
+                            run_bytes += desc.bytes_total
+                    if pad:
+                        res = _strip_pad(res, len(live), len(out_cols))
+                    emit_result(compute[live], res)
+                    i = j
+                if run_secs > 0:
+                    cls = _cs.classify(fki.dev_label, run_flops or None,
+                                       run_bytes, run_secs)
+                    if cls is not None:
+                        # straggler attribution for the fused span;
+                        # the chain attr lets timeline consumers group
+                        # fusion events without parsing op labels
+                        _tracing.add_event(
+                            "op.efficiency", op=fki.chain_id,
+                            chain=fki.chain_id,
+                            device=fki.dev_label,
+                            eff=round(cls["eff"], 6),
+                            bound=cls["bound"])
+        except BaseException as e:
+            if _ms.is_oom(e):
+                _ms.note_oom(e, site="dispatch",
+                             detail=f"chain {fki.chain_id} on "
+                                    f"{fki.dev_label}")
+            raise
+        _M_OP_ROWS.labels(op=fki.chain_id).inc(len(compute))
+        _M_OP_SECONDS.labels(op=fki.chain_id).inc(time.time() - t0)
+
+        # assembly: identical to _run_kernel (nulls LAST so they win)
+        null_set = set(null_out_rows)
+        outputs: Dict[str, ColumnBatch] = {}
+        for col in out_cols:
+            parts = out_parts[col]
+            if not parts and not null_set:
+                outputs[col] = ColumnBatch(np.zeros(0, np.int64), [])
+                continue
+            if null_set:
+                by_row: Dict[int, Elem] = {}
+                for p in parts:
+                    for r, e in zip(p.rows.tolist(), p.elements()):
+                        by_row[r] = e
+                for r in null_set:
+                    by_row[int(r)] = NullElement()
+                rows_sorted = np.asarray(sorted(by_row), np.int64)
+                outputs[col] = ColumnBatch.from_elements(
+                    rows_sorted, [by_row[int(r)] for r in rows_sorted])
+            else:
+                parts.sort(
+                    key=lambda p: int(p.rows[0]) if len(p.rows) else 0)
+                outputs[col] = concat_batches(parts)
+            got = set(outputs[col].rows.tolist())
+            if got != valid_set:
+                missing = sorted(valid_set - got)
+                raise JobException(
+                    f"{fki.chain_id}: missing output rows "
+                    f"{missing[:5]}...")
         return outputs
 
 

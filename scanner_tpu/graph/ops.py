@@ -58,6 +58,26 @@ class Kernel:
     def execute(self, *cols, **kwcols):
         raise NotImplementedError
 
+    def execute_traced(self, *cols):
+        """Trace-safe core of ``execute()`` for whole-pipeline fusion
+        (graph/fusion.py + engine/evaluate.py FusedKernelInstance): the
+        engine composes consecutive members' ``execute_traced`` bodies
+        into ONE jitted program, so this must accept/return jax arrays
+        and stay pure under tracing (no host-side conversion, no
+        per-row python results).  The default delegates to
+        ``execute()`` — correct for kernels whose execute body is
+        already pure jax; kernels with a host-side tail (e.g. a
+        float-list conversion) override this with the traced core and
+        put the conversion in ``finish()``."""
+        return self.execute(*cols)
+
+    def finish(self, result):
+        """Host-side tail conversion applied OUTSIDE the fused jit to
+        the chain-tail kernel's ``execute_traced`` result, restoring
+        the exact ``execute()`` result protocol (identity by
+        default)."""
+        return result
+
     def precompile_input(self, name: str):
         """Optional warm-up hook for the engine's bucket-ladder
         precompile (engine/evaluate.py): return one example row for the
@@ -414,7 +434,8 @@ class OpNode:
                  batch: Optional[int] = None,
                  warmup: Optional[int] = None,
                  extra: Optional[Dict[str, Any]] = None,
-                 init_args: Optional[Dict[str, Any]] = None):
+                 init_args: Optional[Dict[str, Any]] = None,
+                 fuse: Optional[bool] = None):
         self.name = name
         self.inputs = inputs
         self.job_args = job_args or {}     # per-stream op args (length = #jobs)
@@ -423,6 +444,10 @@ class OpNode:
         self.stencil = stencil
         self.batch = batch
         self.warmup = warmup
+        # whole-pipeline fusion override (graph/fusion.py): False pins
+        # this node to staged dispatch (a chain boundary); None/True
+        # leave the planner's eligibility + cost decision in charge
+        self.fuse = fuse
         self.extra = extra or {}           # builtin payload (sampler kind etc.)
         self.id = OpNode._counter[0]
         OpNode._counter[0] += 1
@@ -498,6 +523,7 @@ class OpGenerator:
             stencil = kwargs.pop("stencil", None)
             batch = kwargs.pop("batch", None)
             warmup = kwargs.pop("bounded_state", None)
+            fuse = kwargs.pop("fuse", None)
             inputs: Dict[str, Union[OpColumn, List[OpColumn]]] = {}
             job_args: Dict[str, List[Any]] = {}
             init_args: Dict[str, Any] = {}
@@ -534,7 +560,7 @@ class OpGenerator:
                     init_args[k] = v
             node = OpNode(name, inputs, job_args=job_args, device=device,
                           stencil=stencil, batch=batch, warmup=warmup,
-                          init_args=init_args)
+                          init_args=init_args, fuse=fuse)
             if len(node.outputs) == 1:
                 return node.outputs[0]
             return node  # caller selects columns via node['col']

@@ -52,6 +52,46 @@ def _histogram_impl(frames: jnp.ndarray, bins: int = HISTOGRAM_BINS):
     return counts.reshape(b, c, bins)
 
 
+def _histogram_seq_impl(frames: jnp.ndarray, bins: int = HISTOGRAM_BINS):
+    """(batch, H, W, C) uint8 -> (batch, C, bins) int32 via one
+    compare+sum pass per bin inside a lax.scan: no scatter and no
+    materialized (B, P, C, bins) one-hot (that bool tensor costs ~5x on
+    XLA CPU — 80 ms vs 16 ms at 8x240x320, measured 2026-08).  The scan
+    over bin ids — rather than an unrolled python loop — is load-bearing
+    for FUSION chains: `vals` becomes a loop invariant XLA must
+    materialize ONCE, where an unrolled loop leaves 16 sibling
+    compare+reduce consumers and XLA CPU re-fuses the whole upstream
+    producer (e.g. a composed Blur) into every one of them — it also
+    deletes optimization_barrier, so this loop structure is the only
+    reliable fence.  This is the lowering fused chains trace on
+    host-only backends, where Histogram's numpy bincount fast path is
+    unreachable inside a jit.
+
+    The per-bin reduce is hierarchical: uint8 partial sums over 128-wide
+    chunks (128 matches fit uint8), then an int32 reduce over the tiny
+    partials.  A direct int32 reduce converts every compare result to 4
+    bytes first, quadrupling accumulate traffic — 14.4 ms vs 4.4 ms at
+    8x240x320 on XLA CPU (measured 2026-08).  Assumes bins < 255 (the
+    chunk padding uses 255 as a never-matches bin id)."""
+    b, c = frames.shape[0], frames.shape[-1]
+    vals = ((frames.astype(jnp.int32) * bins) // 256).astype(jnp.uint8)
+    vals = vals.reshape(b, -1, c).transpose(0, 2, 1)    # (B, C, P)
+    chunk = 128
+    pad = (-vals.shape[-1]) % chunk
+    if pad:
+        vals = jnp.pad(vals, ((0, 0), (0, 0), (0, pad)),
+                       constant_values=255)
+    vals = vals.reshape(b, c, -1, chunk)
+    ids = jnp.arange(bins, dtype=jnp.uint8)
+
+    def _bin(carry, i):
+        part = (vals == i).sum(3, dtype=jnp.uint8)
+        return carry, part.astype(jnp.int32).sum(2)
+
+    _, cols = jax.lax.scan(_bin, 0, ids)
+    return jnp.moveaxis(cols, 0, -1)
+
+
 @functools.partial(jax.jit, static_argnames=("bins",))
 def _histogram_cmp_impl(frames: jnp.ndarray, bins: int = HISTOGRAM_BINS):
     """(batch, H, W, C) uint8 -> (batch, C, bins) int32 via one-hot
@@ -143,13 +183,73 @@ class Histogram(Kernel):
             return _histogram_cmp_impl(jnp.asarray(frame))
         return _histogram_impl(jnp.asarray(frame))
 
+    def execute_traced(self, frame):
+        """Fusion-chain core: inside a composed trace the numpy fast
+        path is unreachable (the input is a tracer), and the bincount
+        lowering serializes on scatter on every backend.  TPU traces
+        the measured-fast compare+sum; hosts and other accelerators the
+        per-bin compare+sum (see _histogram_seq_impl)."""
+        frame = jnp.asarray(frame)
+        if self._on_tpu:
+            return _histogram_cmp_impl(frame)
+        return _histogram_seq_impl(frame)
+
+
+def _resize_band(in_size: int, out_size: int):
+    """Contiguous tap indices + normalized triangle weights for one
+    axis of a separable bilinear resize (half-pixel centers, antialias
+    width max(scale, 1) — the jax.image.resize bilinear kernel).  Every
+    output row reads the same small tap count k, so the resize lowers
+    to k weighted gathers per axis instead of a dense contraction."""
+    scale = in_size / out_size
+    centers = (np.arange(out_size) + 0.5) * scale - 0.5
+    idx = np.arange(in_size)
+    wts = 1.0 - np.abs(centers[:, None] - idx[None, :]) / max(scale, 1.0)
+    wts = np.clip(wts, 0.0, None)
+    nz = wts > 0
+    k = int(nz.sum(1).max())
+    start = np.where(nz.any(1), nz.argmax(1), 0)
+    start = np.minimum(start, in_size - k)
+    taps = start[:, None] + np.arange(k)[None, :]
+    tw = np.take_along_axis(wts, taps, 1)
+    tw = (tw / tw.sum(1, keepdims=True)).astype(np.float32)
+    return jnp.asarray(taps), jnp.asarray(tw), k
+
 
 @functools.partial(jax.jit, static_argnames=("h", "w"))
 def _resize_impl(frames: jnp.ndarray, h: int, w: int):
-    b, _, _, c = frames.shape
-    out = jax.image.resize(frames.astype(jnp.float32), (b, h, w, c),
-                           method="bilinear")
-    return jnp.clip(jnp.round(out), 0, 255).astype(jnp.uint8)
+    """Separable gather-based bilinear resize.  The triangle kernel is
+    sparse — k taps per output row (k=4 for a 2x downscale) — but
+    jax.image.resize materializes it as a dense [in, out] contraction,
+    which XLA CPU executes in full: 91.7 ms vs 12.9 ms for the tap form
+    at 8x480x640 -> 240x320 (measured 2026-08).  h/w are static, so the
+    tap tables are concrete numpy at trace time.
+
+    Structure matters as much as the tap count.  The h-pass gathers
+    uint8 rows and converts AFTER the gather (converting the whole
+    input first makes XLA materialize a 4x-bigger f32 copy), and the
+    w-pass runs in a lax.scan over output row blocks with the h-pass
+    result as a loop invariant: left to itself, XLA CPU merges the two
+    passes into one 2-D gather of hk*wk taps per output element,
+    discarding separability — the loop invariant pins the h-pass to
+    one materialization (8.4 ms -> 6.4 ms alone, and it is what keeps
+    fused chains from re-fusing the resize into downstream taps)."""
+    b, c = frames.shape[0], frames.shape[-1]
+    hi, hw_, hk = _resize_band(frames.shape[1], h)
+    wi, ww_, wk = _resize_band(frames.shape[2], w)
+    y = sum(hw_[:, j, None, None] * frames[:, hi[:, j], :, :]
+            .astype(jnp.float32) for j in range(hk))
+    rb = min(48, h)
+    nb = -(-h // rb)
+    y = jnp.pad(y, ((0, 0), (0, nb * rb - h), (0, 0), (0, 0)))
+
+    def _block(carry, s):
+        ys = jax.lax.dynamic_slice_in_dim(y, s, rb, 1)
+        o = sum(ww_[:, j, None] * ys[:, :, wi[:, j], :] for j in range(wk))
+        return carry, jnp.clip(jnp.round(o), 0, 255).astype(jnp.uint8)
+
+    _, blocks = jax.lax.scan(_block, 0, jnp.arange(nb) * rb)
+    return jnp.moveaxis(blocks, 0, 1).reshape(b, nb * rb, w, c)[:, :h]
 
 
 @register_op(device=DeviceType.TPU, batch=16)
@@ -262,17 +362,40 @@ def _gaussian_kernel1d(ksize: int, sigma: float) -> np.ndarray:
 
 @functools.partial(jax.jit, static_argnames=("ksize",))
 def _blur_impl(frames: jnp.ndarray, kern: jnp.ndarray, ksize: int):
-    # separable gaussian via depthwise conv; frames (b,h,w,c) float32
+    """Separable gaussian as shift-add: per tap, one scaled slice of the
+    edge-padded image, summed — pure elementwise VPU work.  The previous
+    depthwise conv_general_dilated lowering (batch*channel images of ONE
+    feature each) hits XLA CPU's scalar conv path and ran 28x slower at
+    the 8x240x320 bench geometry (195 ms vs 7 ms, measured 2026-08);
+    one-feature convs are equally hostile to the TPU MXU.
+
+    The shift-add runs inside a lax.scan over output ROW BLOCKS with the
+    padded input as a loop invariant.  That structure is load-bearing
+    for fusion chains: XLA CPU's loop fusion duplicates a producer into
+    every sibling consumer (it also deletes optimization_barrier), so a
+    composed upstream member would be recomputed once per tap slice —
+    the loop invariant pins it to ONE materialization while the taps
+    stay fully fused inside the block body.  Per-element arithmetic is
+    identical to the unfenced form (bit-exact; block rows past `h` are
+    computed on zero padding and cropped)."""
     b, h, w, c = frames.shape
-    x = frames.astype(jnp.float32).transpose(0, 3, 1, 2).reshape(b * c, 1, h, w)
     pad = ksize // 2
-    kx = kern.reshape(1, 1, 1, ksize)
-    ky = kern.reshape(1, 1, ksize, 1)
-    x = jnp.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)), mode="edge")
-    x = jax.lax.conv_general_dilated(x, kx, (1, 1), "VALID")
-    x = jax.lax.conv_general_dilated(x, ky, (1, 1), "VALID")
-    x = x.reshape(b, c, h, w).transpose(0, 2, 3, 1)
-    return jnp.clip(jnp.round(x), 0, 255).astype(jnp.uint8)
+    x = jnp.pad(frames.astype(jnp.float32),
+                ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="edge")
+    rb = min(48, h)
+    nb = -(-h // rb)
+    # out-of-bounds zero pad so the last block's slice never clamps
+    # (dynamic_slice clamps starts, which would silently shift rows)
+    x = jnp.pad(x, ((0, 0), (0, nb * rb - h), (0, 0), (0, 0)))
+
+    def _block(carry, s):
+        xs = jax.lax.dynamic_slice_in_dim(x, s, rb + 2 * pad, 1)
+        v = sum(kern[i] * xs[:, i:i + rb, :, :] for i in range(ksize))
+        o = sum(kern[j] * v[:, :, j:j + w, :] for j in range(ksize))
+        return carry, jnp.clip(jnp.round(o), 0, 255).astype(jnp.uint8)
+
+    _, blocks = jax.lax.scan(_block, 0, jnp.arange(nb) * rb)
+    return jnp.moveaxis(blocks, 0, 1).reshape(b, nb * rb, w, c)[:, :h]
 
 
 @register_op(device=DeviceType.TPU, batch=16)

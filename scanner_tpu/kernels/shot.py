@@ -32,32 +32,47 @@ class HistDiff(Kernel):
     3x16 ints."""
 
     def cost(self, shapes):
-        """Two histograms over the (b, 2, H, W, C) stencil window
-        (bins+2 flops per pixel-channel each, the Histogram model) plus
-        the per-row L1 over 2 * C * bins histogram cells.  Reads the
-        uint8 window, emits one float per row."""
+        """Two histograms over the (b, 2, ...) stencil window (bins+2
+        flops per input element, the Histogram model) plus the per-row
+        L1 over 2 * C * bins histogram cells, where C is the trailing
+        channel axis.  Reads the window once, emits one float per row.
+        Works for the classic (b, 2, H, W, C) frame window and for any
+        array window a fused chain hands this op (e.g. Histogram
+        output windows)."""
         s = _frame_shape(shapes)
-        if s is None or len(s) != 5:
+        if s is None or len(s) < 3:
             return None
-        b, win, h, w, c = s
-        px = b * win * h * w * c
+        b, c = s[0], s[-1]
+        px = 1
+        for d in s:
+            px *= d
         flops = px * (HISTOGRAM_BINS + 2) + b * 2 * c * HISTOGRAM_BINS
         return CostDescriptor(flops=float(flops), bytes_in=float(px),
                               bytes_out=float(b * 8))
 
+    def execute_traced(self, frame):
+        """Traced core: (batch, 2, ...) window in, (batch,) float32 L1
+        distances out — pure jax, so fused chains
+        (engine/evaluate.py FusedKernelInstance) can inline it.  The
+        histograms are exact small-int counts, so the float32 L1 sums
+        are exact and the host conversion in finish() is bit-stable."""
+        arr = jnp.asarray(frame)
+        prev, cur = arr[:, 0], arr[:, 1]
+        hp = _histogram_impl(prev).astype(jnp.float32)
+        hc = _histogram_impl(cur).astype(jnp.float32)
+        return jnp.abs(hp - hc).sum(axis=(1, 2))
+
+    def finish(self, result):
+        """Host tail: the per-row float list execute() always returned."""
+        return [float(x) for x in np.asarray(result)]
+
     def execute(self, frame: Sequence[Sequence[FrameType]]
                 ) -> Sequence[Any]:
         from ..engine.batch import is_array_data
-        if is_array_data(frame):
-            arr = jnp.asarray(frame)  # engine-gathered (batch, 2, H, W, C)
-            prev, cur = arr[:, 0], arr[:, 1]
-        else:
-            prev = jnp.asarray(np.stack([w[0] for w in frame]))
-            cur = jnp.asarray(np.stack([w[1] for w in frame]))
-        hp = _histogram_impl(prev).astype(jnp.float32)
-        hc = _histogram_impl(cur).astype(jnp.float32)
-        d = jnp.abs(hp - hc).sum(axis=(1, 2))
-        return [float(x) for x in np.asarray(d)]
+        if not is_array_data(frame):
+            # per-row window lists (host path): stack to (batch, 2, ...)
+            frame = np.stack([np.stack([w[0], w[1]]) for w in frame])
+        return self.finish(self.execute_traced(frame))
 
 
 @register_op(stencil=[-1, 0])

@@ -309,15 +309,18 @@ class _CompileCtx:
     observe_compiles() block, on the observing thread (XLA compiles run
     synchronously on the calling thread, so thread-local is exact)."""
 
-    __slots__ = ("op", "device", "bucket", "signature", "compiles",
-                 "pending_cache", "flops", "bytes_accessed", "arg_bytes",
-                 "out_bytes", "temp_bytes", "exec_bytes", "analyzed")
+    __slots__ = ("op", "device", "bucket", "signature", "members",
+                 "compiles", "pending_cache", "flops", "bytes_accessed",
+                 "arg_bytes", "out_bytes", "temp_bytes", "exec_bytes",
+                 "analyzed")
 
-    def __init__(self, op: str, device: str, bucket: int, signature: str):
+    def __init__(self, op: str, device: str, bucket: int, signature: str,
+                 members: Optional[Sequence[str]] = None):
         self.op = op
         self.device = device
         self.bucket = int(bucket)
         self.signature = signature
+        self.members = list(members) if members is not None else None
         self.compiles: List[Tuple[float, str]] = []  # (seconds, cache)
         self.pending_cache: Optional[str] = None
         self.flops = 0.0
@@ -456,18 +459,21 @@ def clear() -> None:
 
 
 @contextlib.contextmanager
-def observe_compiles(op: str, device: str, bucket: int, signature: str):
+def observe_compiles(op: str, device: str, bucket: int, signature: str,
+                     members: Optional[Sequence[str]] = None):
     """Attribute any XLA compile inside the block to (op, device,
     bucket): the engine wraps exactly the calls that can compile — each
     warm-up rung, and the first call of a new (device, shape, dtype)
     signature.  Nothing is recorded when no compile fires.  No-op when
-    coststats is disabled."""
+    coststats is disabled.  Fused-chain compiles pass `members` (the
+    chain's member op names, graph/fusion.py) so ledger entries under
+    the stable chain id stay explainable op by op."""
     if not _ENABLED:
         yield
         return
     install()
     prev = getattr(_tls, "ctx", None)
-    ctx = _CompileCtx(op, device, bucket, signature)
+    ctx = _CompileCtx(op, device, bucket, signature, members=members)
     _tls.ctx = ctx
     try:
         yield
@@ -505,6 +511,8 @@ def _record_compiles(ctx: _CompileCtx) -> None:
         "temp_bytes": ctx.temp_bytes or None,
         "time": time.time(), "task": task, "trace_id": trace_id,
     }
+    if ctx.members is not None:
+        entry["members"] = list(ctx.members)
     with _ledger_lock:
         _ledger_seq += 1
         entry["seq"] = _ledger_seq

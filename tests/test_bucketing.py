@@ -232,9 +232,15 @@ def test_padding_equivalence_null_interleaved(sc, monkeypatch):
 # ---------------------------------------------------------------------------
 
 def _op_counter(series: str):
+    # sum across the device label: multi-chip runs split an op's count
+    # over per-device samples, and a stale single-sample read would
+    # alias another device's (unchanging) value
     snap = registry().snapshot()
-    return {s["labels"]["op"]: s["value"]
-            for s in snap.get(series, {}).get("samples", [])}
+    out: dict = {}
+    for s in snap.get(series, {}).get("samples", []):
+        op = s["labels"]["op"]
+        out[op] = out.get(op, 0) + s["value"]
+    return out
 
 
 def test_shape_churn_guard_golden_pipeline(sc, monkeypatch):
@@ -279,6 +285,86 @@ def test_shape_churn_guard_golden_pipeline(sc, monkeypatch):
     rows2 = _load(out2)
     assert len(rows2) == 21
     assert sum(isinstance(e, NullElement) for e in rows2) == 14
+
+
+def test_shape_churn_guard_fused_chains(sc, monkeypatch):
+    """Fusion extension of the shape-churn guard (PERF.md §5 sweep, §8):
+    on the golden fusable pipeline under the same ragged-tail +
+    null-interleaved geometry sweep, (a) the fused chain's distinct
+    input-signature count stays within ITS bucket ladder — chains obey
+    the same ladder contract as single ops — and (b) the total number
+    of executables minted across the graph strictly DECREASES fused vs
+    staged: one program per chain rung replaces one per member per
+    rung."""
+    from scanner_tpu.graph import fusion
+
+    monkeypatch.delenv("SCANNER_TPU_BUCKETED", raising=False)
+    wp, io = 8, 16
+    # HistDiff (windowed, non-head) stays staged and mints its own
+    # ladder in BOTH modes; the chain covers the other three
+    cid = "Resize+Blur+Histogram"
+    members = ("Resize", "Blur", "Histogram", "HistDiff")
+
+    def sweep(tag):
+        """§5 ragged sweep: run 1 tail geometry (16,16,16,2 row tasks),
+        run 2 null-interleaved (21 rows, 14 null)."""
+        frame = sc.io.Input([NamedVideoStream(sc, "bk")])
+        small = sc.ops.Resize(frame=frame, width=[32], height=[24])
+        blur = sc.ops.Blur(frame=small, kernel_size=3, sigma=1.1)
+        hist = sc.ops.Histogram(frame=blur)
+        diff = sc.ops.HistDiff(frame=hist)
+        sc.run(sc.io.Output(diff, [NamedStream(sc, f"guard_fz_{tag}1")]),
+               PerfParams.manual(wp, io),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+        frame = sc.io.Input([NamedVideoStream(sc, "bk")])
+        spaced = sc.streams.RepeatNull(
+            sc.streams.Range(frame, [(0, 7)]), [3])
+        small = sc.ops.Resize(frame=spaced, width=[32], height=[24])
+        blur = sc.ops.Blur(frame=small, kernel_size=3, sigma=1.1)
+        hist = sc.ops.Histogram(frame=blur)
+        diff = sc.ops.HistDiff(frame=hist)
+        sc.run(sc.io.Output(diff, [NamedStream(sc, f"guard_fz_{tag}2")]),
+               PerfParams.manual(wp, io),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+
+    def minted(before, after, keys):
+        return sum(after.get(k, 0) - before.get(k, 0) for k in keys)
+
+    prev = fusion.enabled()
+    try:
+        fusion.set_enabled(True)
+        before = _op_counter("scanner_tpu_op_recompiles_total")
+        sweep("fused")
+        after = _op_counter("scanner_tpu_op_recompiles_total")
+        chain_delta = after.get(cid, 0) - before.get(cid, 0)
+        # each run builds a fresh evaluator, so two runs may each mint
+        # up to one chain ladder (cap <= wp => ladder(cap) <= ladder(wp))
+        ladder_size = len(bucket_ladder(wp))
+        assert 0 < chain_delta <= 2 * ladder_size, (
+            f"{cid}: {chain_delta} signatures across the sweep "
+            f"(<= {2 * ladder_size} allowed) — the fused path is "
+            f"re-tracing")
+        fused_total = minted(before, after, (cid,) + members)
+
+        fusion.set_enabled(False)
+        before = _op_counter("scanner_tpu_op_recompiles_total")
+        sweep("staged")
+        after = _op_counter("scanner_tpu_op_recompiles_total")
+        staged_total = minted(before, after, (cid,) + members)
+    finally:
+        fusion.set_enabled(prev)
+
+    assert fused_total < staged_total, (
+        f"fusion must strictly reduce minted executables: fused "
+        f"{fused_total} vs staged {staged_total}")
+    # fused outputs stay correct under the guard geometry (HistDiff's
+    # [-1, 0] stencil nullifies every live row whose window touches a
+    # null neighbor: of the 7 live rows only row 0 — REPEAT_EDGE-
+    # clamped onto itself — survives)
+    assert len(_load(NamedStream(sc, "guard_fz_fused1"))) == N_FRAMES
+    rows2 = _load(NamedStream(sc, "guard_fz_fused2"))
+    assert len(rows2) == 21
+    assert sum(isinstance(e, NullElement) for e in rows2) == 20
 
 
 def test_recompile_signature_includes_dtype(monkeypatch):
@@ -361,9 +447,13 @@ def test_precompile_skips_geometry_changed_inputs(sc, monkeypatch):
     """An op downstream of a geometry-changing kernel (Resize) must not
     warm at the SOURCE geometry — that would compile a ladder of
     wrong-shape executables and stall the first real call behind them.
-    First-hop consumers of source frames stay warmable."""
+    First-hop consumers of source frames stay warmable.  (Fusion off:
+    this pins the STAGED warm-up contract — fused, Resize+Histogram
+    becomes one chain that legitimately warms through the geometry
+    change; test_fusion.py covers that side.)"""
     from scanner_tpu.engine.evaluate import TaskEvaluator
     from scanner_tpu.graph import analysis as A
+    from scanner_tpu.graph import fusion
     from scanner_tpu.util.profiler import Profiler
 
     monkeypatch.setenv("SCANNER_TPU_PRECOMPILE", "1")
@@ -372,7 +462,12 @@ def test_precompile_skips_geometry_changed_inputs(sc, monkeypatch):
     hist = sc.ops.Histogram(frame=small)
     outp = sc.io.Output(hist, [NamedStream(sc, "warm_skip")])
     info = A.analyze([outp])
-    te = TaskEvaluator(info, Profiler(), precompile=(H, W, 8))
+    prev = fusion.enabled()
+    fusion.set_enabled(False)
+    try:
+        te = TaskEvaluator(info, Profiler(), precompile=(H, W, 8))
+    finally:
+        fusion.set_enabled(prev)
     try:
         states = {ki.node.name: ki._warm_state
                   for ki in te.kernels.values()}

@@ -666,6 +666,131 @@ def test_framecache_config_schema_both_directions(tmp_path):
     assert not any("frame_cache_enabled" in m for m in msgs)
 
 
+def _fusion_repo(tmp_path,
+                 declared=("scanner_tpu_fusion_a",
+                           "scanner_tpu_fusion_b"),
+                 registered=("scanner_tpu_fusion_a",
+                             "scanner_tpu_fusion_b"),
+                 doc_series=("scanner_tpu_fusion_a",
+                             "scanner_tpu_fusion_b"),
+                 cfg_keys=("fusion_enabled", "fusion_min_chain"),
+                 schema_keys=("fusion_enabled", "fusion_min_chain"),
+                 with_markers=True,
+                 kernel_has_cost=True):
+    """Synthetic mini-repo for the SC317 fusion contract lints."""
+    _write(tmp_path, "setup.py", "# root marker\n")
+    regs = "\n        ".join(
+        f'_G{i} = _mx.registry().counter("{n}", "help text", '
+        f'labels=["chain"])' for i, n in enumerate(registered))
+    decl = ", ".join(f'"{n}"' for n in declared)
+    schema = ", ".join(f'"{k}"' for k in schema_keys)
+    _write(tmp_path, "pkg/graph/fusion.py", f"""
+        from ..util import metrics as _mx
+
+        {regs}
+
+        FUSION_SERIES = ({decl},)
+
+        CONFIG_KEYS = ({schema},)
+    """)
+    _write(tmp_path, "pkg/util/metrics.py", """
+        def registry():
+            return None
+    """)
+    cost = ("\n            def cost(self, shapes):\n"
+            "                return None\n" if kernel_has_cost else "")
+    _write(tmp_path, "pkg/kernels/k.py", f"""
+        class FzKernel:
+            def execute(self, frame):
+                return frame
+
+            def execute_traced(self, frame):
+                return frame
+        {cost}
+    """)
+    cfg = ", ".join(f'"{k}": 1' for k in cfg_keys)
+    _write(tmp_path, "pkg/config.py", f"""
+        def default_config():
+            return {{"perf": {{{cfg}}}}}
+    """)
+    rows = "\n".join(f"| `{n}` | counter | x |" for n in doc_series)
+    table = (f"<!-- fusion-series:begin -->\n"
+             f"| Series | Type | Meaning |\n|---|---|---|\n"
+             f"{rows}\n<!-- fusion-series:end -->\n"
+             if with_markers else rows)
+    all_series = sorted(set(declared) | set(registered) | set(doc_series))
+    keys = " ".join(f"`{k}`"
+                    for k in sorted(set(cfg_keys) | set(schema_keys)))
+    _write(tmp_path, "docs/observability.md", f"""
+        Catalog (every fixture series mentioned so SC301 stays quiet):
+        {" ".join(f"`{n}`" for n in all_series)}
+
+        Config keys documented for SC304: {keys}
+
+        {table}
+    """)
+    return tmp_path
+
+
+def test_fusion_clean_fixture_is_quiet(tmp_path):
+    _fusion_repo(tmp_path)
+    _, findings = _analyze(tmp_path, "pkg")
+    assert [f for f in findings if f.code == "SC317"] == []
+
+
+def test_fusion_series_all_pairings_both_directions(tmp_path):
+    _fusion_repo(
+        tmp_path,
+        declared=("scanner_tpu_fusion_a", "scanner_tpu_fusion_phantom"),
+        registered=("scanner_tpu_fusion_a",
+                    "scanner_tpu_fusion_unlisted"),
+        doc_series=("scanner_tpu_fusion_a", "scanner_tpu_fusion_ghost"))
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC317"]
+    assert any("scanner_tpu_fusion_unlisted" in m
+               and "missing from FUSION_SERIES" in m for m in msgs)
+    assert any("scanner_tpu_fusion_phantom" in m
+               and "registers no such series" in m for m in msgs)
+    assert any("scanner_tpu_fusion_phantom" in m
+               and "missing from" in m and "fusion-series" in m
+               for m in msgs)
+    assert any("scanner_tpu_fusion_ghost" in m
+               and "no such series" in m for m in msgs)
+    assert not any("`scanner_tpu_fusion_a`" in m for m in msgs)
+
+
+def test_fusion_missing_marker_table(tmp_path):
+    _fusion_repo(tmp_path, with_markers=False)
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC317"]
+    assert any("marker table" in m for m in msgs)
+
+
+def test_fusion_config_schema_both_directions(tmp_path):
+    _fusion_repo(
+        tmp_path,
+        cfg_keys=("fusion_enabled", "fusion_min_chain", "fusion_bogus"),
+        schema_keys=("fusion_enabled", "fusion_min_chain",
+                     "fusion_ghost_knob"))
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC317"]
+    assert any("[perf] fusion_bogus" in m and "does not accept" in m
+               for m in msgs)
+    assert any("`fusion_ghost_knob`" in m and "declares no" in m
+               for m in msgs)
+    assert not any("fusion_enabled" in m for m in msgs)
+
+
+def test_fusion_execute_traced_without_cost(tmp_path):
+    """extends SC309: a kernel advertising the fusion trace hook
+    (execute_traced) without a cost() descriptor silently never fuses
+    — the planner's fusability gate keys on cost()."""
+    _fusion_repo(tmp_path, kernel_has_cost=False)
+    _, findings = _analyze(tmp_path, "pkg")
+    msgs = [f.message for f in findings if f.code == "SC317"]
+    assert any("FzKernel" in m and "cost()" in m for m in msgs)
+
+
 def _remediation_repo(tmp_path,
                       code_pbs=(("pb_a", "rule_a"), ("pb_b", "rule_b")),
                       rule_names=("rule_a", "rule_b"),

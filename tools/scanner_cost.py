@@ -38,12 +38,15 @@ def render_ops(node: str, ops) -> list:
     lines = []
     if not ops:
         return lines
-    lines.append(f"{'OP':16} {'DEVICE':>9} {'BUCKET':>6} {'CALLS':>6} "
+    # fused chain ids ("Resize+Blur+Histogram") exceed the classic
+    # 16-char op column: widen to the longest label on screen
+    w = max(16, max(len(o["op"]) for o in ops))
+    lines.append(f"{'OP':{w}} {'DEVICE':>9} {'BUCKET':>6} {'CALLS':>6} "
                  f"{'EFF%':>7} {'BOUND':>8} {'FLOP/s':>9} {'B/s':>9} "
                  f"{'SRC':>8}")
     for o in ops:
         lines.append(
-            f"{o['op'][:16]:16} {o['device']:>9} {o['bucket']:>6} "
+            f"{o['op']:{w}} {o['device']:>9} {o['bucket']:>6} "
             f"{o['calls']:>6} {o['efficiency'] * 100:>6.1f}% "
             f"{o['bound']:>8} {_fmt_rate(o['flops_per_s']):>9} "
             f"{_fmt_rate(o['bytes_per_s']):>9} "
@@ -55,11 +58,13 @@ def render_ledger(entries, n: int) -> list:
     lines = []
     if not entries:
         return lines
-    lines.append(f"{'OP':16} {'DEVICE':>9} {'BUCKET':>6} {'CACHE':>8} "
+    shown = entries[-n:]
+    w = max(16, max(len(e["op"]) for e in shown))
+    lines.append(f"{'OP':{w}} {'DEVICE':>9} {'BUCKET':>6} {'CACHE':>8} "
                  f"{'SECONDS':>8} {'EXEC B':>9} {'FLOPS':>9} {'TASK':>8}")
-    for e in entries[-n:]:
+    for e in shown:
         lines.append(
-            f"{e['op'][:16]:16} {e['device']:>9} {e['bucket']:>6} "
+            f"{e['op']:{w}} {e['device']:>9} {e['bucket']:>6} "
             f"{e['cache']:>8} {e['compile_s']:>8.4f} "
             f"{e.get('exec_bytes') or 0:>9} "
             f"{_fmt_rate(e['flops']) if e.get('flops') else '-':>9} "

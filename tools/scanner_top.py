@@ -381,20 +381,25 @@ def render(status: dict, cur: dict, prev: dict, master: str,
     # at high EFF% needs more chips, at low EFF% a better kernel.  The
     # XCACHE column is the node's persistent-compile-cache hit rate.
     eff_rows = []
+    # fused chain ids ("a+b+c") outgrow the classic 16-char op column:
+    # size it to the widest label in this snapshot
+    opw = max([16] + [len(op) for _, d in cur["nodes"].items()
+                      for (op, _dev) in (d.get("ops") or {})])
     for node, d in sorted(cur["nodes"].items()):
         ops = d.get("ops") or {}
         hr = _hit_rate(d.get("compile") or {})
         hr_s = f"{hr * 100:.0f}%" if hr is not None else "-"
         for (op, dev), o in sorted(ops.items()):
             eff_rows.append(
-                f"{node:10} {op[:16]:16} {dev:>9} {o['bucket']:>6} "
+                f"{node:10} {op:{opw}} {dev:>9} {o['bucket']:>6} "
                 f"{o['efficiency'] * 100:>6.1f}% "
                 f"{'compute' if o['compute_bound'] else 'memory':>8} "
                 f"{o['flops_per_s'] / 1e9:>9.2f} "
                 f"{o['bytes_per_s'] / 1e9:>8.3f} {hr_s:>6}")
     if eff_rows:
         lines.append("")
-        lines.append(f"{'NODE':10} {'OP':16} {'DEVICE':>9} {'BUCKET':>6} "
+        lines.append(f"{'NODE':10} {'OP':{opw}} {'DEVICE':>9} "
+                     f"{'BUCKET':>6} "
                      f"{'EFF%':>7} {'BOUND':>8} {'GFLOP/s':>9} "
                      f"{'GB/s':>8} {'XCACHE':>6}")
         lines.extend(eff_rows)

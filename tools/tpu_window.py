@@ -802,6 +802,104 @@ print("GANG_SKEW_HW " + json.dumps(res))
 """
 
 
+_FUSION_HW = r"""
+import json, os, tempfile, time
+import jax
+from scanner_tpu import (CacheMode, Client, NamedStream, NamedVideoStream,
+                        PerfParams)
+import scanner_tpu.kernels
+from scanner_tpu import video as scv
+from scanner_tpu.graph import fusion as _fusion
+from scanner_tpu.util.metrics import registry
+
+# hardware companion to bench.py's fusion digest: the golden
+# Resize->Blur->Histogram->HistDiff pipeline staged vs fused on the
+# real chip.  On TPU the fused chain keeps intermediates in HBM-
+# resident registers/VMEM across member boundaries, so this is where
+# the paper-shaped bandwidth win (not just the engine bookkeeping win
+# the CPU capture measures) lands.
+assert jax.devices()[0].platform == "tpu"
+root = tempfile.mkdtemp(prefix="fz_hw_")
+vid = os.path.join(root, "v.mp4")
+N, W, H = 96, 640, 480
+scv.synthesize_video(vid, num_frames=N, width=W, height=H, fps=24,
+                     keyint=24)
+sc = Client(db_path=os.path.join(root, "db"))
+sc.ingest_videos([("fz_vid", vid)])
+cid = "Resize+Blur+Histogram"
+keys = (cid, "Resize", "Blur", "Histogram", "HistDiff")
+
+def _by_op(name):
+    out = {}
+    for s in registry().snapshot().get(name, {}).get("samples", []):
+        k = s["labels"].get("op", "_")
+        out[k] = out.get(k, 0.0) + s["value"]
+    return out
+
+def run_mode(mode, on):
+    prev = _fusion.enabled()
+    _fusion.set_enabled(on)
+    try:
+        s0 = _by_op("scanner_tpu_op_seconds_total")
+        r0 = _by_op("scanner_tpu_op_recompiles_total")
+        col = sc.io.Input([NamedVideoStream(sc, "fz_vid")])
+        col = sc.ops.Resize(frame=col, width=[W // 2], height=[H // 2])
+        col = sc.ops.Blur(frame=col, kernel_size=3, sigma=1.1)
+        col = sc.ops.Histogram(frame=col)
+        col = sc.ops.HistDiff(frame=col)
+        out = NamedStream(sc, f"fz_{mode}")
+        t0 = time.time()
+        sc.run(sc.io.Output(col, [out]), PerfParams.manual(8, 16),
+               cache_mode=CacheMode.Overwrite, show_progress=False)
+        wall = round(time.time() - t0, 3)
+        s1 = _by_op("scanner_tpu_op_seconds_total")
+        r1 = _by_op("scanner_tpu_op_recompiles_total")
+        return {"mode": mode, "wall_s": wall,
+                "rows_ok": len(list(out.load())) == N,
+                "op_seconds": round(sum(
+                    s1.get(k, 0.0) - s0.get(k, 0.0) for k in keys), 4),
+                "executables_minted": int(sum(
+                    r1.get(k, 0) - r0.get(k, 0) for k in keys))}
+    finally:
+        _fusion.set_enabled(prev)
+
+# cold pass mints executables; warm pass is the banked steady state
+staged = run_mode("staged", False)
+fused = run_mode("fused", True)
+staged_w = run_mode("staged_warm", False)
+fused_w = run_mode("fused_warm", True)
+speedup = None
+if staged_w["op_seconds"] and fused_w["op_seconds"]:
+    speedup = round(staged_w["op_seconds"] / fused_w["op_seconds"], 3)
+res = {
+    "device": str(jax.devices()[0]),
+    "chain": cid,
+    "rows_ok": all(r["rows_ok"] for r in
+                   (staged, fused, staged_w, fused_w)),
+    "staged": staged, "fused": fused,
+    "staged_warm": staged_w, "fused_warm": fused_w,
+    "fused_chain_speedup": speedup,
+    "executables_avoided": staged["executables_minted"]
+                           - fused["executables_minted"],
+}
+sc.stop()
+# bank the hardware fusion digest next to bench.py's digests so
+# tools/bench_history.py folds fusion_hw into its fusion section
+path = os.path.join(os.getcwd(), "BENCH_DETAIL.json")
+try:
+    detail = json.load(open(path))
+    if not isinstance(detail, list):
+        detail = [detail]
+except Exception:
+    detail = []
+detail.append({"config": "fusion_hw",
+               "clock": time.strftime("%Y-%m-%dT%H:%M:%S"), **res})
+with open(path, "w") as f:
+    json.dump(detail, f, indent=1)
+print("FUSION_HW " + json.dumps(res))
+"""
+
+
 def tunnel_up() -> bool:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from tpu_capture import tunnel_up as probe  # same probe + env override
@@ -873,6 +971,10 @@ def main() -> int:
         "clean gang barrier-skew + clock-sync digest on hardware "
         "(util/clocksync.py -> BENCH_DETAIL.json gang_skew_hw)",
         code=_GANG_SKEW_HW, timeout=1200, marker="GANG_SKEW_HW ")
+    results["fusion_hw"] = run_step(
+        "whole-pipeline fusion staged-vs-fused A/B on hardware "
+        "(graph/fusion.py -> BENCH_DETAIL.json fusion_hw)",
+        code=_FUSION_HW, timeout=1200, marker="FUSION_HW ")
     results["op_bench"] = run_step(
         "per-op device/host A/B (tools/op_bench.py -> OP_BENCH.json)",
         argv=[sys.executable, "tools/op_bench.py"], timeout=1200)
